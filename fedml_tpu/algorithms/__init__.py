@@ -1,0 +1,8 @@
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgAPI,
+    client_sampling,
+    make_fedavg_round,
+    weighted_average,
+)
+
+__all__ = ["FedAvgAPI", "client_sampling", "make_fedavg_round", "weighted_average"]

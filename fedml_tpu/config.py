@@ -1,0 +1,93 @@
+"""Typed run configuration.
+
+One typed config object replacing the reference's three coexisting generations
+(attrs RunConfig at fedml_core/trainer/model_trainer.py:7-38, click CLIs at
+fedml_experiments/distributed/fedavg/main_fedavg.py:24-57, legacy argparse).
+Frozen dataclasses so configs are hashable and safe to close over in jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+# Choice tuples mirroring fedml_experiments/base.py:18-46.
+PARTITION_METHODS = ("hetero", "homo", "hetero-fix")
+CLIENT_OPTIMIZERS = ("sgd", "adam")
+SERVER_OPTIMIZERS = ("sgd", "momentum", "adam", "yogi", "adagrad")
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset + partitioning (ref RunConfig.dataset fields)."""
+
+    dataset: str = "synthetic"
+    data_dir: str = "./data"
+    partition_method: str = "hetero"  # LDA label-skew
+    partition_alpha: float = 0.5
+    batch_size: int = 32
+    # Bucket padded per-client sample counts to multiples of this to bound the
+    # number of distinct jit shapes (see data/base.py).
+    pad_bucket: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Federation topology/round structure (ref RunConfig federation fields)."""
+
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+    comm_round: int = 10
+    epochs: int = 1  # local epochs per round
+    frequency_of_the_test: int = 1
+    ci: bool = False  # CI short-circuit (ref FedAVGAggregator.py:119-126)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Local (client) optimizer settings (ref MyModelTrainer.get_optimizer)."""
+
+    client_optimizer: str = "sgd"
+    lr: float = 0.03
+    wd: float = 0.0
+    momentum: float = 0.0
+    # FedProx proximal term; 0 = plain FedAvg. The reference's distributed
+    # fedprox omits mu entirely (SURVEY §2b) — fixed here.
+    prox_mu: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """Server-side optimizer for the FedOpt family
+    (ref fedml_api/distributed/fedopt/FedOptAggregator.py:95-117)."""
+
+    server_optimizer: str = "sgd"
+    server_lr: float = 1.0
+    server_momentum: float = 0.0
+    tau: float = 1e-3  # adaptivity for yogi/adam
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh spec replacing the reference's gpu_mapping.yaml
+    (fedml_api/distributed/utils/gpu_mapping.py:8-39)."""
+
+    # Number of mesh shards along the client axis; None = all local devices.
+    client_shards: Optional[int] = None
+    axis_name: str = "clients"
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Top-level config threaded through every API (ref RunConfig)."""
+
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    fed: FedConfig = dataclasses.field(default_factory=FedConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    model: str = "lr"
+    seed: int = 0
+
+    def replace(self, **kw) -> "RunConfig":
+        return dataclasses.replace(self, **kw)

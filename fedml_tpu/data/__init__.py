@@ -1,0 +1,10 @@
+from fedml_tpu.data.base import ClientBatch, FederatedDataset, stack_clients
+
+__all__ = ["ClientBatch", "FederatedDataset", "stack_clients", "load_dataset"]
+
+
+def load_dataset(config):
+    """Dataset-name → loader dispatch (ref fedml_experiments/base.py:49-101)."""
+    from fedml_tpu.data import registry
+
+    return registry.load(config)

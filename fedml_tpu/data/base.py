@@ -1,0 +1,137 @@
+"""Federated dataset containers.
+
+Replaces the reference's typed dataset hierarchy
+(fedml_api/data_preprocessing/base.py:15-80: Dataset/LocalDataset/
+FederatedDataset/DistributedDataset + DataLoader ABCs with four load modes)
+with one numpy-backed container plus a *stacking* operation that turns a set of
+sampled clients into dense, padded, masked device arrays — the shape contract
+every jit-compiled round function consumes.
+
+Design note (SURVEY §7 "hard parts"): non-IID client shards are ragged by
+design; XLA needs static shapes. We pad each sampled client's data up to a
+bucketed common length and carry a float mask; weighted aggregation uses true
+sample counts, and all losses are mask-weighted means, so padding never changes
+the math (ref semantics: FedAVGAggregator.py:66-71 weighted averaging,
+my_model_trainer_classification.py:34-53 batch-mean loss).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _ceil_to(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << (x - 1).bit_length() if x > 1 else 1
+
+
+@dataclasses.dataclass
+class ClientBatch:
+    """Dense, device-ready data for a set of sampled clients.
+
+    Shapes: x [C, S, B, *feat], y [C, S, B, *lab], mask [C, S, B] float32,
+    num_samples [C] float32 — C clients, S optimizer steps per local epoch,
+    B batch size. Padded entries have mask 0.
+    """
+
+    x: np.ndarray
+    y: np.ndarray
+    mask: np.ndarray
+    num_samples: np.ndarray
+
+    @property
+    def num_clients(self) -> int:
+        return self.x.shape[0]
+
+
+@dataclasses.dataclass
+class FederatedDataset:
+    """Host-side federated dataset: one (x, y) shard per client plus a global
+    test set. This is the client-state store of SURVEY §7 — clients live in
+    host RAM as numpy; each round the sampled subset is stacked and shipped to
+    device once (never JSON, never per-tensor Python lists —
+    ref message.py:47-59 is the anti-pattern)."""
+
+    name: str
+    client_x: List[np.ndarray]
+    client_y: List[np.ndarray]
+    test_x: np.ndarray
+    test_y: np.ndarray
+    num_classes: int
+    # Optional per-client test shards (for local test parity with
+    # fedavg_api.py:117-180 _local_test_on_all_clients).
+    client_test_x: Optional[List[np.ndarray]] = None
+    client_test_y: Optional[List[np.ndarray]] = None
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.client_x)
+
+    @property
+    def train_sample_counts(self) -> np.ndarray:
+        return np.array([len(y) for y in self.client_y], dtype=np.int64)
+
+    def total_train_samples(self) -> int:
+        return int(self.train_sample_counts.sum())
+
+    def centralized_train(self) -> tuple:
+        """All client shards concatenated — for the federated==centralized
+        oracle (ref CI-script-fedavg.sh:42-48)."""
+        return (
+            np.concatenate(self.client_x, axis=0),
+            np.concatenate(self.client_y, axis=0),
+        )
+
+
+def stack_clients(
+    data: FederatedDataset,
+    client_indices: Sequence[int],
+    batch_size: int,
+    seed: int = 0,
+    pad_bucket: int = 1,
+    shuffle: bool = True,
+) -> ClientBatch:
+    """Build a dense ClientBatch for the sampled clients.
+
+    ``batch_size == -1`` means full batch (one step containing every sample) —
+    the degenerate config the CI oracle uses (ref fedavg full-batch mode,
+    CI-script-fedavg.sh:42).
+
+    Steps-per-epoch S is ceil(max_n / B) rounded up to the next power of two
+    (and to ``pad_bucket``) so repeated rounds with ragged client sizes reuse a
+    small set of compiled shapes instead of recompiling per distinct max-size
+    (full-batch mode is exempt: S is 1 there, but the batch dim varies).
+    """
+    ns = [len(data.client_y[i]) for i in client_indices]
+    max_n = max(ns)
+    bs = max_n if batch_size == -1 else batch_size
+    steps = _ceil_to(_ceil_to(max_n, bs) // bs, pad_bucket)
+    if batch_size != -1:
+        steps = _next_pow2(steps)
+    cap = steps * bs
+
+    rng = np.random.default_rng(seed)
+    feat_shape = data.client_x[client_indices[0]].shape[1:]
+    lab_shape = data.client_y[client_indices[0]].shape[1:]
+    C = len(client_indices)
+    x = np.zeros((C, cap) + feat_shape, dtype=data.client_x[client_indices[0]].dtype)
+    y = np.zeros((C, cap) + lab_shape, dtype=data.client_y[client_indices[0]].dtype)
+    mask = np.zeros((C, cap), dtype=np.float32)
+    for j, ci in enumerate(client_indices):
+        n = ns[j]
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        x[j, :n] = data.client_x[ci][order]
+        y[j, :n] = data.client_y[ci][order]
+        mask[j, :n] = 1.0
+    x = x.reshape((C, steps, bs) + feat_shape)
+    y = y.reshape((C, steps, bs) + lab_shape)
+    mask = mask.reshape((C, steps, bs))
+    return ClientBatch(
+        x=x, y=y, mask=mask, num_samples=np.array(ns, dtype=np.float32)
+    )

@@ -1,0 +1,37 @@
+"""Dataset registry: name → FederatedDataset loader dispatch
+(ref fedml_experiments/base.py:49-101 load_data)."""
+
+from __future__ import annotations
+
+from fedml_tpu.data.base import FederatedDataset
+
+
+def load(config) -> FederatedDataset:
+    """``config`` is a RunConfig (uses .data.* and .fed.client_num_in_total)."""
+    d = config.data
+    name = d.dataset.lower()
+    if name == "synthetic":
+        from fedml_tpu.data.synthetic import synthetic_classification
+
+        return synthetic_classification(
+            num_clients=config.fed.client_num_in_total,
+            partition_method=d.partition_method,
+            partition_alpha=d.partition_alpha,
+            seed=config.seed,
+        )
+    if name.startswith("synthetic_"):
+        # synthetic_<alpha>_<beta>, e.g. synthetic_1_1 (ref
+        # fedml_api/data_preprocessing/synthetic_1_1/).
+        from fedml_tpu.data.synthetic import synthetic_fedprox
+
+        parts = name.split("_")
+        alpha, beta = float(parts[1]), float(parts[2])
+        return synthetic_fedprox(
+            alpha=alpha,
+            beta=beta,
+            num_clients=config.fed.client_num_in_total,
+            seed=config.seed,
+        )
+    raise KeyError(
+        f"unknown dataset {d.dataset!r}; available: synthetic, synthetic_<a>_<b>"
+    )

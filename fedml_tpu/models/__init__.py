@@ -1,0 +1,61 @@
+"""Flax model zoo (ref: fedml_api/model/, re-exported at model/__init__.py:1-15).
+
+Every model is wrapped in a :class:`ModelDef` adapter giving the framework a
+uniform functional surface: ``init(rng) -> variables`` and
+``apply(variables, x, train, rng) -> (outputs, updated_variables)``. The
+variables pytree may contain non-param collections (e.g. ``batch_stats`` for
+BatchNorm models) — FedAvg averages those with the same sample weights the
+reference uses for BN running stats (ref FedAVGAggregator.py:66-71 averages the
+full state_dict, which includes BN stats)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.core import FrozenDict
+
+
+@dataclasses.dataclass
+class ModelDef:
+    module: Any  # flax linen Module
+    input_shape: Tuple[int, ...]  # per-example shape (no batch dim)
+    num_classes: int
+    input_dtype: Any = jnp.float32
+    has_dropout: bool = False
+    has_batch_stats: bool = False
+    name: str = "model"
+
+    def init(self, rng) -> dict:
+        dummy = jnp.zeros((1,) + tuple(self.input_shape), dtype=self.input_dtype)
+        rngs = {"params": rng}
+        if self.has_dropout:
+            rngs["dropout"] = jax.random.fold_in(rng, 1)
+        variables = self.module.init(rngs, dummy, train=False)
+        return jax.tree_util.tree_map(lambda a: a, dict(variables))
+
+    def apply(self, variables, x, train: bool, rng=None):
+        """Returns (outputs, updated_variables)."""
+        rngs = {}
+        if self.has_dropout and train:
+            rngs["dropout"] = rng if rng is not None else jax.random.PRNGKey(0)
+        if self.has_batch_stats and train:
+            out, mutated = self.module.apply(
+                variables, x, train=train, rngs=rngs, mutable=["batch_stats"]
+            )
+            new_vars = dict(variables)
+            new_vars["batch_stats"] = mutated["batch_stats"]
+            return out, new_vars
+        out = self.module.apply(variables, x, train=train, rngs=rngs)
+        return out, variables
+
+
+def create_model(model_name: str, dataset_name: str, input_shape, num_classes, **kw) -> ModelDef:
+    """Model-name × dataset → ModelDef dispatch
+    (ref fedml_experiments/base.py:103-140 create_model)."""
+    from fedml_tpu.models import registry
+
+    return registry.create(model_name, dataset_name, input_shape, num_classes, **kw)

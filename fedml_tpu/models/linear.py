@@ -1,0 +1,19 @@
+"""Linear models (ref: fedml_api/model/linear/lr.py:4 LogisticRegression)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """Flatten → single Dense (ref lr.py:4-13: nn.Linear(input_dim, output_dim),
+    sigmoid applied in loss there; here we return logits and let the loss apply
+    softmax/sigmoid)."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, name="linear")(x)

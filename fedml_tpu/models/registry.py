@@ -1,0 +1,51 @@
+"""Model registry: model-name × dataset → ModelDef
+(ref fedml_experiments/base.py:103-140 create_model dispatch)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelDef
+
+
+def create(
+    model_name: str,
+    dataset_name: str,
+    input_shape: Tuple[int, ...],
+    num_classes: int,
+    **kw,
+) -> ModelDef:
+    name = model_name.lower()
+    if name == "lr":
+        from fedml_tpu.models.linear import LogisticRegression
+
+        return ModelDef(
+            LogisticRegression(num_classes=num_classes),
+            input_shape,
+            num_classes,
+            name="lr",
+        )
+    if name == "cnn":
+        from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+        return ModelDef(
+            CNNOriginalFedAvg(num_classes=num_classes),
+            input_shape,
+            num_classes,
+            name="cnn",
+        )
+    if name == "cnn_dropout":
+        from fedml_tpu.models.cnn import CNNDropOut
+
+        return ModelDef(
+            CNNDropOut(num_classes=num_classes),
+            input_shape,
+            num_classes,
+            has_dropout=True,
+            name="cnn_dropout",
+        )
+    raise KeyError(
+        f"unknown model {model_name!r}; available: lr, cnn, cnn_dropout"
+    )

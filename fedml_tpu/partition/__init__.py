@@ -1,0 +1,13 @@
+from fedml_tpu.partition.noniid import (
+    homo_partition,
+    lda_partition,
+    partition_class_samples_with_dirichlet,
+    record_data_stats,
+)
+
+__all__ = [
+    "homo_partition",
+    "lda_partition",
+    "partition_class_samples_with_dirichlet",
+    "record_data_stats",
+]

@@ -1,0 +1,5 @@
+from fedml_tpu.train.client import make_local_train
+from fedml_tpu.train.evaluate import make_eval_fn
+from fedml_tpu.train import losses
+
+__all__ = ["make_local_train", "make_eval_fn", "losses"]

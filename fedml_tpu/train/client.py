@@ -1,0 +1,165 @@
+"""Local (client) training operator.
+
+Replaces the reference's ModelTrainer ABC + per-task trainers
+(fedml_core/trainer/model_trainer.py:41-81;
+fedml_api/standalone/fedavg/my_model_trainer_classification.py:19-54) with one
+pure function: ``local_train(variables, x, y, mask, rng) -> (variables',
+metrics)`` — a `lax.scan` of optimizer steps over [epochs × steps] minibatches.
+It is vmap-able over a client axis (the standalone simulator) and shard_map-able
+over a device mesh (the distributed runtime); the reference's epoch×batch torch
+loop is HOT LOOP #2 of SURVEY §3.1.
+
+The FedProx proximal term μ/2·‖w − w_global‖² is included when
+``train_config.prox_mu > 0`` — present in the reference only in FedNova's
+optimizer (standalone/fednova/fednova.py:120s); its distributed fedprox omits
+it (SURVEY §2b row fedprox)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.config import TrainConfig
+from fedml_tpu.models import ModelDef
+from fedml_tpu.train import losses as L
+
+
+def build_client_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    """torch-semantics optimizers (ref my_model_trainer_classification.py
+    get_optimizer: SGD(lr) | Adam(lr, wd, amsgrad=True)). Weight decay is
+    L2-added-to-grad (torch style), not decoupled."""
+    parts = []
+    if tc.wd:
+        parts.append(optax.add_decayed_weights(tc.wd))
+    if tc.client_optimizer == "sgd":
+        parts.append(optax.sgd(tc.lr, momentum=tc.momentum if tc.momentum else None))
+    elif tc.client_optimizer == "adam":
+        parts.append(optax.amsgrad(tc.lr))
+    else:
+        raise ValueError(f"unknown client_optimizer {tc.client_optimizer!r}")
+    return optax.chain(*parts)
+
+
+def _split_vars(variables: dict) -> Tuple[dict, dict]:
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    return params, extra
+
+
+def make_task_loss(task: str) -> Callable:
+    """task → (loss, (correct, total)) (ref per-task MyModelTrainer impls)."""
+
+    def classification(logits, y, mask):
+        loss = L.masked_softmax_ce(logits, y, mask)
+        correct, total = L.masked_accuracy_stats(logits, y, mask)
+        return loss, correct, total
+
+    def nwp(logits, y, mask):
+        loss = L.masked_seq_ce(logits, y, mask)
+        correct, total = L.masked_seq_accuracy_stats(logits, y, mask)
+        return loss, correct, total
+
+    def tag(logits, y, mask):
+        loss = L.masked_sigmoid_bce(logits, y, mask)
+        pred = (logits > 0).astype(jnp.float32)
+        correct = jnp.sum((pred == y).astype(jnp.float32) * mask[:, None])
+        total = jnp.sum(mask) * y.shape[-1]
+        return loss, correct, total
+
+    return {"classification": classification, "nwp": nwp, "tag": tag}[task]
+
+
+def make_local_train(
+    model: ModelDef,
+    tc: TrainConfig,
+    epochs: int,
+    task: str = "classification",
+    reshuffle_each_epoch: bool = True,
+):
+    """Build the per-client training function.
+
+    Returned fn: ``(variables, x, y, mask, rng) -> (variables', metrics)`` with
+    x [S, B, *feat], y [S, B, *lab], mask [S, B]. metrics are SUMS
+    {loss_sum, correct, count} so they aggregate exactly across clients.
+    """
+    opt = build_client_optimizer(tc)
+    task_loss = make_task_loss(task)
+
+    def local_train(variables, x, y, mask, rng):
+        params0, extra0 = _split_vars(variables)
+        S, B = mask.shape[0], mask.shape[1]
+        n_flat = S * B
+        x_flat = x.reshape((n_flat,) + x.shape[2:])
+        y_flat = y.reshape((n_flat,) + y.shape[2:])
+        m_flat = mask.reshape((n_flat,))
+
+        def loss_fn(params, extra, xb, yb, mb, step_rng):
+            logits, new_vars = model.apply(
+                {"params": params, **extra}, xb, train=True, rng=step_rng
+            )
+            task_l, correct, total = task_loss(logits, yb, mb)
+            loss = task_l
+            if tc.prox_mu:
+                loss = loss + 0.5 * tc.prox_mu * L.tree_sq_dist(params, params0)
+            _, new_extra = _split_vars(new_vars)
+            # task_l (not loss) feeds the metrics so FedProx runs report plain
+            # task loss, comparable to FedAvg and the reference's logs.
+            return loss, (new_extra, task_l, correct, total)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def epoch_body(carry, epoch_idx):
+            params, extra, opt_state = carry
+            ep_rng = jax.random.fold_in(rng, epoch_idx)
+            if reshuffle_each_epoch:
+                perm = jax.random.permutation(ep_rng, n_flat)
+            else:
+                perm = jnp.arange(n_flat)
+            xe = x_flat[perm].reshape(x.shape)
+            ye = y_flat[perm].reshape(y.shape)
+            me = m_flat[perm].reshape(mask.shape)
+
+            def step_body(carry, inp):
+                params, extra, opt_state = carry
+                xb, yb, mb, sidx = inp
+                step_rng = jax.random.fold_in(ep_rng, sidx)
+                (_, (new_extra, task_l, correct, total)), grads = grad_fn(
+                    params, extra, xb, yb, mb, step_rng
+                )
+                updates, new_opt_state = opt.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                # An all-padding step (mask sum 0) must be a complete no-op:
+                # masked-mean grads are already 0, but momentum/Adam state and
+                # the prox term would still move params — gate everything.
+                has_data = jnp.sum(mb) > 0
+
+                def keep(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(has_data, n, o), new, old
+                    )
+
+                params = keep(new_params, params)
+                opt_state = keep(new_opt_state, opt_state)
+                extra = keep(new_extra, extra)
+                mets = jnp.stack([task_l * total, correct, total])
+                return (params, extra, opt_state), mets
+
+            (params, extra, opt_state), mets = jax.lax.scan(
+                step_body,
+                (params, extra, opt_state),
+                (xe, ye, me, jnp.arange(S)),
+            )
+            return (params, extra, opt_state), mets.sum(axis=0)
+
+        opt_state = opt.init(params0)
+        (params, extra, _), mets = jax.lax.scan(
+            epoch_body, (params0, extra0, opt_state), jnp.arange(epochs)
+        )
+        mets = mets.sum(axis=0)
+        metrics = {"loss_sum": mets[0], "correct": mets[1], "count": mets[2]}
+        return {"params": params, **extra}, metrics
+
+    return local_train

@@ -1,0 +1,67 @@
+"""Mask-weighted losses and metrics.
+
+The reference computes per-batch mean cross-entropy
+(my_model_trainer_classification.py:34-53) and counts corrects for accuracy
+(:56-86). Here every loss/metric is a mask-weighted mean so zero-padded
+examples (see data/base.py) contribute nothing."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _safe_div(num, den):
+    return num / jnp.maximum(den, 1e-9)
+
+
+def masked_softmax_ce(logits, labels, mask):
+    """Mean CE over masked examples. labels: int [B]; mask: float [B]."""
+    per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return _safe_div(jnp.sum(per_ex * mask), jnp.sum(mask))
+
+
+def masked_accuracy_stats(logits, labels, mask):
+    """Returns (correct_count, total_count) — the reference's metric schema
+    {test_correct, test_total} (my_model_trainer_classification.py:60-64)."""
+    pred = jnp.argmax(logits, axis=-1)
+    correct = jnp.sum((pred == labels).astype(jnp.float32) * mask)
+    return correct, jnp.sum(mask)
+
+
+def masked_sigmoid_bce(logits, labels, mask):
+    """Multi-label BCE for tag prediction (ref
+    my_model_trainer_tag_prediction.py: BCELoss). labels: float [B, C]."""
+    per_ex = jnp.sum(
+        optax.sigmoid_binary_cross_entropy(logits, labels), axis=-1
+    )
+    return _safe_div(jnp.sum(per_ex * mask), jnp.sum(mask))
+
+
+def masked_seq_ce(logits, labels, mask, pad_token: int = 0):
+    """Next-word/char prediction CE over sequences, ignoring pad tokens
+    (ref my_model_trainer_nwp.py: criterion ignores padding idx 0).
+
+    logits [B, T, V], labels int [B, T], mask float [B] (example mask)."""
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    tok_mask = (labels != pad_token).astype(jnp.float32) * mask[:, None]
+    return _safe_div(jnp.sum(per_tok * tok_mask), jnp.sum(tok_mask))
+
+
+def masked_seq_accuracy_stats(logits, labels, mask, pad_token: int = 0):
+    pred = jnp.argmax(logits, axis=-1)
+    tok_mask = (labels != pad_token).astype(jnp.float32) * mask[:, None]
+    correct = jnp.sum((pred == labels).astype(jnp.float32) * tok_mask)
+    return correct, jnp.sum(tok_mask)
+
+
+def tree_sq_norm(tree):
+    return sum(jnp.sum(jnp.square(x)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_sq_dist(a, b):
+    return sum(
+        jnp.sum(jnp.square(x - y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )

@@ -1,0 +1,75 @@
+"""Padding must be mathematically invisible (the core shape-contract claim of
+fedml_tpu/data/base.py) — including for stateful optimizers (momentum/Adam)
+and the FedProx prox term, where a padded step would otherwise still move
+params via optimizer state. Regression test for the gated step in
+train/client.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.config import TrainConfig
+from fedml_tpu.models import ModelDef
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.train.client import make_local_train
+
+
+def _run(tc, n_real_steps, n_pad_steps, epochs=2):
+    model = ModelDef(LogisticRegression(num_classes=3), (4,), 3)
+    variables = model.init(jax.random.PRNGKey(0))
+    B = 5
+    rng = np.random.default_rng(0)
+    S = n_real_steps + n_pad_steps
+    x = np.zeros((S, B, 4), np.float32)
+    y = np.zeros((S, B), np.int32)
+    m = np.zeros((S, B), np.float32)
+    x[:n_real_steps] = rng.normal(size=(n_real_steps, B, 4))
+    y[:n_real_steps] = rng.integers(0, 3, size=(n_real_steps, B))
+    m[:n_real_steps] = 1.0
+    fn = make_local_train(model, tc, epochs=epochs, reshuffle_each_epoch=False)
+    out_vars, metrics = jax.jit(fn)(
+        variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jax.random.PRNGKey(7)
+    )
+    return out_vars, metrics
+
+
+@pytest.mark.parametrize(
+    "tc",
+    [
+        TrainConfig(client_optimizer="sgd", lr=0.1, momentum=0.9),
+        TrainConfig(client_optimizer="adam", lr=0.01),
+        TrainConfig(client_optimizer="sgd", lr=0.1, prox_mu=0.1),
+        TrainConfig(client_optimizer="sgd", lr=0.1, wd=0.01),
+    ],
+    ids=["momentum", "adam", "prox", "wd"],
+)
+def test_trailing_padding_is_noop(tc):
+    v_unpadded, m_unpadded = _run(tc, n_real_steps=2, n_pad_steps=0)
+    v_padded, m_padded = _run(tc, n_real_steps=2, n_pad_steps=3)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(v_unpadded), jax.tree_util.tree_leaves(v_padded)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    assert float(m_unpadded["count"]) == float(m_padded["count"])
+
+
+def test_registries():
+    from fedml_tpu.config import RunConfig, DataConfig, FedConfig
+    from fedml_tpu.data import load_dataset
+    from fedml_tpu.models import create_model
+
+    cfg = RunConfig(
+        data=DataConfig(dataset="synthetic"), fed=FedConfig(client_num_in_total=4)
+    )
+    data = load_dataset(cfg)
+    assert data.num_clients == 4
+    model = create_model("lr", "synthetic", (28, 28, 1), 10)
+    assert model.num_classes == 10
+    cfg2 = cfg.replace(data=DataConfig(dataset="synthetic_1_1"))
+    data2 = load_dataset(cfg2)
+    assert data2.num_clients == 4
+    with pytest.raises(KeyError):
+        load_dataset(cfg.replace(data=DataConfig(dataset="nope")))
+    with pytest.raises(KeyError):
+        create_model("nope", "synthetic", (1,), 2)

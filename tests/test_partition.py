@@ -1,0 +1,38 @@
+import numpy as np
+
+from fedml_tpu.partition import homo_partition, lda_partition, record_data_stats
+
+
+def test_homo_partition_covers_all():
+    rng = np.random.default_rng(0)
+    parts = homo_partition(103, 7, rng)
+    all_idx = np.sort(np.concatenate(list(parts.values())))
+    assert np.array_equal(all_idx, np.arange(103))
+
+
+def test_lda_partition_covers_all_and_min_size():
+    labels = np.random.default_rng(1).integers(0, 10, size=2000)
+    parts = lda_partition(labels, 20, alpha=0.5, seed=3, min_size=10)
+    all_idx = np.sort(np.concatenate(list(parts.values())))
+    assert np.array_equal(all_idx, np.arange(2000))
+    assert min(len(v) for v in parts.values()) >= 10
+
+
+def test_lda_partition_is_skewed():
+    # Low alpha must produce label skew: some client has a dominant class.
+    labels = np.random.default_rng(2).integers(0, 10, size=5000)
+    parts = lda_partition(labels, 10, alpha=0.1, seed=0)
+    stats = record_data_stats(labels, parts)
+    top_fracs = []
+    for hist in stats.values():
+        tot = sum(hist.values())
+        top_fracs.append(max(hist.values()) / tot)
+    assert max(top_fracs) > 0.5
+
+
+def test_lda_partition_deterministic():
+    labels = np.random.default_rng(3).integers(0, 5, size=500)
+    a = lda_partition(labels, 5, 0.5, seed=7)
+    b = lda_partition(labels, 5, 0.5, seed=7)
+    for k in a:
+        assert np.array_equal(a[k], b[k])
