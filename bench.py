@@ -1,0 +1,80 @@
+"""Headline benchmark: FEMNIST-CNN FedAvg rounds/sec on the available device.
+
+Workload parity with the reference's north-star config (BASELINE.json /
+benchmark/README.md:54): Federated-EMNIST geometry (28×28×1, 62 classes,
+power-law client shards ~226 samples), CNNOriginalFedAvg, 10 clients/round,
+batch 20, E=1, SGD lr 0.1. Data is synthetic with the real geometry (the real
+h5 is not vendored; shapes/FLOPs match, so throughput is representative).
+
+Baseline: the reference publishes no wall-clock numbers (SURVEY §6). The
+comparison constant below is an estimate of the reference's per-round time on
+its documented MPI path: 10 clients × ~12 local steps of the 1.2M-param CNN
+(~0.25 s on a V100 worker including per-round model transfer — the reference
+serializes the full state dict through JSON lists per message,
+message.py:47-59,76-79, which alone costs ~1 s for 1.2M floats) → ~0.5
+rounds/sec. Printed as `vs_baseline` = ours / 0.5.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+REF_ROUNDS_PER_SEC = 0.5  # estimated 8xV100 MPI reference (see module doc)
+
+
+def main():
+    import jax
+
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.femnist_synth import femnist_synthetic
+    from fedml_tpu.models import create_model
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    config = RunConfig(
+        data=DataConfig(dataset="femnist", batch_size=20, pad_bucket=4),
+        fed=FedConfig(
+            client_num_in_total=128,
+            client_num_per_round=10,
+            comm_round=1,
+            epochs=1,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        model="cnn",
+        seed=0,
+    )
+    data = femnist_synthetic(num_clients=128, seed=0)
+    model = create_model("cnn", "femnist", (28, 28, 1), 62)
+    api = FedAvgAPI(config, data, model)
+
+    # Warmup: compile every bucketed shape the timed rounds will see.
+    warmup_rounds = 3
+    timed_rounds = 20
+    for r in range(warmup_rounds):
+        api.train_round(r)
+    jax.block_until_ready(api.global_vars)
+
+    t0 = time.perf_counter()
+    for r in range(warmup_rounds, warmup_rounds + timed_rounds):
+        api.train_round(r)
+    jax.block_until_ready(api.global_vars)
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = timed_rounds / dt
+    print(
+        json.dumps(
+            {
+                "metric": "femnist_cnn_fedavg_rounds_per_sec",
+                "value": round(rounds_per_sec, 4),
+                "unit": "rounds/sec",
+                "vs_baseline": round(rounds_per_sec / REF_ROUNDS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -4,5 +4,19 @@ from fedml_tpu.algorithms.fedavg import (
     make_fedavg_round,
     weighted_average,
 )
+from fedml_tpu.algorithms.fedopt import FedOptAPI, make_server_optimizer
+from fedml_tpu.algorithms.fednova import FedNovaAPI, make_fednova_round
+from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI, assign_groups
 
-__all__ = ["FedAvgAPI", "client_sampling", "make_fedavg_round", "weighted_average"]
+__all__ = [
+    "FedAvgAPI",
+    "FedOptAPI",
+    "FedNovaAPI",
+    "HierarchicalFedAvgAPI",
+    "assign_groups",
+    "client_sampling",
+    "make_fedavg_round",
+    "make_fednova_round",
+    "make_server_optimizer",
+    "weighted_average",
+]

@@ -58,6 +58,14 @@ def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_rou
     )
 
 
+def round_client_rngs(round_rng, num_sampled: int):
+    """Per-client PRNG keys for one round. Generated once per round from the
+    round-folded key so the stream is independent of how clients are later
+    padded/sharded over a mesh (single-chip and N-shard runs see identical
+    per-client randomness)."""
+    return jax.random.split(round_rng, num_sampled)
+
+
 def make_fedavg_round(
     model: ModelDef,
     config: RunConfig,
@@ -74,12 +82,10 @@ def make_fedavg_round(
         model, config.train, config.fed.epochs, task=task
     )
 
-    def round_fn(global_vars, x, y, mask, num_samples, rng):
-        C = mask.shape[0]
-        rngs = jax.random.split(rng, C)
+    def round_fn(global_vars, x, y, mask, num_samples, client_rngs):
         client_vars, metrics = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
-        )(global_vars, x, y, mask, rngs)
+        )(global_vars, x, y, mask, client_rngs)
         new_global = weighted_average(client_vars, num_samples)
         agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)
         return new_global, agg_metrics
@@ -95,6 +101,10 @@ class FedAvgAPI:
     move is restacking the sampled shards into one padded device batch.
     """
 
+    # Subclasses that read the pre-round global model after the round call
+    # (e.g. FedOpt's pseudo-gradient) must disable buffer donation.
+    _donate = True
+
     def __init__(
         self,
         config: RunConfig,
@@ -102,7 +112,6 @@ class FedAvgAPI:
         model: ModelDef,
         task: str = "classification",
         local_train_fn: Optional[Callable] = None,
-        aggregate_fn=None,
         log_fn: Optional[Callable[[dict], None]] = None,
     ):
         self.config = config
@@ -112,11 +121,18 @@ class FedAvgAPI:
         self.log_fn = log_fn or (lambda m: None)
         self.rng = jax.random.PRNGKey(config.seed)
         self.global_vars = model.init(jax.random.fold_in(self.rng, 0))
-        self.round_fn = make_fedavg_round(
-            model, config, task=task, local_train_fn=local_train_fn
-        )
+        self.round_fn = self._build_round_fn(local_train_fn)
         self.eval_fn = make_eval_fn(model, task)
         self.history: list = []
+
+    def _build_round_fn(self, local_train_fn):
+        return make_fedavg_round(
+            self.model,
+            self.config,
+            task=self.task,
+            local_train_fn=local_train_fn,
+            donate=self._donate,
+        )
 
     def train_round(self, round_idx: int):
         cfg = self.config
@@ -132,14 +148,20 @@ class FedAvgAPI:
         )
         rng = jax.random.fold_in(self.rng, round_idx + 1)
         self.global_vars, metrics = self.round_fn(
-            self.global_vars,
+            self.global_vars, *self._place_batch(batch, rng)
+        )
+        return sampled, metrics
+
+    def _place_batch(self, batch, round_rng):
+        """Device placement hook — the sharded subclass pads the client axis
+        to the mesh and shards these arrays over it."""
+        return (
             jnp.asarray(batch.x),
             jnp.asarray(batch.y),
             jnp.asarray(batch.mask),
             jnp.asarray(batch.num_samples),
-            rng,
+            round_client_rngs(round_rng, batch.num_clients),
         )
-        return sampled, metrics
 
     def train(self) -> Dict[str, float]:
         cfg = self.config

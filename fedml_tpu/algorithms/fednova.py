@@ -1,0 +1,108 @@
+"""FedNova — normalized averaging (ref: fedml_api/standalone/fednova/,
+vendored from JYWa/FedNova; fednova.py:10 `FedNova(Optimizer)` with the
+`local_normalizing_vec` bookkeeping at :141-170, server aggregation
+`FedNovaTrainer.aggregate(params, norm_grads, tau_effs)` at
+fednova_trainer.py:97-125).
+
+Clients run heterogeneous numbers of local steps τ_i (ragged shards ⇒ ragged
+step counts); plain FedAvg then implicitly over-weights fast clients. FedNova
+normalizes each client's cumulative update by its step-accumulation factor
+a_i and rescales by the effective τ:
+
+    d_i   = (w_g − w_i) / a_i
+    τ_eff = Σ p_i a_i          (p_i = n_i / Σ n)
+    w'    = w_g − τ_eff Σ p_i d_i
+
+For vanilla SGD a_i = τ_i; for local momentum ρ, a_i = Σ_{k=1}^{τ_i}
+(1−ρ^k)/(1−ρ) = (τ_i − ρ(1−ρ^{τ_i})/(1−ρ))/(1−ρ) — exactly what the
+reference's optimizer accumulates step-by-step into `local_normalizing_vec`
+(fednova.py:141-170); here it's the closed form of τ_i, which the local-train
+scan reports as the "steps" metric (all-padding steps are gated no-ops and
+excluded). Unlike the reference (whose fednova is standalone-only), the same
+round function vmaps on one chip and shard_maps over a mesh."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.train.client import make_local_train
+
+
+def _accum_factor(tau, momentum: float):
+    """Closed form of the reference's local_normalizing_vec after tau steps."""
+    if momentum:
+        rho = momentum
+        return (tau - rho * (1.0 - rho**tau) / (1.0 - rho)) / (1.0 - rho)
+    return tau
+
+
+def make_fednova_round(model, config, task="classification", local_train_fn=None, donate=True):
+    # The closed-form a_i below models plain/momentum SGD only. The
+    # reference's mu-aware accumulation (fednova.py etamu branch) and
+    # adaptive client optimizers are not modeled — reject rather than
+    # silently mis-normalize.
+    if config.train.client_optimizer != "sgd":
+        raise ValueError(
+            "FedNova requires client_optimizer='sgd' "
+            f"(got {config.train.client_optimizer!r})"
+        )
+    if config.train.prox_mu:
+        raise ValueError("FedNova with prox_mu is not supported")
+    local_train = local_train_fn or make_local_train(
+        model, config.train, config.fed.epochs, task=task
+    )
+    momentum = config.train.momentum
+
+    def round_fn(global_vars, x, y, mask, num_samples, client_rngs):
+        client_vars, metrics = jax.vmap(
+            local_train, in_axes=(None, 0, 0, 0, 0)
+        )(global_vars, x, y, mask, client_rngs)
+        p = num_samples / jnp.sum(num_samples)
+        tau = metrics["steps"]  # [C] effective local steps
+        a = _accum_factor(tau, momentum)
+        # Dummy padded clients: tau = 0 ⇒ a = 0; their p is also 0 — guard
+        # the division so 0/0 doesn't poison the sum.
+        a_safe = jnp.where(a > 0, a, 1.0)
+        tau_eff = jnp.sum(p * a)
+
+        def nova_avg(stacked, g):
+            stacked = stacked.astype(jnp.float32)
+            # d_i = (w_g − w_i)/a_i ; w' = w_g − τ_eff Σ p_i d_i
+            coeff = p * tau_eff / a_safe * (a > 0)
+            return g - jnp.tensordot(coeff, g[None] - stacked, axes=1)
+
+        # Only params get the nova update; other collections (BN stats) are
+        # plain weighted averages as in FedAvg.
+        new_params = jax.tree_util.tree_map(
+            lambda s, g: nova_avg(s, g), client_vars["params"], global_vars["params"]
+        )
+        new_global = {
+            k: (
+                new_params
+                if k == "params"
+                else jax.tree_util.tree_map(
+                    lambda s: jnp.tensordot(p, s.astype(jnp.float32), axes=1),
+                    v,
+                )
+            )
+            for k, v in client_vars.items()
+        }
+        agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)
+        return new_global, agg_metrics
+
+    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+
+
+class FedNovaAPI(FedAvgAPI):
+    """FedNova simulator — FedAvg round skeleton with normalized averaging."""
+
+    def _build_round_fn(self, local_train_fn):
+        return make_fednova_round(
+            self.model,
+            self.config,
+            task=self.task,
+            local_train_fn=local_train_fn,
+            donate=self._donate,
+        )

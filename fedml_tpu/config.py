@@ -41,6 +41,11 @@ class FedConfig:
     epochs: int = 1  # local epochs per round
     frequency_of_the_test: int = 1
     ci: bool = False  # CI short-circuit (ref FedAVGAggregator.py:119-126)
+    # Hierarchical FL (ref standalone/hierarchical_fl/trainer.py:43-69):
+    # clients → group_num groups; each global round runs group_comm_round
+    # FedAvg sub-rounds inside every group before the cross-group average.
+    group_num: int = 1
+    group_comm_round: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,21 @@
+"""Device-mesh parallelism: the TPU-native replacement for the reference's
+process-per-worker MPI runtime (ref fedml_core/distributed/communication/mpi/ +
+fedml_api/distributed/utils/gpu_mapping.py).
+
+Instead of `mpirun -np N+1` processes exchanging JSON-serialized state dicts
+(SURVEY §2h), clients are laid out along a mesh axis of a single SPMD program:
+"broadcast" is parameter replication, "gather + aggregate" is a weighted `psum`
+over ICI. The mesh spec replaces gpu_mapping.yaml."""
+
+from fedml_tpu.parallel.mesh import make_mesh, pad_client_batch
+from fedml_tpu.parallel.fedavg_sharded import (
+    make_sharded_fedavg_round,
+    DistributedFedAvgAPI,
+)
+
+__all__ = [
+    "make_mesh",
+    "pad_client_batch",
+    "make_sharded_fedavg_round",
+    "DistributedFedAvgAPI",
+]

@@ -1,0 +1,55 @@
+"""Mesh construction + client-batch padding.
+
+Replaces the reference's host×GPU→process placement YAML
+(fedml_api/distributed/utils/gpu_mapping.py:8-39, gpu_mapping.yaml): a
+`jax.sharding.Mesh` over the local (or declared) devices with a named client
+axis. Sampled clients per round are padded with zero-weight dummies to a
+multiple of the mesh size so the per-shard client count is static."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from fedml_tpu.data.base import ClientBatch
+
+
+def make_mesh(
+    client_shards: Optional[int] = None,
+    axis_name: str = "clients",
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D mesh along the client axis. ``client_shards=None`` uses every
+    visible device (the common case: one shard per chip)."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = client_shards if client_shards is not None else len(devs)
+    if n > len(devs):
+        raise ValueError(f"client_shards={n} > available devices {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def pad_client_batch(batch: ClientBatch, multiple: int) -> ClientBatch:
+    """Pad the client axis with all-mask-zero dummy clients so C is divisible
+    by the mesh size. Dummy clients carry num_samples=0, so the weighted
+    aggregation (ref FedAVGAggregator.py:66-71 semantics) ignores them exactly,
+    and the all-padding-step no-op gate in train/client.py leaves their
+    parameters untouched."""
+    C = batch.num_clients
+    rem = C % multiple
+    if rem == 0:
+        return batch
+    extra = multiple - rem
+
+    def pad0(a):
+        pad = [(0, extra)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, pad)
+
+    return ClientBatch(
+        x=pad0(batch.x),
+        y=pad0(batch.y),
+        mask=pad0(batch.mask),
+        num_samples=pad0(batch.num_samples),
+    )

@@ -144,7 +144,9 @@ def make_local_train(
                 params = keep(new_params, params)
                 opt_state = keep(new_opt_state, opt_state)
                 extra = keep(new_extra, extra)
-                mets = jnp.stack([task_l * total, correct, total])
+                mets = jnp.stack(
+                    [task_l * total, correct, total, has_data.astype(jnp.float32)]
+                )
                 return (params, extra, opt_state), mets
 
             (params, extra, opt_state), mets = jax.lax.scan(
@@ -159,7 +161,14 @@ def make_local_train(
             epoch_body, (params0, extra0, opt_state), jnp.arange(epochs)
         )
         mets = mets.sum(axis=0)
-        metrics = {"loss_sum": mets[0], "correct": mets[1], "count": mets[2]}
+        # "steps" = effective local optimizer steps (all-padding steps are
+        # gated no-ops and not counted) — FedNova's τ_i normalizer.
+        metrics = {
+            "loss_sum": mets[0],
+            "correct": mets[1],
+            "count": mets[2],
+            "steps": mets[3],
+        }
         return {"params": params, **extra}, metrics
 
     return local_train

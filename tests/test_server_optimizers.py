@@ -1,0 +1,183 @@
+"""Oracle-style reduction tests for the algorithm variants:
+
+- FedOpt with server SGD lr=1.0 IS FedAvg (w_old − 1.0·(w_old − w_avg) = w_avg)
+  — the identity the reference's pseudo-gradient construction relies on
+  (FedOptAggregator.py:109-117).
+- FedNova with equal client sample counts and equal local steps reduces to
+  FedAvg (a_i identical ⇒ τ_eff = a ⇒ w' = Σ p_i w_i).
+- Hierarchical FedAvg with group_comm_round=1 equals flat FedAvg under
+  full-batch E=1 for ANY group split — the reference's CI oracle
+  (CI-script-fedavg.sh:52-58).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.config import (
+    DataConfig,
+    FedConfig,
+    RunConfig,
+    ServerConfig,
+    TrainConfig,
+)
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import ModelDef
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.algorithms import (
+    FedAvgAPI,
+    FedNovaAPI,
+    FedOptAPI,
+    HierarchicalFedAvgAPI,
+)
+
+NUM_CLIENTS = 8
+NUM_CLASSES = 5
+FEAT = (6,)
+
+
+def _data(ragged=True):
+    return synthetic_classification(
+        num_clients=NUM_CLIENTS,
+        num_classes=NUM_CLASSES,
+        feat_shape=FEAT,
+        samples_per_client=24,
+        partition_method="homo",
+        ragged=ragged,
+        seed=5,
+    )
+
+
+def _model():
+    return ModelDef(
+        module=LogisticRegression(num_classes=NUM_CLASSES),
+        input_shape=FEAT,
+        num_classes=NUM_CLASSES,
+        name="lr",
+    )
+
+
+def _cfg(**over):
+    base = dict(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=NUM_CLIENTS,
+            client_num_per_round=NUM_CLIENTS,
+            comm_round=4,
+            epochs=1,
+            frequency_of_the_test=4,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=3,
+    )
+    base.update(over)
+    return RunConfig(**base)
+
+
+def _assert_trees_close(a, b, atol=1e-5):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol, rtol=1e-5)
+
+
+def test_fedopt_sgd_lr1_equals_fedavg():
+    data = _data()
+    cfg = _cfg(server=ServerConfig(server_optimizer="sgd", server_lr=1.0))
+    avg = FedAvgAPI(cfg, data, _model())
+    avg.train()
+    opt = FedOptAPI(cfg, data, _model())
+    opt.train()
+    _assert_trees_close(avg.global_vars, opt.global_vars)
+
+
+def test_fedopt_adam_learns():
+    data = _data()
+    cfg = _cfg(
+        server=ServerConfig(server_optimizer="adam", server_lr=0.05),
+        fed=FedConfig(
+            client_num_in_total=NUM_CLIENTS,
+            client_num_per_round=4,
+            comm_round=15,
+            epochs=1,
+            frequency_of_the_test=15,
+        ),
+    )
+    api = FedOptAPI(cfg, data, _model())
+    final = api.train()
+    assert final["Test/Acc"] > 0.5
+
+
+def test_fednova_equal_clients_equals_fedavg():
+    # Equal shard sizes + full batch => tau_i identical => FedNova == FedAvg.
+    data = _data(ragged=False)
+    cfg = _cfg(data=DataConfig(batch_size=-1))
+    avg = FedAvgAPI(cfg, data, _model())
+    avg.train()
+    nova = FedNovaAPI(cfg, data, _model())
+    nova.train()
+    _assert_trees_close(avg.global_vars, nova.global_vars)
+
+
+def test_fednova_rejects_unsupported():
+    data = _data()
+    with pytest.raises(ValueError):
+        FedNovaAPI(_cfg(train=TrainConfig(client_optimizer="adam")), data, _model())
+    with pytest.raises(ValueError):
+        FedNovaAPI(_cfg(train=TrainConfig(prox_mu=0.1)), data, _model())
+
+
+def test_fednova_ragged_learns():
+    data = _data(ragged=True)
+    cfg = _cfg(
+        train=TrainConfig(client_optimizer="sgd", lr=0.1, momentum=0.9),
+        fed=FedConfig(
+            client_num_in_total=NUM_CLIENTS,
+            client_num_per_round=NUM_CLIENTS,
+            comm_round=15,
+            epochs=2,
+            frequency_of_the_test=15,
+        ),
+    )
+    api = FedNovaAPI(cfg, data, _model())
+    final = api.train()
+    assert final["Test/Acc"] > 0.5
+
+
+def test_hierarchical_oracle_equals_flat():
+    """Full batch, E=1, group_comm_round=1, full participation: hierarchical
+    == flat FedAvg for any group split (ref CI-script-fedavg.sh:52-58)."""
+    data = _data()
+    cfg = _cfg(
+        data=DataConfig(batch_size=-1),
+        fed=FedConfig(
+            client_num_in_total=NUM_CLIENTS,
+            client_num_per_round=NUM_CLIENTS,
+            comm_round=3,
+            epochs=1,
+            frequency_of_the_test=3,
+            group_num=3,
+            group_comm_round=1,
+        ),
+    )
+    flat = FedAvgAPI(cfg, data, _model())
+    flat.train()
+    hier = HierarchicalFedAvgAPI(cfg, data, _model())
+    hier.train()
+    _assert_trees_close(flat.global_vars, hier.global_vars)
+
+
+def test_hierarchical_multi_subround_learns():
+    data = _data()
+    cfg = _cfg(
+        fed=FedConfig(
+            client_num_in_total=NUM_CLIENTS,
+            client_num_per_round=NUM_CLIENTS,
+            comm_round=8,
+            epochs=1,
+            frequency_of_the_test=8,
+            group_num=2,
+            group_comm_round=2,
+        ),
+    )
+    api = HierarchicalFedAvgAPI(cfg, data, _model())
+    final = api.train()
+    assert final["Test/Acc"] > 0.5
