@@ -27,6 +27,15 @@ def _ceil_to(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
 
 
+def concat_nonempty(parts: Sequence[np.ndarray], like: np.ndarray) -> np.ndarray:
+    """Concatenate, tolerating an all-empty list (returns a well-formed
+    (0, *feat) array shaped/typed like ``like``'s rows)."""
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        return np.zeros((0,) + tuple(like.shape[1:]), like.dtype)
+    return np.concatenate(parts, axis=0)
+
+
 def _next_pow2(x: int) -> int:
     return 1 << (x - 1).bit_length() if x > 1 else 1
 

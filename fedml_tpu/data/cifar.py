@@ -1,0 +1,134 @@
+"""Centralized image datasets + LDA partitioning — CIFAR-10/100, CINIC-10
+(ref: fedml_api/data_preprocessing/base.py:100-260 CifarDataLoader template +
+cifar10/cifar100/cinic10 subclasses).
+
+These datasets ship as one global train set; the federated split is
+synthesized by the LDA/homo partitioner (partition/noniid.py — the pure-numpy
+port of fedml_core/non_iid_partition/). Normalization constants match the
+reference exactly (cifar10/data_loader.py:6-7, cifar100:12-13, cinic10:14-15).
+Cutout/random-crop augmentation (base.py:136-146) is deliberately host-free:
+random augmentation belongs inside the jit'd train step (future work), and
+eval parity doesn't need it."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.partition.noniid import homo_partition, lda_partition
+
+CIFAR10_MEAN = (0.49139968, 0.48215827, 0.44653124)
+CIFAR10_STD = (0.24703233, 0.24348505, 0.26158768)
+CIFAR100_MEAN = (0.5071, 0.4865, 0.4409)
+CIFAR100_STD = (0.2673, 0.2564, 0.2762)
+CINIC10_MEAN = (0.47889522, 0.47227842, 0.43047404)
+CINIC10_STD = (0.24205776, 0.23828046, 0.25874835)
+
+
+def _normalize(x_u8: np.ndarray, mean, std) -> np.ndarray:
+    x = x_u8.astype(np.float32) / 255.0
+    return (x - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+
+
+def _load_cifar10_raw(data_dir: str):
+    d = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(d):
+        raise FileNotFoundError(
+            f"CIFAR-10 not found at {d} (python pickle batches; "
+            "ref data/cifar10/download_cifar10.sh)"
+        )
+
+    def read(fname):
+        with open(os.path.join(d, fname), "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        x = batch[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        y = np.asarray(batch[b"labels"], np.int32)
+        return x, y
+
+    xs, ys = zip(*(read(f"data_batch_{i}") for i in range(1, 6)))
+    tx, ty = read("test_batch")
+    return np.concatenate(xs), np.concatenate(ys), tx, ty
+
+
+def _load_cifar100_raw(data_dir: str):
+    d = os.path.join(data_dir, "cifar-100-python")
+    if not os.path.isdir(d):
+        raise FileNotFoundError(f"CIFAR-100 not found at {d}")
+
+    def read(fname):
+        with open(os.path.join(d, fname), "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        x = batch[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        y = np.asarray(batch[b"fine_labels"], np.int32)
+        return x, y
+
+    x, y = read("train")
+    tx, ty = read("test")
+    return x, y, tx, ty
+
+
+def _load_cinic10_raw(data_dir: str):
+    """CINIC-10 ImageFolder (train/ test/ with one subdir per class)."""
+    from PIL import Image
+
+    root = data_dir
+    if not os.path.isdir(os.path.join(root, "train")):
+        raise FileNotFoundError(
+            f"CINIC-10 not found at {root} (ImageFolder layout train/<class>/*.png)"
+        )
+
+    def read(split):
+        xs, ys = [], []
+        classes = sorted(os.listdir(os.path.join(root, split)))
+        for yi, c in enumerate(classes):
+            cdir = os.path.join(root, split, c)
+            for fn in sorted(os.listdir(cdir)):
+                with Image.open(os.path.join(cdir, fn)) as im:
+                    xs.append(np.asarray(im.convert("RGB"), np.uint8))
+                ys.append(yi)
+        return np.stack(xs), np.asarray(ys, np.int32)
+
+    x, y = read("train")
+    tx, ty = read("test")
+    return x, y, tx, ty
+
+
+_DATASETS = {
+    "cifar10": (_load_cifar10_raw, CIFAR10_MEAN, CIFAR10_STD, 10),
+    "cifar100": (_load_cifar100_raw, CIFAR100_MEAN, CIFAR100_STD, 100),
+    "cinic10": (_load_cinic10_raw, CINIC10_MEAN, CINIC10_STD, 10),
+}
+
+
+def load_cifar_family(
+    name: str,
+    data_dir: str,
+    num_clients: int,
+    partition_method: str = "hetero",
+    partition_alpha: float = 0.5,
+    seed: int = 0,
+) -> FederatedDataset:
+    """Global train set → LDA ('hetero') or uniform ('homo') client shards
+    (ref base.py:165-212 partition_data)."""
+    loader, mean, std, num_classes = _DATASETS[name]
+    x, y, tx, ty = loader(data_dir)
+    x = _normalize(x, mean, std)
+    tx = _normalize(tx, mean, std)
+    if partition_method == "homo":
+        idx_map = homo_partition(len(y), num_clients, np.random.default_rng(seed))
+    else:
+        idx_map = lda_partition(y, num_clients, partition_alpha, seed=seed)
+    client_x = [x[idx_map[i]] for i in range(num_clients)]
+    client_y = [y[idx_map[i]] for i in range(num_clients)]
+    return FederatedDataset(
+        name=name,
+        client_x=client_x,
+        client_y=client_y,
+        test_x=tx,
+        test_y=ty,
+        num_classes=num_classes,
+    )

@@ -1,20 +1,46 @@
 """Dataset registry: name → FederatedDataset loader dispatch
-(ref fedml_experiments/base.py:49-101 load_data)."""
+(ref fedml_experiments/base.py:49-101 load_data; DATASETS tuple base.py:28-40)."""
 
 from __future__ import annotations
 
 from fedml_tpu.data.base import FederatedDataset
+
+# dataset → training task (selects loss/metrics; ref trainer selection by
+# dataset, FedAvgAPI.py:33-39).
+TASKS = {
+    "mnist": "classification",
+    "femnist": "classification",
+    "femnist_synth": "classification",
+    "shakespeare": "classification",  # next-char from 80-char window
+    "fed_shakespeare": "nwp",
+    "fed_cifar100": "classification",
+    "cifar10": "classification",
+    "cifar100": "classification",
+    "cinic10": "classification",
+    "stackoverflow_lr": "tag",
+    "stackoverflow_nwp": "nwp",
+    "synthetic": "classification",
+}
+
+
+def task_for_dataset(name: str) -> str:
+    base = name.lower()
+    if base.startswith("synthetic"):
+        return "classification"
+    return TASKS.get(base, "classification")
 
 
 def load(config) -> FederatedDataset:
     """``config`` is a RunConfig (uses .data.* and .fed.client_num_in_total)."""
     d = config.data
     name = d.dataset.lower()
+    n_clients = config.fed.client_num_in_total
+
     if name == "synthetic":
         from fedml_tpu.data.synthetic import synthetic_classification
 
         return synthetic_classification(
-            num_clients=config.fed.client_num_in_total,
+            num_clients=n_clients,
             partition_method=d.partition_method,
             partition_alpha=d.partition_alpha,
             seed=config.seed,
@@ -27,11 +53,45 @@ def load(config) -> FederatedDataset:
         parts = name.split("_")
         alpha, beta = float(parts[1]), float(parts[2])
         return synthetic_fedprox(
-            alpha=alpha,
-            beta=beta,
-            num_clients=config.fed.client_num_in_total,
+            alpha=alpha, beta=beta, num_clients=n_clients, seed=config.seed
+        )
+    if name == "femnist_synth":
+        from fedml_tpu.data.femnist_synth import femnist_synthetic
+
+        return femnist_synthetic(num_clients=n_clients, seed=config.seed)
+    if name in _FILE_LOADERS:
+        import importlib
+
+        mod_name, fn_name = _FILE_LOADERS[name]
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        return fn(d.data_dir, max_clients=n_clients or None)
+    if name in ("cifar10", "cifar100", "cinic10"):
+        from fedml_tpu.data.cifar import load_cifar_family
+
+        return load_cifar_family(
+            name,
+            d.data_dir,
+            num_clients=n_clients,
+            partition_method=d.partition_method,
+            partition_alpha=d.partition_alpha,
             seed=config.seed,
         )
-    raise KeyError(
-        f"unknown dataset {d.dataset!r}; available: synthetic, synthetic_<a>_<b>"
+    available = ", ".join(
+        ["synthetic", "synthetic_<a>_<b>", "femnist_synth"]
+        + sorted(_FILE_LOADERS)
+        + ["cifar10", "cifar100", "cinic10"]
     )
+    raise KeyError(f"unknown dataset {d.dataset!r}; available: {available}")
+
+
+# datasets loaded from files on disk: name -> (module, loader fn); every
+# loader takes (data_dir, max_clients=...).
+_FILE_LOADERS = {
+    "mnist": ("fedml_tpu.data.leaf", "load_mnist"),
+    "femnist": ("fedml_tpu.data.tff_h5", "load_femnist"),
+    "shakespeare": ("fedml_tpu.data.leaf", "load_shakespeare"),
+    "fed_shakespeare": ("fedml_tpu.data.tff_h5", "load_fed_shakespeare"),
+    "fed_cifar100": ("fedml_tpu.data.tff_h5", "load_fed_cifar100"),
+    "stackoverflow_lr": ("fedml_tpu.data.stackoverflow", "load_stackoverflow_lr"),
+    "stackoverflow_nwp": ("fedml_tpu.data.stackoverflow", "load_stackoverflow_nwp"),
+}

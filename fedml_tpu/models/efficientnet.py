@@ -1,0 +1,136 @@
+"""EfficientNet (ref: fedml_api/model/cv/efficientnet.py:138 +
+efficientnet_utils.py — the reference vendors the standard EfficientNet;
+`EfficientNet()` defaults to B0 in fedml_experiments/base.py:128-129).
+
+Standard MBConv inverted-bottleneck with squeeze-excite and swish (SiLU);
+width/depth coefficients select B0..B7. Stochastic depth (drop-connect) is
+applied per-block under the `dropout` rng when training."""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _round_filters(filters: int, width: float, divisor: int = 8) -> int:
+    filters *= width
+    new = max(divisor, int(filters + divisor / 2) // divisor * divisor)
+    if new < 0.9 * filters:
+        new += divisor
+    return int(new)
+
+
+def _round_repeats(repeats: int, depth: float) -> int:
+    return int(math.ceil(depth * repeats))
+
+
+def _bn(train, name):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+
+
+class MBConv(nn.Module):
+    out_ch: int
+    expand: int
+    kernel: int
+    stride: int
+    se_ratio: float = 0.25
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        h = x
+        mid = in_ch * self.expand
+        if self.expand != 1:
+            h = nn.Conv(mid, (1, 1), use_bias=False, name="expand")(h)
+            h = nn.silu(_bn(train, "bn_expand")(h))
+        h = nn.Conv(
+            mid,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=mid,
+            use_bias=False,
+            name="depthwise",
+        )(h)
+        h = nn.silu(_bn(train, "bn_dw")(h))
+        # squeeze-excite
+        se_ch = max(1, int(in_ch * self.se_ratio))
+        s = jnp.mean(h, axis=(1, 2))
+        s = nn.silu(nn.Dense(se_ch, name="se_reduce")(s))
+        s = nn.sigmoid(nn.Dense(mid, name="se_expand")(s))
+        h = h * s[:, None, None, :]
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False, name="project")(h)
+        h = _bn(train, "bn_project")(h)
+        if self.stride == 1 and in_ch == self.out_ch:
+            if train and self.drop_rate > 0.0:
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(rng, keep, (h.shape[0], 1, 1, 1))
+                h = h * mask / keep
+            h = h + x
+        return h
+
+
+# (expand, out, repeats, stride, kernel) — B0 stage table.
+_B0_STAGES: Tuple = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+_COEFFS = {  # name -> (width, depth, dropout)
+    "b0": (1.0, 1.0, 0.2),
+    "b1": (1.0, 1.1, 0.2),
+    "b2": (1.1, 1.2, 0.3),
+    "b3": (1.2, 1.4, 0.3),
+    "b4": (1.4, 1.8, 0.4),
+    "b5": (1.6, 2.2, 0.4),
+    "b6": (1.8, 2.6, 0.5),
+    "b7": (2.0, 3.1, 0.5),
+}
+
+
+class EfficientNet(nn.Module):
+    num_classes: int = 1000
+    variant: str = "b0"
+    drop_connect_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        width, depth, dropout = _COEFFS[self.variant]
+        h = nn.Conv(
+            _round_filters(32, width),
+            (3, 3),
+            strides=(2, 2),
+            padding="SAME",
+            use_bias=False,
+            name="stem",
+        )(x)
+        h = nn.silu(_bn(train, "stem_bn")(h))
+        total_blocks = sum(_round_repeats(r, depth) for _, _, r, _, _ in _B0_STAGES)
+        bi = 0
+        for si, (expand, out, repeats, stride, kernel) in enumerate(_B0_STAGES):
+            for r in range(_round_repeats(repeats, depth)):
+                h = MBConv(
+                    _round_filters(out, width),
+                    expand,
+                    kernel,
+                    stride if r == 0 else 1,
+                    drop_rate=self.drop_connect_rate * bi / total_blocks,
+                    name=f"stage{si}_block{r}",
+                )(h, train=train)
+                bi += 1
+        h = nn.Conv(_round_filters(1280, width), (1, 1), use_bias=False, name="head")(h)
+        h = nn.silu(_bn(train, "head_bn")(h))
+        h = jnp.mean(h, axis=(1, 2))
+        h = nn.Dropout(dropout, deterministic=not train)(h)
+        return nn.Dense(self.num_classes, name="fc")(h)

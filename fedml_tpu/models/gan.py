@@ -1,0 +1,54 @@
+"""MNIST GAN — generator + discriminator MLPs (ref:
+fedml_api/model/cv/mnistgan.py:4-55, used by fedgan).
+
+Same widths as the reference: G: 100→128→256(BN)→512(BN)→1024(BN)→784 tanh;
+D: 784→512→256→1 sigmoid-logit (we return the raw logit and fold the sigmoid
+into the BCE loss — numerically safer than the reference's nn.Sigmoid +
+BCELoss)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    input_size: int = 100
+    out_pixels: int = 784
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        bn = lambda name: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, name=name
+        )
+        h = nn.leaky_relu(nn.Dense(128, name="fc1")(z), 0.2)
+        h = nn.leaky_relu(bn("bn2")(nn.Dense(256, name="fc2")(h)), 0.2)
+        h = nn.leaky_relu(bn("bn3")(nn.Dense(512, name="fc3")(h)), 0.2)
+        h = nn.leaky_relu(bn("bn4")(nn.Dense(1024, name="fc4")(h)), 0.2)
+        h = jnp.tanh(nn.Dense(self.out_pixels, name="fc5")(h))
+        return h.reshape((z.shape[0], 28, 28, 1))
+
+
+class Discriminator(nn.Module):
+    input_size: int = 784
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        h = nn.leaky_relu(nn.Dense(512, name="fc1")(h), 0.2)
+        h = nn.leaky_relu(nn.Dense(256, name="fc2")(h), 0.2)
+        return nn.Dense(1, name="fc3")(h)  # logit
+
+
+class MNISTGan(nn.Module):
+    """G+D container so FedAvg can average both nets' params as one tree
+    (ref MNISTGan module holding netg/netd, mnistgan.py:55+)."""
+
+    @nn.compact
+    def __call__(self, z, x_real=None, train: bool = False):
+        g = Generator(name="netg")
+        d = Discriminator(name="netd")
+        fake = g(z, train=train)
+        d_fake = d(fake, train=train)
+        d_real = d(x_real, train=train) if x_real is not None else None
+        return fake, d_fake, d_real

@@ -1,0 +1,178 @@
+"""MobileNet v1 and MobileNetV3 (ref: fedml_api/model/cv/mobilenet.py:60-195,
+mobilenet_v3.py:137+; cross-silo CIFAR/CINIC benchmark rows of BASELINE.md).
+
+V1 follows the reference's CIFAR layout (stride-1 stem, BN after every conv,
+depthwise-separable blocks 64→128×2→256×2→512×6→1024×2, width multiplier α).
+V3 implements the standard LARGE configuration (the reference's model_mode
+default, fedml_experiments/base.py:126-127) with hard-swish/hard-sigmoid and
+squeeze-excite. Depthwise convs use flax feature_group_count — XLA lowers
+them to TPU depthwise convolutions."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _bn(train, name):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+
+
+class DepthSeparableConv(nn.Module):
+    out_ch: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        h = nn.Conv(
+            in_ch,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=in_ch,
+            use_bias=False,
+            name="depthwise",
+        )(x)
+        h = nn.relu(_bn(train, "bn_dw")(h))
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False, name="pointwise")(h)
+        return nn.relu(_bn(train, "bn_pw")(h))
+
+
+class MobileNet(nn.Module):
+    num_classes: int = 100
+    width_multiplier: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.width_multiplier
+        ch = lambda c: int(c * a)
+        h = nn.Conv(ch(32), (3, 3), padding="SAME", use_bias=False, name="stem")(x)
+        h = nn.relu(_bn(train, "stem_bn")(h))
+        plan: Sequence[Tuple[int, int]] = [
+            (64, 1),
+            (128, 2), (128, 1),
+            (256, 2), (256, 1),
+            (512, 2), (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+            (1024, 2), (1024, 1),
+        ]
+        for i, (c, s) in enumerate(plan):
+            h = DepthSeparableConv(ch(c), stride=s, name=f"ds{i}")(h, train=train)
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="fc")(h)
+
+
+def hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def hard_swish(x):
+    return x * hard_sigmoid(x)
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(1, c // self.reduce), name="fc1")(s))
+        s = hard_sigmoid(nn.Dense(c, name="fc2")(s))
+        return x * s[:, None, None, :]
+
+
+class MBConvV3(nn.Module):
+    exp_ch: int
+    out_ch: int
+    kernel: int
+    stride: int
+    use_se: bool
+    use_hs: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = hard_swish if self.use_hs else nn.relu
+        in_ch = x.shape[-1]
+        h = x
+        if self.exp_ch != in_ch:
+            h = nn.Conv(self.exp_ch, (1, 1), use_bias=False, name="expand")(h)
+            h = act(_bn(train, "bn_expand")(h))
+        h = nn.Conv(
+            self.exp_ch,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=self.exp_ch,
+            use_bias=False,
+            name="depthwise",
+        )(h)
+        h = act(_bn(train, "bn_dw")(h))
+        if self.use_se:
+            h = SqueezeExcite(name="se")(h)
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False, name="project")(h)
+        h = _bn(train, "bn_project")(h)
+        if self.stride == 1 and in_ch == self.out_ch:
+            h = h + x
+        return h
+
+
+# (kernel, expansion, out, SE, HS, stride) — MobileNetV3-LARGE table.
+_V3_LARGE = [
+    (3, 16, 16, False, False, 1),
+    (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1),
+    (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1),
+    (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2),
+    (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1),
+    (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2),
+    (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1),
+]
+
+# MobileNetV3-SMALL table.
+_V3_SMALL = [
+    (3, 16, 16, True, False, 2),
+    (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1),
+    (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1),
+    (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1),
+    (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2),
+    (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1),
+]
+
+
+class MobileNetV3(nn.Module):
+    num_classes: int = 1000
+    model_mode: str = "LARGE"  # ref mobilenet_v3.py model_mode arg
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        table = _V3_LARGE if self.model_mode.upper() == "LARGE" else _V3_SMALL
+        h = nn.Conv(
+            16, (3, 3), strides=(2, 2), padding="SAME", use_bias=False, name="stem"
+        )(x)
+        h = hard_swish(_bn(train, "stem_bn")(h))
+        for i, (k, exp, out, se, hs, s) in enumerate(table):
+            h = MBConvV3(exp, out, k, s, se, hs, name=f"block{i}")(h, train=train)
+        last_exp = 960 if self.model_mode.upper() == "LARGE" else 576
+        head = 1280 if self.model_mode.upper() == "LARGE" else 1024
+        h = nn.Conv(last_exp, (1, 1), use_bias=False, name="head_conv")(h)
+        h = hard_swish(_bn(train, "head_bn")(h))
+        h = jnp.mean(h, axis=(1, 2))
+        h = hard_swish(nn.Dense(head, name="head_fc")(h))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return nn.Dense(self.num_classes, name="fc")(h)

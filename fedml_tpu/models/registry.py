@@ -1,5 +1,6 @@
 """Model registry: model-name × dataset → ModelDef
-(ref fedml_experiments/base.py:103-140 create_model dispatch)."""
+(ref fedml_experiments/base.py:103-140 create_model dispatch; MODELS tuple at
+base.py:18-26)."""
 
 from __future__ import annotations
 
@@ -18,34 +19,111 @@ def create(
     **kw,
 ) -> ModelDef:
     name = model_name.lower()
+    ds = (dataset_name or "").lower()
+
     if name == "lr":
         from fedml_tpu.models.linear import LogisticRegression
 
         return ModelDef(
             LogisticRegression(num_classes=num_classes),
-            input_shape,
-            num_classes,
-            name="lr",
+            input_shape, num_classes, name="lr",
         )
+
     if name == "cnn":
+        # ref base.py:110-111 builds CNNDropOut for femnist under the name
+        # "cnn"; we expose the original-FedAvg CNN as "cnn" and the dropout
+        # variant as "cnn_dropout" (both in the reference's model zoo).
         from fedml_tpu.models.cnn import CNNOriginalFedAvg
 
         return ModelDef(
             CNNOriginalFedAvg(num_classes=num_classes),
-            input_shape,
-            num_classes,
-            name="cnn",
+            input_shape, num_classes, name="cnn",
         )
+
     if name == "cnn_dropout":
         from fedml_tpu.models.cnn import CNNDropOut
 
         return ModelDef(
             CNNDropOut(num_classes=num_classes),
-            input_shape,
-            num_classes,
-            has_dropout=True,
-            name="cnn_dropout",
+            input_shape, num_classes, has_dropout=True, name="cnn_dropout",
         )
+
+    if name == "rnn":
+        # dataset selects the variant (ref base.py:108-120).
+        if ds in ("stackoverflow_nwp", "stackoverflow"):
+            from fedml_tpu.models.rnn import RNNStackOverFlow
+
+            m = RNNStackOverFlow(**kw)
+            ext = m.vocab_size + 3 + m.num_oov_buckets
+            return ModelDef(
+                m, input_shape, ext, input_dtype=jnp.int32, name="rnn_stackoverflow",
+            )
+        from fedml_tpu.models.rnn import RNNOriginalFedAvg
+
+        seq_output = ds == "fed_shakespeare"
+        m = RNNOriginalFedAvg(seq_output=seq_output, **kw)
+        return ModelDef(
+            m, input_shape, m.vocab_size, input_dtype=jnp.int32, name="rnn",
+        )
+
+    if name in ("resnet56", "resnet110"):
+        from fedml_tpu.models import resnet
+
+        m = getattr(resnet, name)(num_classes)
+        return ModelDef(
+            m, input_shape, num_classes, has_batch_stats=True, name=name,
+        )
+
+    if name in ("resnet18_gn", "resnet34_gn", "resnet50_gn", "resnet101_gn", "resnet152_gn"):
+        from fedml_tpu.models import resnet_gn
+
+        ctor = getattr(resnet_gn, name[: -len("_gn")])
+        cpg = kw.pop("channels_per_group", 2)
+        m = ctor(num_classes, channels_per_group=cpg, **kw)
+        return ModelDef(
+            m, input_shape, num_classes, has_batch_stats=(cpg == 0), name=name,
+        )
+
+    if name == "mobilenet":
+        from fedml_tpu.models.mobilenet import MobileNet
+
+        return ModelDef(
+            MobileNet(num_classes=num_classes, **kw),
+            input_shape, num_classes, has_batch_stats=True, name=name,
+        )
+
+    if name == "mobilenet_v3":
+        from fedml_tpu.models.mobilenet import MobileNetV3
+
+        return ModelDef(
+            MobileNetV3(num_classes=num_classes, **kw),
+            input_shape, num_classes,
+            has_batch_stats=True, has_dropout=True, name=name,
+        )
+
+    if name in ("vgg11", "vgg13", "vgg16", "vgg19",
+                "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"):
+        from fedml_tpu.models import vgg as vgg_mod
+
+        bn = name.endswith("_bn")
+        base = name[:-3] if bn else name
+        m = getattr(vgg_mod, base)(num_classes=num_classes, batch_norm=bn)
+        return ModelDef(
+            m, input_shape, num_classes,
+            has_batch_stats=bn, has_dropout=True, name=name,
+        )
+
+    if name == "efficientnet":
+        from fedml_tpu.models.efficientnet import EfficientNet
+
+        return ModelDef(
+            EfficientNet(num_classes=num_classes, **kw),
+            input_shape, num_classes,
+            has_batch_stats=True, has_dropout=True, name=name,
+        )
+
     raise KeyError(
-        f"unknown model {model_name!r}; available: lr, cnn, cnn_dropout"
+        f"unknown model {model_name!r}; available: lr, cnn, cnn_dropout, rnn, "
+        "resnet56, resnet110, resnet18_gn..resnet152_gn, mobilenet, "
+        "mobilenet_v3, vgg11..vgg19(_bn), efficientnet"
     )

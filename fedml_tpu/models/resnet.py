@@ -1,0 +1,82 @@
+"""CIFAR ResNets with BatchNorm — resnet56/resnet110 (ref:
+fedml_api/model/cv/resnet.py:113-243; cross-silo CIFAR benchmark rows of
+BASELINE.md).
+
+Architecture parity with the reference's CIFAR variant: 3×3 stem (stride 1,
+16 ch), three Bottleneck stages of widths 16/32/64 (expansion 4) with [6,6,6]
+(resnet56) or [12,12,12] (resnet110) blocks, global average pool, linear
+head. NHWC layout for TPU (MXU conv tiling); BatchNorm running stats live in
+the ``batch_stats`` collection and are federated-averaged alongside params
+exactly as the reference averages the full state dict
+(FedAVGAggregator.py:66-71)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, name=name
+        )
+        out_ch = self.planes * self.expansion
+        identity = x
+        h = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
+        h = nn.relu(norm("bn1")(h))
+        h = nn.Conv(
+            self.planes,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            use_bias=False,
+            name="conv2",
+        )(h)
+        h = nn.relu(norm("bn2")(h))
+        h = nn.Conv(out_ch, (1, 1), use_bias=False, name="conv3")(h)
+        h = norm("bn3")(h)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            identity = nn.Conv(
+                out_ch,
+                (1, 1),
+                strides=(self.stride, self.stride),
+                use_bias=False,
+                name="downsample_conv",
+            )(x)
+            identity = norm("downsample_bn")(identity)
+        return nn.relu(h + identity)
+
+
+class CifarResNet(nn.Module):
+    layers: Sequence[int] = (6, 6, 6)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
+        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, name="bn1")(h)
+        h = nn.relu(h)
+        for si, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for bi in range(blocks):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                h = Bottleneck(
+                    planes, stride=stride, name=f"layer{si + 1}_block{bi}"
+                )(h, train=train)
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, name="fc")(h)
+
+
+def resnet56(num_classes: int) -> CifarResNet:
+    return CifarResNet(layers=(6, 6, 6), num_classes=num_classes)
+
+
+def resnet110(num_classes: int) -> CifarResNet:
+    return CifarResNet(layers=(12, 12, 12), num_classes=num_classes)

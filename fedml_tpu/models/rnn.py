@@ -1,0 +1,52 @@
+"""Federated NLP RNNs (ref: fedml_api/model/nlp/rnn.py).
+
+Two models, both straight from the FedAvg / Adaptive-Federated-Optimization
+papers the reference reproduces:
+
+- :class:`RNNOriginalFedAvg` (rnn.py:5-38): embed(90→8) → 2×LSTM(256) →
+  dense(vocab). ``seq_output=False`` predicts from the final hidden state
+  (shakespeare next-char classification); ``True`` emits per-position logits
+  (the fed_shakespeare variant the reference keeps commented at rnn.py:34-36).
+- :class:`RNNStackOverFlow` (rnn.py:40-72): extended vocab (10000+pad/bos/eos/
+  oov), embed 96 → LSTM(670) → dense 96 → dense vocab, per-position logits.
+
+TPU notes: the recurrence is a `lax.scan` via flax's nn.RNN —
+sequence-length-static, MXU-friendly gate matmuls fused per step. Embedding
+lookups are gathers; padding_idx-0 semantics are handled in the loss
+(train/losses.py masked_seq_ce ignores token 0), not the embedding table."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+    seq_output: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim, name="embeddings")(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="lstm_1")(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size), name="lstm_2")(h)
+        if not self.seq_output:
+            h = h[:, -1]
+        return nn.Dense(self.vocab_size, name="fc")(h)
+
+
+class RNNStackOverFlow(nn.Module):
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        extended = self.vocab_size + 3 + self.num_oov_buckets
+        h = nn.Embed(extended, self.embedding_size, name="word_embeddings")(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.latent_size), name="lstm")(h)
+        h = nn.Dense(self.embedding_size, name="fc1")(h)
+        return nn.Dense(extended, name="fc2")(h)  # [B, T, V]

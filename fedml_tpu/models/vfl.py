@@ -1,0 +1,36 @@
+"""Vertical-FL party models (ref: fedml_api/model/finance/
+vfl_models_standalone.py:1-77, vfl_feature_extractor.py, vfl_classifier.py).
+
+The reference wraps tiny torch MLPs in numpy-in/numpy-out shims with their
+own embedded SGD optimizers (an artifact of its manual split-autograd, SURVEY
+§2b classical_vertical_fl). Here they are plain flax modules; the split
+backward lives in the VFL algorithm (algorithms/vertical.py) as jax.vjp —
+no per-model optimizer state."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class VFLFeatureExtractor(nn.Module):
+    """One linear + LeakyReLU — the host/guest bottom model
+    (ref vfl_feature_extractor.py:4-15, LocalModel at
+    vfl_models_standalone.py:38-47)."""
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.leaky_relu(nn.Dense(self.output_dim, name="fc")(x), 0.01)
+
+
+class VFLClassifier(nn.Module):
+    """Single linear head over concatenated/summed party features
+    (ref vfl_classifier.py:4-12, DenseModel at vfl_models_standalone.py:6-14)."""
+
+    output_dim: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.output_dim, use_bias=self.use_bias, name="fc")(x)

@@ -1,0 +1,81 @@
+"""VGG 11/13/16/19 (±BN) — ref: fedml_api/model/cv/vgg.py:12-152.
+
+Same layer tables (cfgs A/B/D/E); classifier head matches the reference's
+4096-4096-classes MLP with dropout. NHWC; adaptive 7×7 pooling is replaced by
+mean-pool-to-7×7-free global layout only when inputs are 224²; for CIFAR-size
+inputs the flatten happens at whatever spatial size remains (the reference
+relies on AdaptiveAvgPool2d((7,7)) — we reproduce it with a resize-mean)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _adaptive_avg_pool(x, out_hw: int = 7):
+    """AdaptiveAvgPool2d((7,7)) equivalent for inputs whose spatial dims are
+    multiples (or equal/smaller)."""
+    B, H, W, C = x.shape
+    if H == out_hw and W == out_hw:
+        return x
+    if H % out_hw == 0 and W % out_hw == 0:
+        kh, kw = H // out_hw, W // out_hw
+        return nn.avg_pool(x, (kh, kw), strides=(kh, kw))
+    # Fallback: global mean broadcast to the target grid.
+    g = jnp.mean(x, axis=(1, 2), keepdims=True)
+    return jnp.broadcast_to(g, (B, out_hw, out_hw, C))
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 1000
+    batch_norm: bool = False
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x
+        ci = 0
+        for v in self.cfg:
+            if v == "M":
+                h = nn.max_pool(h, (2, 2), strides=(2, 2))
+            else:
+                h = nn.Conv(int(v), (3, 3), padding="SAME", name=f"conv{ci}")(h)
+                if self.batch_norm:
+                    h = nn.BatchNorm(
+                        use_running_average=not train, momentum=0.9, name=f"bn{ci}"
+                    )(h)
+                h = nn.relu(h)
+                ci += 1
+        h = _adaptive_avg_pool(h, 7)
+        h = h.reshape((h.shape[0], -1))
+        h = nn.relu(nn.Dense(4096, name="fc1")(h))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = nn.relu(nn.Dense(4096, name="fc2")(h))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return nn.Dense(self.num_classes, name="fc3")(h)
+
+
+def vgg11(num_classes=1000, batch_norm=False):
+    return VGG(cfg=tuple(_CFGS["A"]), num_classes=num_classes, batch_norm=batch_norm)
+
+
+def vgg13(num_classes=1000, batch_norm=False):
+    return VGG(cfg=tuple(_CFGS["B"]), num_classes=num_classes, batch_norm=batch_norm)
+
+
+def vgg16(num_classes=1000, batch_norm=False):
+    return VGG(cfg=tuple(_CFGS["D"]), num_classes=num_classes, batch_norm=batch_norm)
+
+
+def vgg19(num_classes=1000, batch_norm=False):
+    return VGG(cfg=tuple(_CFGS["E"]), num_classes=num_classes, batch_norm=batch_norm)

@@ -1,0 +1,85 @@
+"""Shape/param sanity for the model zoo (ref: the reference's only model test
+is a param/FLOP counter, fedml_api/model/cv/test_cnn.py:1-14 — we check
+init+apply shapes, dtype, and train-mode mutability instead)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models import create_model
+
+CASES = [
+    # (model, dataset, input_shape, num_classes, kw, expected_logits_shape_fn)
+    ("lr", "mnist", (28, 28, 1), 10, {}, lambda B: (B, 10)),
+    ("cnn", "femnist", (28, 28, 1), 62, {}, lambda B: (B, 62)),
+    ("cnn_dropout", "femnist", (28, 28, 1), 62, {}, lambda B: (B, 62)),
+    ("rnn", "shakespeare", (20,), 90, {}, lambda B: (B, 90)),
+    ("rnn", "fed_shakespeare", (20,), 90, {}, lambda B: (B, 20, 90)),
+    ("rnn", "stackoverflow_nwp", (20,), 10004, {}, lambda B: (B, 20, 10004)),
+    ("resnet56", "cifar10", (32, 32, 3), 10, {}, lambda B: (B, 10)),
+    ("resnet18_gn", "fed_cifar100", (24, 24, 3), 100, {}, lambda B: (B, 100)),
+    ("mobilenet", "cifar100", (32, 32, 3), 100, {}, lambda B: (B, 100)),
+    ("mobilenet_v3", "cifar10", (32, 32, 3), 10, {}, lambda B: (B, 10)),
+    ("vgg11", "cifar10", (32, 32, 3), 10, {}, lambda B: (B, 10)),
+    ("vgg16_bn", "cifar10", (32, 32, 3), 10, {}, lambda B: (B, 10)),
+    ("efficientnet", "cifar10", (32, 32, 3), 10, {}, lambda B: (B, 10)),
+]
+
+
+@pytest.mark.parametrize(
+    "name,ds,shape,classes,kw,out_fn",
+    CASES,
+    ids=[f"{c[0]}-{c[1]}" for c in CASES],
+)
+def test_model_shapes(name, ds, shape, classes, kw, out_fn):
+    model = create_model(name, ds, shape, classes, **kw)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng)
+    B = 2
+    if model.input_dtype == jnp.int32:
+        x = jnp.ones((B,) + shape, jnp.int32)
+    else:
+        x = jnp.zeros((B,) + shape, jnp.float32)
+    # eval mode
+    out, vars_eval = model.apply(variables, x, train=False)
+    assert out.shape == out_fn(B)
+    assert np.all(np.isfinite(np.asarray(out)))
+    # train mode must run and (for BN models) mutate batch_stats
+    out_t, vars_train = model.apply(
+        variables, x, train=True, rng=jax.random.fold_in(rng, 1)
+    )
+    assert out_t.shape == out_fn(B)
+    if model.has_batch_stats:
+        assert "batch_stats" in vars_train
+
+
+def test_gan_shapes():
+    from fedml_tpu.models.gan import MNISTGan
+
+    m = MNISTGan()
+    z = jnp.zeros((4, 100))
+    x = jnp.zeros((4, 28, 28, 1))
+    variables = m.init(
+        {"params": jax.random.PRNGKey(0)}, z, x, train=False
+    )
+    fake, d_fake, d_real = m.apply(variables, z, x, train=False)
+    assert fake.shape == (4, 28, 28, 1)
+    assert d_fake.shape == (4, 1) and d_real.shape == (4, 1)
+
+
+def test_vfl_models():
+    from fedml_tpu.models.vfl import VFLClassifier, VFLFeatureExtractor
+
+    fe = VFLFeatureExtractor(output_dim=16)
+    v = fe.init(jax.random.PRNGKey(0), jnp.zeros((3, 30)))
+    feats = fe.apply(v, jnp.zeros((3, 30)))
+    assert feats.shape == (3, 16)
+    clf = VFLClassifier(output_dim=2)
+    vc = clf.init(jax.random.PRNGKey(1), feats)
+    assert clf.apply(vc, feats).shape == (3, 2)
+
+
+def test_registry_unknown_raises():
+    with pytest.raises(KeyError):
+        create_model("nope", "mnist", (1,), 2)
