@@ -1,0 +1,3 @@
+from fedml_tpu.cli import main
+
+main()

@@ -1,0 +1,182 @@
+"""Unified CLI — the L5 experiment-driver layer (ref:
+fedml_experiments/distributed/fedavg/main_fedavg.py:24-131 click flags +
+fed_launch/main.py unified launcher + the 19 main_*.py drivers).
+
+One command covers what the reference spreads over 19 drivers: flag surface
+mirrors main_fedavg.py:24-57 (model/dataset/partition/optimizer/round flags),
+`--algorithm` replaces the per-algorithm driver files, and `--runtime`
+replaces `--backend MPI|GRPC|MQTT|TRPC` with the TPU-native choices:
+``vmap`` (single-chip simulator, ref standalone/*), ``mesh`` (sharded
+multi-chip SPMD, ref distributed/* over MPI), ``loopback`` (threaded
+actor federation, transport parity path). GPU-mapping YAML flags become
+`--client_shards` (mesh spec, SURVEY §5 config point)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import click
+
+from fedml_tpu.config import (
+    DataConfig,
+    FedConfig,
+    MeshConfig,
+    RunConfig,
+    ServerConfig,
+    TrainConfig,
+)
+
+ALGORITHMS = ("fedavg", "fedopt", "fedprox", "fednova", "hierarchical", "fedavg_robust")
+RUNTIMES = ("vmap", "mesh", "loopback")
+
+
+@click.command()
+@click.option("--model", default="lr", help="Model name (models/registry.py)")
+@click.option("--dataset", "dataset_name", default="synthetic", help="Dataset name (data/registry.py)")
+@click.option("--data_dir", type=click.Path(path_type=Path), default=Path("./data"))
+@click.option("--partition_method", type=click.Choice(("hetero", "homo", "hetero-fix")), default="hetero")
+@click.option("--partition_alpha", type=float, default=0.5)
+@click.option("--client_num_in_total", type=int, default=10)
+@click.option("--client_num_per_round", type=int, default=10)
+@click.option("--batch_size", type=int, default=32, help="-1 = full batch")
+@click.option("--client_optimizer", type=click.Choice(("sgd", "adam")), default="sgd")
+@click.option("--lr", type=float, default=0.03)
+@click.option("--wd", type=float, default=0.0)
+@click.option("--momentum", type=float, default=0.0)
+@click.option("--epochs", type=int, default=1)
+@click.option("--comm_round", type=int, default=10)
+@click.option("--frequency_of_the_test", type=int, default=1)
+@click.option("--algorithm", type=click.Choice(ALGORITHMS), default="fedavg")
+@click.option("--runtime", type=click.Choice(RUNTIMES), default="vmap")
+@click.option("--client_shards", type=int, default=None, help="Mesh shards (runtime=mesh); default all devices")
+@click.option("--server_optimizer", default="sgd", help="FedOpt server optimizer")
+@click.option("--server_lr", type=float, default=1.0)
+@click.option("--server_momentum", type=float, default=0.0)
+@click.option("--prox_mu", type=float, default=0.01, help="FedProx proximal term (algorithm=fedprox)")
+@click.option("--group_num", type=int, default=2, help="hierarchical: number of groups")
+@click.option("--group_comm_round", type=int, default=1)
+@click.option("--seed", type=int, default=0)
+@click.option("--log_dir", type=click.Path(path_type=Path), default=None)
+@click.option("--checkpoint_path", type=click.Path(path_type=Path), default=None,
+              help="Save (params, round, rng) here every test round")
+@click.option("--ci", is_flag=True, default=False, help="CI short-circuit (1 round smoke)")
+def main(**opt):
+    """Train a federated model on TPU."""
+    run(**opt)
+
+
+def build_config(opt) -> RunConfig:
+    return RunConfig(
+        data=DataConfig(
+            dataset=opt["dataset_name"],
+            data_dir=str(opt["data_dir"]),
+            partition_method=opt["partition_method"],
+            partition_alpha=opt["partition_alpha"],
+            batch_size=opt["batch_size"],
+        ),
+        fed=FedConfig(
+            client_num_in_total=opt["client_num_in_total"],
+            client_num_per_round=opt["client_num_per_round"],
+            comm_round=1 if opt["ci"] else opt["comm_round"],
+            epochs=opt["epochs"],
+            frequency_of_the_test=opt["frequency_of_the_test"],
+            ci=opt["ci"],
+            group_num=opt["group_num"],
+            group_comm_round=opt["group_comm_round"],
+        ),
+        train=TrainConfig(
+            client_optimizer=opt["client_optimizer"],
+            lr=opt["lr"],
+            wd=opt["wd"],
+            momentum=opt["momentum"],
+            prox_mu=opt["prox_mu"] if opt["algorithm"] == "fedprox" else 0.0,
+        ),
+        server=ServerConfig(
+            server_optimizer=opt["server_optimizer"],
+            server_lr=opt["server_lr"],
+            server_momentum=opt["server_momentum"],
+        ),
+        mesh=MeshConfig(client_shards=opt["client_shards"]),
+        model=opt["model"],
+        seed=opt["seed"],
+    )
+
+
+def run(**opt):
+    from fedml_tpu.data import registry as data_registry
+    from fedml_tpu.models import create_model
+    from fedml_tpu.utils import MetricsLogger, save_checkpoint
+
+    config = build_config(opt)
+    data = data_registry.load(config)
+    task = data_registry.task_for_dataset(config.data.dataset)
+    sample_shape = tuple(data.client_x[0].shape[1:])
+    model = create_model(config.model, config.data.dataset, sample_shape, data.num_classes)
+
+    logger = MetricsLogger(str(opt["log_dir"]) if opt["log_dir"] else None)
+    api = _build_api(opt["algorithm"], opt["runtime"], config, data, model, task, logger)
+
+    final = api.train()
+    if opt["checkpoint_path"]:
+        save_checkpoint(
+            str(opt["checkpoint_path"]),
+            getattr(api, "global_vars"),
+            round_idx=config.fed.comm_round,
+        )
+    logger.close()
+    click.echo(json.dumps({k: v for k, v in (final or {}).items()}))
+    return api
+
+
+def _build_api(algorithm, runtime, config, data, model, task, logger):
+    log_fn = logger.log
+    if runtime == "loopback":
+        if algorithm != "fedavg":
+            raise click.UsageError("runtime=loopback currently supports algorithm=fedavg")
+        from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+
+        class _Runner:
+            global_vars = None
+
+            def train(self):
+                server = run_loopback_federation(config, data, model, task=task, log_fn=log_fn)
+                _Runner.global_vars = server.global_vars
+                self.global_vars = server.global_vars
+                return server.history[-1] if server.history else {}
+
+        return _Runner()
+
+    if runtime == "mesh":
+        from fedml_tpu.parallel import DistributedFedAvgAPI
+
+        if algorithm not in ("fedavg", "fedprox"):
+            raise click.UsageError("runtime=mesh currently supports fedavg/fedprox")
+        return DistributedFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
+
+    # vmap simulator runtimes (ref standalone/*)
+    if algorithm in ("fedavg", "fedprox"):
+        from fedml_tpu.algorithms import FedAvgAPI
+
+        return FedAvgAPI(config, data, model, task=task, log_fn=log_fn)
+    if algorithm == "fedopt":
+        from fedml_tpu.algorithms import FedOptAPI
+
+        return FedOptAPI(config, data, model, task=task, log_fn=log_fn)
+    if algorithm == "fednova":
+        from fedml_tpu.algorithms import FedNovaAPI
+
+        return FedNovaAPI(config, data, model, task=task, log_fn=log_fn)
+    if algorithm == "hierarchical":
+        from fedml_tpu.algorithms import HierarchicalFedAvgAPI
+
+        return HierarchicalFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
+    if algorithm == "fedavg_robust":
+        from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
+
+        return RobustFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
+    raise click.UsageError(f"unknown algorithm {algorithm}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Cross-silo / edge transport runtime — the one place the reference's
+Message/Observer actor architecture survives (SURVEY §2h design point (b)).
+
+Intra-pod "distributed FL" is a sharded jit program (fedml_tpu.parallel);
+this package exists for TRUE federation: independent hosts/silos that cannot
+share a mesh. It mirrors the reference's fedml_core/distributed/ layer —
+Message envelope, Observer, pluggable comm managers (loopback for tests,
+gRPC for cross-host), ClientManager/ServerManager actor loops — with one
+deliberate break: tensors travel as dtype-preserved binary buffers, never
+JSON lists (the reference's message.py:47-59,76-79 round-trips every tensor
+through Python lists — its #1 performance sin, SURVEY §2h)."""
+
+from fedml_tpu.core.message import Message, MessageType
+from fedml_tpu.core.comm import BaseCommManager, Observer
+from fedml_tpu.core.loopback import LoopbackHub, LoopbackCommManager
+from fedml_tpu.core.managers import ClientManager, ServerManager
+
+__all__ = [
+    "Message",
+    "MessageType",
+    "BaseCommManager",
+    "Observer",
+    "LoopbackHub",
+    "LoopbackCommManager",
+    "ClientManager",
+    "ServerManager",
+]

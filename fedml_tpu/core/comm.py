@@ -1,0 +1,41 @@
+"""Backend-agnostic communication interface (ref:
+fedml_core/distributed/communication/base_com_manager.py:7-27 +
+observer.py:4-7). Same Observer contract so every backend — loopback, gRPC,
+or a future MQTT bridge — slots in identically."""
+
+from __future__ import annotations
+
+import abc
+from typing import List
+
+from fedml_tpu.core.message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: str, msg: Message) -> None: ...
+
+
+class BaseCommManager(abc.ABC):
+    def __init__(self):
+        self._observers: List[Observer] = []
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None: ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Enter the receive loop (blocks until stopped)."""
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None: ...

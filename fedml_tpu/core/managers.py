@@ -1,0 +1,59 @@
+"""Actor runtime: ClientManager/ServerManager (ref:
+fedml_core/distributed/{client/client_manager.py:14-77,
+server/server_manager.py:12-60}).
+
+Same shape as the reference: construct/receive a comm manager, register as
+Observer, keep a msg_type → handler registry, run() = enter receive loop.
+Deliberate non-ports (SURVEY §7 parity checklist): no MPI.Abort as normal
+termination (client_manager.py:69-77) — finish() stops the receive loop
+cleanly; no 0.3 s poll loop — backends block on their queues."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from fedml_tpu.core.comm import BaseCommManager, Observer
+from fedml_tpu.core.message import Message
+
+
+class _ManagerBase(Observer):
+    def __init__(self, comm: BaseCommManager, rank: int):
+        self.comm = comm
+        self.rank = rank
+        self._handlers: Dict[str, Callable[[Message], None]] = {}
+        comm.add_observer(self)
+
+    def register_message_receive_handler(
+        self, msg_type: str, handler: Callable[[Message], None]
+    ) -> None:
+        self._handlers[msg_type] = handler
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses wire their handlers here (ref abstract at
+        client_manager.py:63-64)."""
+
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            raise KeyError(
+                f"rank {self.rank}: no handler for message type {msg_type!r}"
+            )
+        handler(msg)
+
+    def send_message(self, msg: Message) -> None:
+        self.comm.send_message(msg)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.comm.handle_receive_message()
+
+    def finish(self) -> None:
+        self.comm.stop_receive_message()
+
+
+class ClientManager(_ManagerBase):
+    """ref client_manager.py:14-77."""
+
+
+class ServerManager(_ManagerBase):
+    """ref server_manager.py:12-60."""

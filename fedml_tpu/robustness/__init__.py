@@ -1,0 +1,13 @@
+from fedml_tpu.robustness.robust_aggregation import (
+    RobustConfig,
+    norm_diff_clip_tree,
+    add_gaussian_noise,
+    tree_weight_norm,
+)
+
+__all__ = [
+    "RobustConfig",
+    "norm_diff_clip_tree",
+    "add_gaussian_noise",
+    "tree_weight_norm",
+]

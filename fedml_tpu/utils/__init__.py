@@ -1,0 +1,12 @@
+"""Cross-cutting utilities: checkpoint/resume, metrics logging
+(ref fedml_api/utils/ + the per-algorithm Saver/wandb call sites,
+SURVEY §5)."""
+
+from fedml_tpu.utils.checkpoint import (
+    save_checkpoint,
+    load_checkpoint,
+    restore_like,
+)
+from fedml_tpu.utils.metrics import MetricsLogger
+
+__all__ = ["save_checkpoint", "load_checkpoint", "restore_like", "MetricsLogger"]

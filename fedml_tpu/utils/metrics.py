@@ -1,0 +1,63 @@
+"""Metrics logging — wandb-compatible names without the wandb dependency
+(ref SURVEY §5: wandb is the reference's metrics backbone; rank-0-only
+wandb.init at main_fedavg.py:93-108, wandb.log of Train/Acc, Train/Loss,
+Test/Acc, Test/Loss, round from 20+ call sites; CI reads
+wandb-summary.json as its oracle, CI-script-fedavg.sh:44).
+
+MetricsLogger keeps the same metric-name schema, appends JSONL rows, and
+maintains a ``summary`` (last value per key) written as summary.json — the
+drop-in analog of wandb-summary.json, so the reference's
+read-summary-and-assert CI pattern ports directly. If wandb is importable
+and a run is active, rows are forwarded."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: Optional[str] = None, use_wandb: bool = False):
+        self.log_dir = log_dir
+        self.summary: Dict[str, float] = {}
+        self.history = []
+        self._fh = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._fh = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb if wandb.run is not None else None
+            except ImportError:
+                self._wandb = None
+
+    def log(self, row: Dict) -> None:
+        row = dict(row)
+        row.setdefault("_ts", time.time())
+        self.history.append(row)
+        self.summary.update(
+            {k: v for k, v in row.items() if not k.startswith("_")}
+        )
+        if self._fh:
+            self._fh.write(json.dumps(row) + "\n")
+            self._fh.flush()
+            with open(os.path.join(self.log_dir, "summary.json"), "w") as f:
+                json.dump(self.summary, f)
+        if self._wandb:
+            self._wandb.log({k: v for k, v in row.items() if not k.startswith("_")})
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,192 @@
+"""Aux subsystems: checkpoint round-trip, metrics logger summary (the CI
+oracle surface), topology managers, robust aggregation, robust-FedAvg
+no-defense equivalence, and the CLI end-to-end."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import ModelDef
+from fedml_tpu.models.linear import LogisticRegression
+
+
+def _data(n=6):
+    return synthetic_classification(
+        num_clients=n, num_classes=4, feat_shape=(5,), samples_per_client=16,
+        partition_method="homo", seed=2,
+    )
+
+
+def _model():
+    return ModelDef(
+        module=LogisticRegression(num_classes=4), input_shape=(5,), num_classes=4, name="lr"
+    )
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from fedml_tpu.utils import load_checkpoint, restore_like, save_checkpoint
+
+    params = {"params": {"dense": {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.zeros(3, np.float32)}}}
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params["params"])
+    rng = jax.random.PRNGKey(7)
+    p = str(tmp_path / "ckpt")
+    save_checkpoint(p, params, round_idx=5, rng=np.asarray(rng), server_opt_state=opt_state)
+    vars2, round_idx, rng2, opt2_raw = load_checkpoint(p)
+    assert round_idx == 5
+    np.testing.assert_array_equal(np.asarray(rng), rng2)
+    np.testing.assert_array_equal(
+        vars2["params"]["dense"]["w"], params["params"]["dense"]["w"]
+    )
+    opt2 = restore_like(opt_state, opt2_raw)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(opt_state), jax.tree_util.tree_leaves(opt2)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_metrics_logger_summary(tmp_path):
+    from fedml_tpu.utils import MetricsLogger
+
+    with MetricsLogger(str(tmp_path)) as ml:
+        ml.log({"round": 0, "Train/Acc": 0.5})
+        ml.log({"round": 1, "Train/Acc": 0.7, "Test/Acc": 0.6})
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    # wandb-summary.json semantics: last value per key (ref CI oracle,
+    # CI-script-fedavg.sh:44)
+    assert summary["Train/Acc"] == 0.7
+    assert summary["round"] == 1
+    lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+
+
+def test_symmetric_topology_rows_stochastic():
+    from fedml_tpu.partition.topology import SymmetricTopologyManager
+
+    t = SymmetricTopologyManager(8, neighbor_num=4)
+    t.generate_topology()
+    W = t.topology
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(8), atol=1e-6)
+    assert (np.diag(W) > 0).all()
+    # symmetric support
+    assert ((W > 0) == (W.T > 0)).all()
+    assert 1 in t.get_out_neighbor_idx_list(0)
+
+
+def test_asymmetric_topology_rows_stochastic():
+    from fedml_tpu.partition.topology import AsymmetricTopologyManager
+
+    t = AsymmetricTopologyManager(8, undirected_neighbor_num=4, seed=1)
+    t.generate_topology()
+    np.testing.assert_allclose(t.topology.sum(axis=1), np.ones(8), atol=1e-6)
+
+
+def test_norm_clip_tree():
+    from fedml_tpu.robustness import norm_diff_clip_tree, tree_weight_norm
+
+    g = {"params": {"w": jnp.zeros(4)}}
+    l = {"params": {"w": jnp.full(4, 10.0)}}
+    clipped = norm_diff_clip_tree(l, g, norm_bound=1.0)
+    # diff norm 20 -> scaled to norm 1
+    np.testing.assert_allclose(
+        float(tree_weight_norm(clipped, g)), 1.0, rtol=1e-5
+    )
+    # under the bound: unchanged
+    l2 = {"params": {"w": jnp.full(4, 0.1)}}
+    c2 = norm_diff_clip_tree(l2, g, norm_bound=5.0)
+    np.testing.assert_allclose(np.asarray(c2["params"]["w"]), 0.1, rtol=1e-6)
+
+
+def test_robust_fedavg_no_defense_equals_fedavg():
+    from fedml_tpu.algorithms import FedAvgAPI
+    from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
+    from fedml_tpu.robustness import RobustConfig
+
+    data = _data()
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(client_num_in_total=6, client_num_per_round=6, comm_round=2, epochs=1, frequency_of_the_test=2),
+        train=TrainConfig(lr=0.1),
+        seed=4,
+    )
+    plain = FedAvgAPI(cfg, data, _model())
+    plain.train()
+    # huge bound + no noise => identical to FedAvg
+    rob = RobustFedAvgAPI(cfg, data, _model(), robust=RobustConfig(defense_type="norm_diff_clipping", norm_bound=1e9))
+    rob.train()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain.global_vars),
+        jax.tree_util.tree_leaves(rob.global_vars),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_robust_fedavg_weak_dp_runs():
+    from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
+    from fedml_tpu.robustness import RobustConfig
+
+    data = _data()
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(client_num_in_total=6, client_num_per_round=3, comm_round=2, epochs=1, frequency_of_the_test=2),
+        train=TrainConfig(lr=0.1),
+    )
+    api = RobustFedAvgAPI(
+        cfg, data, _model(), robust=RobustConfig(defense_type="weak_dp", norm_bound=5.0, stddev=0.01)
+    )
+    final = api.train()
+    assert np.isfinite(final["Test/Loss"])
+
+
+def test_cli_end_to_end(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    result = CliRunner().invoke(
+        main,
+        [
+            "--dataset", "synthetic",
+            "--model", "lr",
+            "--client_num_in_total", "6",
+            "--client_num_per_round", "3",
+            "--comm_round", "2",
+            "--batch_size", "8",
+            "--lr", "0.1",
+            "--log_dir", str(tmp_path / "logs"),
+            "--checkpoint_path", str(tmp_path / "ckpt"),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    out = json.loads(result.output.strip().splitlines()[-1])
+    assert "Test/Acc" in out
+    assert (tmp_path / "logs" / "summary.json").exists()
+    assert (tmp_path / "ckpt.npz").exists()
+
+
+def test_cli_fedopt_and_hierarchical(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    for extra in (
+        ["--algorithm", "fedopt", "--server_optimizer", "adam", "--server_lr", "0.05"],
+        ["--algorithm", "hierarchical", "--group_num", "2"],
+        ["--algorithm", "fedprox", "--prox_mu", "0.1"],
+    ):
+        result = CliRunner().invoke(
+            main,
+            [
+                "--dataset", "synthetic", "--model", "lr",
+                "--client_num_in_total", "4", "--client_num_per_round", "4",
+                "--comm_round", "1", "--batch_size", "8",
+            ]
+            + extra,
+        )
+        assert result.exit_code == 0, result.output
