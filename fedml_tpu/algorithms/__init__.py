@@ -8,6 +8,18 @@ from fedml_tpu.algorithms.fedopt import FedOptAPI, make_server_optimizer
 from fedml_tpu.algorithms.fednova import FedNovaAPI, make_fednova_round
 from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI, assign_groups
 
+# Heavier algorithm modules import lazily from their own namespaces:
+#   fedml_tpu.algorithms.fedavg_robust    RobustFedAvgAPI
+#   fedml_tpu.algorithms.fedavg_transport run_loopback_federation, managers
+#   fedml_tpu.algorithms.decentralized    DecentralizedAPI (DSGD/PushSum)
+#   fedml_tpu.algorithms.split_nn         SplitNNAPI
+#   fedml_tpu.algorithms.vertical_fl      VFLAPI
+#   fedml_tpu.algorithms.fedgkt           FedGKTAPI
+#   fedml_tpu.algorithms.fedgan           FedGANAPI
+#   fedml_tpu.algorithms.fedseg           FedSegAPI
+#   fedml_tpu.algorithms.fednas           FedNASAPI
+#   fedml_tpu.algorithms.base_framework   templates
+
 __all__ = [
     "FedAvgAPI",
     "FedOptAPI",

@@ -1,0 +1,143 @@
+"""Minimal distributed-algorithm templates (ref:
+fedml_api/distributed/base_framework/ — central worker sums scalars from
+clients (algorithm_api.py:16-21, central_worker.py:28-32, client_worker.py:
+10-12) — and fedml_api/distributed/decentralized_framework/ — serverless
+gossip skeleton (decentralized_worker_manager.py:8-46)).
+
+These are the "write your own algorithm here" starting points: subclass,
+replace the payload/handlers, keep the actor wiring. Both run over any
+BaseCommManager."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from fedml_tpu.core.comm import BaseCommManager
+from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+from fedml_tpu.core.managers import ClientManager, ServerManager
+from fedml_tpu.core.message import Message
+
+MSG_C2S_VALUE = "base_c2s_value"
+MSG_S2C_START = "base_s2c_start"
+MSG_FINISH = "base_finish"
+MSG_GOSSIP = "gossip_result"
+
+
+class BaseCentralWorker(ServerManager):
+    """Sums one scalar from every client (ref central_worker.py:28-32)."""
+
+    def __init__(self, comm: BaseCommManager, worker_num: int):
+        super().__init__(comm, rank=0)
+        self.worker_num = worker_num
+        self.values: List[float] = []
+        self.total: Optional[float] = None
+
+    def start(self):
+        for w in range(1, self.worker_num + 1):
+            self.send_message(Message(MSG_S2C_START, 0, w))
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_C2S_VALUE, self._on_value)
+
+    def _on_value(self, msg: Message):
+        self.values.append(float(msg.get("value")))
+        if len(self.values) == self.worker_num:
+            self.total = sum(self.values)
+            for w in range(1, self.worker_num + 1):
+                self.send_message(Message(MSG_FINISH, 0, w))
+            self.finish()
+
+
+class BaseClientWorker(ClientManager):
+    """Replies with its payload (ref client_worker.py:10-12 returns
+    client_index)."""
+
+    def __init__(self, comm: BaseCommManager, rank: int, value_fn: Callable[[], float]):
+        super().__init__(comm, rank)
+        self.value_fn = value_fn
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_S2C_START, self._on_start)
+        self.register_message_receive_handler(MSG_FINISH, lambda m: self.finish())
+
+    def _on_start(self, msg: Message):
+        out = Message(MSG_C2S_VALUE, self.rank, 0)
+        out.add_params("value", float(self.value_fn()))
+        self.send_message(out)
+
+
+def run_base_framework(worker_values: List[float]) -> float:
+    """Loopback demo run (ref FedML_Base_distributed, algorithm_api.py:16-21).
+    Returns the central sum."""
+    hub = LoopbackHub()
+    K = len(worker_values)
+    server = BaseCentralWorker(LoopbackCommManager(hub, 0), K)
+    clients = [
+        BaseClientWorker(
+            LoopbackCommManager(hub, r), r, (lambda v=v: v)
+        )
+        for r, v in enumerate(worker_values, start=1)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    assert server.total is not None
+    return server.total
+
+
+class DecentralizedWorkerManager(ClientManager):
+    """Serverless gossip template (ref decentralized_worker_manager.py:8-46:
+    each worker trains, sends to topology out-neighbors, waits for all
+    in-neighbors, then averages)."""
+
+    def __init__(
+        self,
+        comm: BaseCommManager,
+        rank: int,
+        topology,
+        value: np.ndarray,
+        rounds: int = 1,
+    ):
+        super().__init__(comm, rank)
+        self.topology = topology
+        self.value = np.asarray(value, np.float64)
+        self.rounds = rounds
+        self.round_idx = 0
+        self._inbox: Dict[int, np.ndarray] = {}
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_GOSSIP, self._on_gossip)
+
+    def start_gossip(self):
+        for j in self.topology.get_out_neighbor_idx_list(self.rank):
+            m = Message(MSG_GOSSIP, self.rank, j)
+            m.add_params("value", self.value)
+            m.add_params("round", self.round_idx)
+            self.send_message(m)
+
+    def _on_gossip(self, msg: Message):
+        self._inbox[msg.get_sender_id()] = msg.get("value")
+        in_neighbors = self.topology.get_in_neighbor_idx_list(self.rank)
+        if len(self._inbox) < len(in_neighbors):
+            return
+        # weighted mix with the confusion-matrix row (ref __train:41-46; the
+        # reference's symmetric manager returns the row for both in/out,
+        # symmetric_topology_manager.py:55-61)
+        w = self.topology.get_out_neighbor_weights(self.rank)
+        mixed = self.value * w[self.rank]
+        for j, v in self._inbox.items():
+            mixed = mixed + np.asarray(v) * w[j]
+        self.value = mixed
+        self._inbox.clear()
+        self.round_idx += 1
+        if self.round_idx >= self.rounds:
+            self.finish()
+        else:
+            self.start_gossip()

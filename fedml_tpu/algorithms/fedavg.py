@@ -72,21 +72,31 @@ def make_fedavg_round(
     task: str = "classification",
     local_train_fn: Optional[Callable] = None,
     donate: bool = True,
+    post_train: Optional[Callable] = None,
+    post_aggregate: Optional[Callable] = None,
 ):
     """Build the jitted FedAvg round function (vmap over clients, one chip).
 
     ``local_train_fn`` lets algorithm variants (FedProx via prox_mu, FedNova
-    via its own trainer) reuse this round skeleton.
-    """
+    via its own trainer) reuse this round skeleton. ``post_train(client_vars,
+    global_vars, *extra)`` transforms the stacked per-client results before
+    averaging (robust clipping); ``post_aggregate(new_global, *extra)``
+    transforms the average (weak-DP noise); any positional round-fn
+    arguments beyond client_rngs are forwarded to both hooks (e.g. a noise
+    rng supplied by the API's _place_batch)."""
     local_train = local_train_fn or make_local_train(
         model, config.train, config.fed.epochs, task=task
     )
 
-    def round_fn(global_vars, x, y, mask, num_samples, client_rngs):
+    def round_fn(global_vars, x, y, mask, num_samples, client_rngs, *extra):
         client_vars, metrics = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
         )(global_vars, x, y, mask, client_rngs)
+        if post_train is not None:
+            client_vars = post_train(client_vars, global_vars, *extra)
         new_global = weighted_average(client_vars, num_samples)
+        if post_aggregate is not None:
+            new_global = post_aggregate(new_global, *extra)
         agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)
         return new_global, agg_metrics
 

@@ -13,13 +13,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI, weighted_average
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.robustness import (
     RobustConfig,
     add_gaussian_noise,
     norm_diff_clip_tree,
 )
-from fedml_tpu.train.client import make_local_train
 
 
 def make_robust_fedavg_round(
@@ -30,25 +29,32 @@ def make_robust_fedavg_round(
     local_train_fn=None,
     donate: bool = True,
 ):
-    local_train = local_train_fn or make_local_train(
-        model, config.train, config.fed.epochs, task=task
-    )
+    """The FedAvg round skeleton with the defense inserted via its
+    post_train/post_aggregate hooks (the skeleton itself lives once, in
+    make_fedavg_round)."""
+    from fedml_tpu.algorithms.fedavg import make_fedavg_round
 
-    def round_fn(global_vars, x, y, mask, num_samples, client_rngs, noise_rng):
-        client_vars, metrics = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0)
-        )(global_vars, x, y, mask, client_rngs)
+    def post_train(client_vars, global_vars, noise_rng):
         if robust.defense_type in ("norm_diff_clipping", "weak_dp"):
-            client_vars = jax.vmap(
+            return jax.vmap(
                 lambda cv: norm_diff_clip_tree(cv, global_vars, robust.norm_bound)
             )(client_vars)
-        new_global = weighted_average(client_vars, num_samples)
-        if robust.defense_type == "weak_dp":
-            new_global = add_gaussian_noise(new_global, noise_rng, robust.stddev)
-        agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)
-        return new_global, agg_metrics
+        return client_vars
 
-    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+    def post_aggregate(new_global, noise_rng):
+        if robust.defense_type == "weak_dp":
+            return add_gaussian_noise(new_global, noise_rng, robust.stddev)
+        return new_global
+
+    return make_fedavg_round(
+        model,
+        config,
+        task=task,
+        local_train_fn=local_train_fn,
+        donate=donate,
+        post_train=post_train,
+        post_aggregate=post_aggregate,
+    )
 
 
 class RobustFedAvgAPI(FedAvgAPI):

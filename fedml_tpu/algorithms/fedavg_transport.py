@@ -98,7 +98,9 @@ class LocalTrainer:
             self.data,
             [self.client_index],
             cfg.data.batch_size,
-            seed=cfg.seed * 1_000_003 + round_idx,
+            # client_index folded in: otherwise every client in a round
+            # would draw the identical shuffle permutation.
+            seed=cfg.seed * 1_000_003 + round_idx * 8191 + self.client_index,
             pad_bucket=cfg.data.pad_bucket,
         )
         rng = jax.random.fold_in(
@@ -141,6 +143,9 @@ class FedAvgServerManager(ServerManager):
             model.init(jax.random.fold_in(jax.random.PRNGKey(config.seed), 0))
         )
         self.history: List[dict] = []
+        from fedml_tpu.train.evaluate import make_eval_fn
+
+        self._eval_fn = make_eval_fn(model, task) if data is not None else None
 
     def send_init_msg(self):
         """Sample round-0 clients, broadcast w0 (ref send_init_msg :20-28)."""
@@ -179,6 +184,7 @@ class FedAvgServerManager(ServerManager):
                 self.data.test_x,
                 self.data.test_y,
                 task=self.task,
+                eval_fn=self._eval_fn,
             )
             row["Test/Loss"], row["Test/Acc"] = loss, acc
         self.history.append(row)
@@ -277,6 +283,10 @@ def run_loopback_federation(
     server.send_init_msg()
     server.run()  # blocks until FINISH or a client failure stops the loop
     if errors:
+        # release the surviving client threads before raising — they would
+        # otherwise park on inbox.get() for the process lifetime.
+        for c in clients:
+            c.finish()
         raise RuntimeError("client actor failed") from errors[0]
     for t in threads:
         t.join(timeout=60)

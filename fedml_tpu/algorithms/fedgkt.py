@@ -1,0 +1,200 @@
+"""FedGKT — Group Knowledge Transfer (ref: fedml_api/distributed/fedgkt/
+{GKTClientTrainer.py:48+, GKTServerTrainer.py:13-291, utils.py:75-92 KL_Loss,
+message_def.py:6-24}).
+
+Clients train a small CNN and upload per-batch (features, logits, labels) —
+representations, NOT weights; the server trains the large network on those
+features with CE + temperature-scaled KL against the client logits, then
+returns its own logits per client so the next local round distills the
+server's knowledge back (CE + KL vs server logits). Both KD directions use
+the reference's KL: T²·KL(softmax_T(teacher) ‖ softmax_T(student)),
+utils.py:75-92."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models.gkt_resnet import GKTClientResNet, GKTServerResNet
+
+
+def kl_loss(student_logits, teacher_logits, temperature: float = 3.0):
+    """T²·KL(teacher_T ‖ student_T) (ref KL_Loss.forward, utils.py:86-92)."""
+    T = temperature
+    log_p = jax.nn.log_softmax(student_logits / T, axis=-1)
+    q = jax.nn.softmax(teacher_logits / T, axis=-1) + 1e-7
+    return T * T * jnp.mean(jnp.sum(q * (jnp.log(q) - log_p), axis=-1))
+
+
+class FedGKTAPI:
+    """Single-host simulator of the GKT exchange (the reference runs it over
+    MPI; the message contents here are exactly the per-client feature/logit/
+    label arrays of message_def.py:6-24)."""
+
+    def __init__(
+        self,
+        num_classes: int = 10,
+        input_shape=(32, 32, 3),
+        client_blocks: int = 1,
+        server_layers=(2, 2),
+        lr: float = 0.01,
+        temperature: float = 3.0,
+        seed: int = 0,
+    ):
+        self.T = temperature
+        self.client_net = GKTClientResNet(num_classes=num_classes, blocks=client_blocks)
+        self.server_net = GKTServerResNet(num_classes=num_classes, layers=server_layers)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        dummy = jnp.zeros((1,) + tuple(input_shape))
+        self.client_vars: Dict[int, dict] = {}
+        self._client_init = lambda key: self.client_net.init(key, dummy, train=False)
+        self._ckeys = k1
+        feat = jnp.zeros((1, input_shape[0], input_shape[1], 16))
+        self.server_vars = self.server_net.init(k2, feat, train=False)
+        self.client_opt = optax.sgd(lr, momentum=0.9)
+        self.server_opt = optax.sgd(lr, momentum=0.9)
+        self.server_opt_state = self.server_opt.init(self.server_vars["params"])
+        self._client_step = jax.jit(self._make_client_step())
+        self._server_step = jax.jit(self._make_server_step())
+        self._extract = jax.jit(
+            lambda cv, x: self.client_net.apply(cv, x, train=False)
+        )
+        self._server_infer = jax.jit(
+            lambda sv, f: self.server_net.apply(sv, f, train=False)
+        )
+
+    def _make_client_step(self):
+        net, opt, T = self.client_net, self.client_opt, self.T
+
+        def loss_fn(params, variables, x, y, server_logits, has_teacher):
+            (feats, logits), new_vars = net.apply(
+                {**variables, "params": params},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            kd = kl_loss(logits, server_logits, T)
+            # round 0 has no server logits yet (ref GKTClientTrainer trains
+            # CE-only before the first server response)
+            loss = ce + jnp.where(has_teacher, kd, 0.0)
+            return loss, new_vars
+
+        def step(variables, opt_state, x, y, server_logits, has_teacher):
+            (loss, mutated), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                variables["params"], variables, x, y, server_logits, has_teacher
+            )
+            updates, opt_state = opt.update(grads, opt_state, variables["params"])
+            params = optax.apply_updates(variables["params"], updates)
+            return (
+                {"params": params, "batch_stats": mutated["batch_stats"]},
+                opt_state,
+                loss,
+            )
+
+        return step
+
+    def _make_server_step(self):
+        net, opt, T = self.server_net, self.server_opt, self.T
+
+        def loss_fn(params, variables, feats, y, client_logits):
+            logits, new_vars = net.apply(
+                {**variables, "params": params},
+                feats,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            kd = kl_loss(logits, client_logits, T)
+            return ce + kd, new_vars
+
+        def step(variables, opt_state, feats, y, client_logits):
+            (loss, mutated), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                variables["params"], variables, feats, y, client_logits
+            )
+            updates, opt_state = opt.update(grads, opt_state, variables["params"])
+            params = optax.apply_updates(variables["params"], updates)
+            return (
+                {"params": params, "batch_stats": mutated["batch_stats"]},
+                opt_state,
+                loss,
+            )
+
+        return step
+
+    def train_round(
+        self,
+        client_data: List[tuple],
+        local_epochs: int = 1,
+        server_epochs: int = 1,
+        batch_size: int = 32,
+        server_logits_cache: Optional[Dict[int, np.ndarray]] = None,
+    ):
+        """One GKT round. client_data: list of (x, y) per client. Returns the
+        new per-client server-logits cache (the S2C message content)."""
+        cache = server_logits_cache or {}
+        uploads = []  # (features, client_logits, labels) per client — C2S msg
+        for ci, (x, y) in enumerate(client_data):
+            if ci not in self.client_vars:
+                self.client_vars[ci] = self._client_init(
+                    jax.random.fold_in(self._ckeys, ci)
+                )
+            variables = self.client_vars[ci]
+            opt_state = self.client_opt.init(variables["params"])
+            n = len(y)
+            s_logits = cache.get(ci)
+            for _ in range(local_epochs):
+                for s in range(0, n - batch_size + 1, batch_size):
+                    xb = jnp.asarray(x[s : s + batch_size])
+                    yb = jnp.asarray(y[s : s + batch_size])
+                    if s_logits is None:
+                        teach = jnp.zeros((batch_size, self.client_net.num_classes))
+                        has_t = jnp.asarray(False)
+                    else:
+                        teach = jnp.asarray(s_logits[s : s + batch_size])
+                        has_t = jnp.asarray(True)
+                    variables, opt_state, _ = self._client_step(
+                        variables, opt_state, xb, yb, teach, has_t
+                    )
+            self.client_vars[ci] = variables
+            feats, logits = self._extract(variables, jnp.asarray(x))
+            uploads.append((np.asarray(feats), np.asarray(logits), np.asarray(y)))
+
+        # server: train on all clients' features (ref train_and_distill
+        # GKTServerTrainer.py:110-126, 233-291)
+        for _ in range(server_epochs):
+            for feats, logits, y in uploads:
+                n = len(y)
+                for s in range(0, n - batch_size + 1, batch_size):
+                    self.server_vars, self.server_opt_state, _ = self._server_step(
+                        self.server_vars,
+                        self.server_opt_state,
+                        jnp.asarray(feats[s : s + batch_size]),
+                        jnp.asarray(y[s : s + batch_size]),
+                        jnp.asarray(logits[s : s + batch_size]),
+                    )
+
+        # server logits back to each client (ref message_def.py:24)
+        new_cache = {}
+        for ci, (feats, _, _) in enumerate(uploads):
+            new_cache[ci] = np.asarray(
+                self._server_infer(self.server_vars, jnp.asarray(feats))
+            )
+        return new_cache
+
+    def evaluate(self, x, y, client_id: int = 0, batch_size: int = 128):
+        """End-to-end accuracy: client stem features → server net."""
+        correct = 0
+        variables = self.client_vars[client_id]
+        for s in range(0, len(y), batch_size):
+            xb = jnp.asarray(x[s : s + batch_size])
+            feats, _ = self._extract(variables, xb)
+            logits = self._server_infer(self.server_vars, feats)
+            correct += int(
+                jnp.sum(jnp.argmax(logits, -1) == jnp.asarray(y[s : s + batch_size]))
+            )
+        return correct / len(y)

@@ -1,0 +1,152 @@
+"""FedNAS — federated neural architecture search over the DARTS space (ref:
+fedml_api/distributed/fednas/{FedNASAggregator.py:56-114 separate weight/α
+averaging + per-round genotype record :173+, FedNASTrainer.py:34-128
+search/local_search}; second-order architect at
+model/cv/darts/architect.py:32-44).
+
+Each client alternates (a) architecture steps — ∇α L_val — and (b) weight
+steps — ∇w L_train — on its local split; the server sample-weight-averages w
+and α separately and records the derived genotype per round. This uses the
+first-order DARTS approximation (the reference's `--arch_search_method
+DARTS` default path; its 2nd-order unrolled variant, architect.py:32-44,
+is grad-of-grad in JAX and can slot into `arch_grad` later)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.fedavg import client_sampling, weighted_average
+from fedml_tpu.models.darts import DARTSNetwork, derive_genotype
+
+
+def _split_arch(params):
+    arch = {k: v for k, v in params.items() if k.startswith("alpha_")}
+    weights = {k: v for k, v in params.items() if not k.startswith("alpha_")}
+    return arch, weights
+
+
+class FedNASAPI:
+    def __init__(
+        self,
+        data,
+        num_classes: int,
+        input_shape,
+        ch: int = 8,
+        cells: int = 2,
+        steps: int = 2,
+        w_lr: float = 0.025,
+        arch_lr: float = 3e-3,
+        batch_size: int = 16,
+        seed: int = 0,
+    ):
+        self.data = data
+        self.net = DARTSNetwork(
+            num_classes=num_classes, ch=ch, cells=cells, steps=steps
+        )
+        self.steps = steps
+        rng = jax.random.PRNGKey(seed)
+        dummy = jnp.zeros((1,) + tuple(input_shape))
+        self.variables = self.net.init({"params": rng}, dummy, train=False)
+        self.w_opt = optax.sgd(w_lr, momentum=0.9)
+        self.arch_opt = optax.adam(arch_lr, b1=0.5, b2=0.999)
+        self.batch_size = batch_size
+        self.genotype_history: List = []
+        self._train_step = jax.jit(self._make_step(update_arch=False))
+        self._arch_step = jax.jit(self._make_step(update_arch=True))
+
+    def _make_step(self, update_arch: bool):
+        net = self.net
+        opt = self.arch_opt if update_arch else self.w_opt
+
+        def loss_fn(target_params, other_params, bs, x, y):
+            if update_arch:
+                params = {**other_params, **target_params}
+            else:
+                params = {**target_params, **other_params}
+            variables = {"params": params}
+            if bs:
+                variables["batch_stats"] = bs
+            logits, mut = net.apply(
+                variables, x, train=True, mutable=["batch_stats"]
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            return loss, mut.get("batch_stats", {})
+
+        def step(variables, opt_state, x, y):
+            arch, weights = _split_arch(variables["params"])
+            target, other = (arch, weights) if update_arch else (weights, arch)
+            (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                target, other, variables.get("batch_stats", {}), x, y
+            )
+            updates, opt_state = opt.update(grads, opt_state, target)
+            target = optax.apply_updates(target, updates)
+            params = {**other, **target}
+            out = {"params": params}
+            if new_bs:
+                out["batch_stats"] = new_bs
+            return out, opt_state, loss
+
+        return step
+
+    def _local_search(self, variables, x, y, epochs: int):
+        """ref FedNASTrainer.search: per epoch, arch step on val half +
+        weight steps on train half."""
+        n = len(y)
+        half = n // 2
+        xt, yt = x[:half], y[:half]
+        xv, yv = x[half:], y[half:]
+        arch, weights = _split_arch(variables["params"])
+        w_os = self.w_opt.init(weights)
+        a_os = self.arch_opt.init(arch)
+        B = self.batch_size
+        loss = jnp.zeros(())
+        for _ in range(epochs):
+            for s in range(0, max(len(yv) - B + 1, 1), B):
+                variables, a_os, _ = self._arch_step(
+                    variables, a_os, jnp.asarray(xv[s : s + B]), jnp.asarray(yv[s : s + B])
+                )
+            for s in range(0, max(len(yt) - B + 1, 1), B):
+                variables, w_os, loss = self._train_step(
+                    variables, w_os, jnp.asarray(xt[s : s + B]), jnp.asarray(yt[s : s + B])
+                )
+        return variables, float(loss)
+
+    def train_round(self, round_idx: int, client_num_per_round: int, epochs: int = 1):
+        sampled = client_sampling(
+            round_idx, self.data.num_clients, client_num_per_round
+        )
+        locals_, weights_n = [], []
+        for ci in sampled:
+            v, _ = self._local_search(
+                self.variables, self.data.client_x[ci], self.data.client_y[ci], epochs
+            )
+            locals_.append(v)
+            weights_n.append(len(self.data.client_y[ci]))
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *locals_
+        )
+        # weight + α averaged with the same sample weights, but kept as the
+        # two logical groups of the reference's aggregator (they are separate
+        # subtrees of params here, so one weighted_average covers both).
+        self.variables = weighted_average(
+            stacked, jnp.asarray(weights_n, jnp.float32)
+        )
+        geno = derive_genotype(
+            self.variables["params"]["alpha_normal"], steps=self.steps
+        )
+        self.genotype_history.append((round_idx, geno))
+        return geno
+
+    def evaluate(self, x, y, batch_size: int = 64) -> float:
+        correct = 0
+        for s in range(0, len(y), batch_size):
+            logits = self.net.apply(
+                self.variables, jnp.asarray(x[s : s + batch_size]), train=False
+            )
+            correct += int(jnp.sum(jnp.argmax(logits, -1) == jnp.asarray(y[s : s + batch_size])))
+        return correct / len(y)

@@ -1,0 +1,142 @@
+"""Split learning — model cut at a layer; clients own the bottom, the server
+owns the top; activations flow up, activation-grads flow back, clients take
+turns around a relay ring (ref: fedml_api/distributed/split_nn/
+{SplitNNAPI.py:9-40, client.py:24-34 forward/backward + ring neighbors
+:12-13, server.py:40-60 loss + acts.grad}).
+
+Two runtimes:
+
+- :class:`SplitNNAPI` — the fused simulator: client-bottom and server-top are
+  two param groups of one jitted step; jax.grad through the composition IS
+  the activation-gradient exchange. The ring relay (one active client at a
+  time, weights handed to the next; ref SplitNNAPI relay) becomes a
+  sequential pass over clients reusing the same bottom params — semantically
+  identical, compiled once.
+- :func:`split_step_with_boundary` — the explicit two-party step that cuts
+  the vjp exactly where the reference cuts the wire (client uploads acts,
+  server returns ∂L/∂acts); used by the transport managers and to verify
+  the fused path's math."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models import ModelDef
+
+
+class SplitNNAPI:
+    """Fused split-learning simulator over a client ring."""
+
+    def __init__(
+        self,
+        bottom: ModelDef,
+        top: ModelDef,
+        lr: float = 0.1,
+        momentum: float = 0.9,
+        wd: float = 5e-4,
+        seed: int = 0,
+    ):
+        self.bottom = bottom
+        self.top = top
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        self.bottom_vars = bottom.init(k1)
+        self.top_vars = top.init(k2)
+        # ref client optimizer: SGD(0.1, momentum=0.9, wd=5e-4) client.py:18-19
+        self.opt = optax.chain(
+            optax.add_decayed_weights(wd), optax.sgd(lr, momentum=momentum)
+        )
+        self.opt_state = self.opt.init(
+            {"bottom": self.bottom_vars["params"], "top": self.top_vars["params"]}
+        )
+        self._step = jax.jit(self._make_step())
+
+    def _make_step(self):
+        bottom, top, opt = self.bottom, self.top, self.opt
+
+        def loss_fn(params, x, y):
+            acts, _ = bottom.apply({"params": params["bottom"]}, x, train=True)
+            logits, _ = top.apply({"params": params["top"]}, acts, train=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            correct = jnp.sum(jnp.argmax(logits, -1) == y)
+            return loss, correct
+
+        def step(params, opt_state, x, y):
+            (loss, correct), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, x, y
+            )
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, correct
+
+        return step
+
+    def train_ring(self, client_data, batch_size: int = 32, epochs_per_client: int = 1):
+        """Relay ring: each client in turn runs its epochs with the shared
+        bottom weights (ref relay turn-taking, client.py:12-13, run at
+        SplitNNAPI.py:30-40)."""
+        params = {
+            "bottom": self.bottom_vars["params"],
+            "top": self.top_vars["params"],
+        }
+        stats = []
+        for x, y in client_data:  # ring order
+            n = len(y)
+            for _ in range(epochs_per_client):
+                for s in range(0, n - batch_size + 1, batch_size):
+                    params, self.opt_state, loss, correct = self._step(
+                        params,
+                        self.opt_state,
+                        jnp.asarray(x[s : s + batch_size]),
+                        jnp.asarray(y[s : s + batch_size]),
+                    )
+            stats.append({"loss": float(loss)})
+        self.bottom_vars = {"params": params["bottom"]}
+        self.top_vars = {"params": params["top"]}
+        return stats
+
+    def evaluate(self, x, y, batch_size: int = 128):
+        correct = total = 0
+        for s in range(0, len(y), batch_size):
+            xb = jnp.asarray(x[s : s + batch_size])
+            acts, _ = self.bottom.apply(self.bottom_vars, xb, train=False)
+            logits, _ = self.top.apply(self.top_vars, acts, train=False)
+            correct += int(jnp.sum(jnp.argmax(logits, -1) == jnp.asarray(y[s : s + batch_size])))
+            total += len(xb)
+        return correct / max(total, 1)
+
+
+def split_step_with_boundary(
+    bottom: ModelDef,
+    top: ModelDef,
+    bottom_vars: dict,
+    top_vars: dict,
+    x,
+    y,
+) -> Tuple[jnp.ndarray, dict, dict]:
+    """One forward/backward with the explicit wire boundary: returns
+    (loss, bottom_grads, top_grads) where the only values crossing between
+    the parties are ``acts`` (client→server) and ``acts_grad``
+    (server→client) — the reference's per-batch message
+    (client.py:24-34 / server.py:40-60)."""
+    # client side
+    acts, bottom_vjp = jax.vjp(
+        lambda p: bottom.apply({"params": p}, x, train=True)[0],
+        bottom_vars["params"],
+    )
+
+    # server side: loss + grads wrt (top params, acts)
+    def server_loss(tp, a):
+        logits, _ = top.apply({"params": tp}, a, train=True)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    loss, (top_grads, acts_grad) = jax.value_and_grad(server_loss, argnums=(0, 1))(
+        top_vars["params"], acts
+    )
+    # client backward with the returned activation grads
+    (bottom_grads,) = bottom_vjp(acts_grad)
+    return loss, bottom_grads, top_grads

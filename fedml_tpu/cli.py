@@ -59,7 +59,7 @@ RUNTIMES = ("vmap", "mesh", "loopback")
 @click.option("--seed", type=int, default=0)
 @click.option("--log_dir", type=click.Path(path_type=Path), default=None)
 @click.option("--checkpoint_path", type=click.Path(path_type=Path), default=None,
-              help="Save (params, round, rng) here every test round")
+              help="Save (params, round) here on every test round and at the end")
 @click.option("--ci", is_flag=True, default=False, help="CI short-circuit (1 round smoke)")
 def main(**opt):
     """Train a federated model on TPU."""
@@ -115,7 +115,20 @@ def run(**opt):
     model = create_model(config.model, config.data.dataset, sample_shape, data.num_classes)
 
     logger = MetricsLogger(str(opt["log_dir"]) if opt["log_dir"] else None)
-    api = _build_api(opt["algorithm"], opt["runtime"], config, data, model, task, logger)
+    api_cell = []
+
+    def log_fn(row):
+        logger.log(row)
+        # crash-resumable: persist on every test round, not just at the end
+        if opt["checkpoint_path"] and "Test/Acc" in row and api_cell:
+            gv = getattr(api_cell[0], "global_vars", None)
+            if gv is not None:
+                save_checkpoint(
+                    str(opt["checkpoint_path"]), gv, round_idx=row["round"]
+                )
+
+    api = _build_api(opt["algorithm"], opt["runtime"], config, data, model, task, log_fn)
+    api_cell.append(api)
 
     final = api.train()
     if opt["checkpoint_path"]:
@@ -129,8 +142,7 @@ def run(**opt):
     return api
 
 
-def _build_api(algorithm, runtime, config, data, model, task, logger):
-    log_fn = logger.log
+def _build_api(algorithm, runtime, config, data, model, task, log_fn):
     if runtime == "loopback":
         if algorithm != "fedavg":
             raise click.UsageError("runtime=loopback currently supports algorithm=fedavg")

@@ -81,13 +81,11 @@ class Message:
                 "params": meta_params,
             }
         ).encode("utf-8")
-        out = bytearray()
-        out += _MAGIC
-        out += struct.pack("<Q", len(meta))
-        out += meta
-        for b in buffers:
-            out += b
-        return bytes(out)
+        from fedml_tpu import native
+
+        header = _MAGIC + struct.pack("<Q", len(meta)) + meta
+        # single-pass (threaded when large) wire-image assembly
+        return native.concat_buffers(buffers, header=header)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Message":

@@ -132,11 +132,14 @@ def stack_clients(
     x = np.zeros((C, cap) + feat_shape, dtype=data.client_x[client_indices[0]].dtype)
     y = np.zeros((C, cap) + lab_shape, dtype=data.client_y[client_indices[0]].dtype)
     mask = np.zeros((C, cap), dtype=np.float32)
+    from fedml_tpu import native
+
     for j, ci in enumerate(client_indices):
         n = ns[j]
         order = rng.permutation(n) if shuffle else np.arange(n)
-        x[j, :n] = data.client_x[ci][order]
-        y[j, :n] = data.client_y[ci][order]
+        # threaded row-gather (native/src/fastpack.cpp); numpy fallback inside
+        native.gather_rows(data.client_x[ci], order, x[j, :n])
+        native.gather_rows(data.client_y[ci], order, y[j, :n])
         mask[j, :n] = 1.0
     x = x.reshape((C, steps, bs) + feat_shape)
     y = y.reshape((C, steps, bs) + lab_shape)

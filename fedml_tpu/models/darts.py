@@ -1,0 +1,191 @@
+"""DARTS search space for FedNAS (ref: fedml_api/model/cv/darts/
+{model_search.py (306 LoC), operations.py, genotypes.py, architect.py:13-44};
+used by fedml_api/distributed/fednas/).
+
+A differentiable cell: every edge is a softmax(α)-weighted mixture over the
+candidate op set; the network stacks normal/reduction cells. α lives in its
+own ``arch`` variable collection so FedNAS can average weights and
+architecture parameters separately (ref FedNASAggregator.__aggregate_weight /
+__aggregate_alpha, FedNASAggregator.py:56-114). Genotype extraction follows
+model_search.py's derive: per node keep the two strongest non-'none'
+incoming edges. Op set is the standard DARTS eight, minus the 5×5 variants
+by default to keep the mixture compile-light (configurable)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+DEFAULT_OPS: Tuple[str, ...] = (
+    "none",
+    "skip_connect",
+    "avg_pool_3x3",
+    "max_pool_3x3",
+    "sep_conv_3x3",
+    "dil_conv_3x3",
+)
+
+
+class _SepConv(nn.Module):
+    ch: int
+    kernel: int = 3
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        k = (self.kernel, self.kernel)
+        s = (self.stride, self.stride)
+        h = nn.Conv(x.shape[-1], k, strides=s, padding="SAME", feature_group_count=x.shape[-1], use_bias=False)(nn.relu(x))
+        h = nn.Conv(self.ch, (1, 1), use_bias=False)(h)
+        h = nn.BatchNorm(use_running_average=not train, momentum=0.9)(h)
+        h = nn.Conv(self.ch, k, padding="SAME", feature_group_count=self.ch, use_bias=False)(nn.relu(h))
+        h = nn.Conv(self.ch, (1, 1), use_bias=False)(h)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(h)
+
+
+class _DilConv(nn.Module):
+    ch: int
+    kernel: int = 3
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        k = (self.kernel, self.kernel)
+        h = nn.Conv(
+            x.shape[-1], k, strides=(self.stride, self.stride), padding="SAME",
+            kernel_dilation=(2, 2), feature_group_count=x.shape[-1], use_bias=False,
+        )(nn.relu(x))
+        h = nn.Conv(self.ch, (1, 1), use_bias=False)(h)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(h)
+
+
+class MixedOp(nn.Module):
+    ch: int
+    stride: int
+    ops: Sequence[str] = DEFAULT_OPS
+
+    @nn.compact
+    def __call__(self, x, weights, train=False):
+        outs = []
+        s = (self.stride, self.stride)
+        for name in self.ops:
+            if name == "none":
+                if self.stride == 1:
+                    o = jnp.zeros_like(x)
+                else:
+                    o = jnp.zeros(
+                        x[:, :: self.stride, :: self.stride, :].shape, x.dtype
+                    )
+            elif name == "skip_connect":
+                if self.stride == 1:
+                    o = x
+                else:
+                    o = nn.Conv(self.ch, (1, 1), strides=s, use_bias=False, name="skip_reduce")(x)
+            elif name == "avg_pool_3x3":
+                o = nn.avg_pool(x, (3, 3), strides=s, padding="SAME")
+            elif name == "max_pool_3x3":
+                o = nn.max_pool(x, (3, 3), strides=s, padding="SAME")
+            elif name == "sep_conv_3x3":
+                o = _SepConv(self.ch, 3, self.stride, name="sep3")(x, train)
+            elif name == "dil_conv_3x3":
+                o = _DilConv(self.ch, 3, self.stride, name="dil3")(x, train)
+            else:
+                raise ValueError(name)
+            if o.shape[-1] != self.ch:
+                o = nn.Conv(self.ch, (1, 1), use_bias=False, name=f"adj_{name}")(o)
+            outs.append(o)
+        stacked = jnp.stack(outs)  # [O, B, H, W, C]
+        return jnp.tensordot(weights, stacked, axes=1)
+
+
+class Cell(nn.Module):
+    ch: int
+    steps: int = 4
+    reduction: bool = False
+    ops: Sequence[str] = DEFAULT_OPS
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train=False):
+        """weights: [num_edges, num_ops] softmaxed α rows."""
+        s0 = nn.Conv(self.ch, (1, 1), use_bias=False, name="pre0")(s0)
+        s1 = nn.Conv(self.ch, (1, 1), use_bias=False, name="pre1")(s1)
+        states: List = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            acc = None
+            for j, h in enumerate(states):
+                stride = 2 if self.reduction and j < 2 else 1
+                o = MixedOp(self.ch, stride, self.ops, name=f"edge_{i}_{j}")(
+                    h, weights[offset + j], train
+                )
+                acc = o if acc is None else acc + o
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.steps :], axis=-1)
+
+
+def num_edges(steps: int = 4) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    num_classes: int
+    ch: int = 16
+    cells: int = 3
+    steps: int = 4
+    ops: Sequence[str] = DEFAULT_OPS
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        E = num_edges(self.steps)
+        O = len(self.ops)
+        alpha_normal = self.param(
+            "alpha_normal",
+            lambda k: 1e-3 * jax.random.normal(k, (E, O)),
+        )
+        alpha_reduce = self.param(
+            "alpha_reduce",
+            lambda k: 1e-3 * jax.random.normal(k, (E, O)),
+        )
+        w_n = jax.nn.softmax(alpha_normal, axis=-1)
+        w_r = jax.nn.softmax(alpha_reduce, axis=-1)
+        h = nn.Conv(self.ch, (3, 3), padding="SAME", use_bias=False, name="stem")(x)
+        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, name="stem_bn")(h)
+        s0 = s1 = h
+        for ci in range(self.cells):
+            reduction = ci == self.cells // 2 and self.cells > 1
+            out = Cell(
+                self.ch,
+                steps=self.steps,
+                reduction=reduction,
+                ops=self.ops,
+                name=f"cell{ci}",
+            )(s0, s1, w_r if reduction else w_n, train)
+            s0, s1 = (s1, out) if not reduction else (out, out)
+        h = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(h)
+
+
+def derive_genotype(alpha: jnp.ndarray, ops: Sequence[str] = DEFAULT_OPS, steps: int = 4):
+    """Per node keep the 2 strongest non-'none' incoming edges
+    (ref model_search.py genotype())."""
+    alpha = jax.nn.softmax(jnp.asarray(alpha), axis=-1)
+    gene = []
+    offset = 0
+    none_idx = ops.index("none") if "none" in ops else -1
+    for i in range(steps):
+        n_in = 2 + i
+        rows = alpha[offset : offset + n_in]
+        best_per_edge = []
+        for j in range(n_in):
+            row = [w for k, w in enumerate(rows[j]) if k != none_idx]
+            names = [ops[k] for k in range(len(ops)) if k != none_idx]
+            k_best = int(jnp.argmax(jnp.asarray(row)))
+            best_per_edge.append((float(row[k_best]), names[k_best], j))
+        best_per_edge.sort(reverse=True)
+        gene.extend([(op, j) for _, op, j in best_per_edge[:2]])
+        offset += n_in
+    return gene

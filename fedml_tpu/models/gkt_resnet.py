@@ -1,0 +1,57 @@
+"""GKT split ResNets — the small client feature-extractor and the large
+server network (ref: fedml_api/model/cv/resnet56_gkt/{resnet_client.py,
+resnet_server.py}, 870 LoC; used by fedgkt).
+
+Client (resnet_client.py:130-205): 3×3 stem conv16+BN+ReLU — whose OUTPUT is
+the uploaded ``extracted_features`` [B,32,32,16] — then the 16-channel stage
+and a local fc head for distillation logits. Server (resnet_server.py:
+113-160): consumes those features through the 32/64-channel stages + fc.
+Together they form resnet56's topology cut after the stem."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.resnet import Bottleneck
+
+
+class GKTClientResNet(nn.Module):
+    """Stem + 16-ch stage + local head; returns (features, logits)."""
+
+    num_classes: int = 10
+    blocks: int = 2  # resnet8_56 client variant uses few blocks
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
+        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, name="bn1")(h)
+        h = nn.relu(h)
+        features = h  # ref resnet_client.py:193 extracted_features
+        for bi in range(self.blocks):
+            h = Bottleneck(4, name=f"layer1_block{bi}")(h, train=train)
+        h = jnp.mean(h, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, name="fc")(h)
+        return features, logits
+
+
+class GKTServerResNet(nn.Module):
+    """32/64-ch stages over client features (ref resnet_server.py forward
+    starting at layer2 on the uploaded features)."""
+
+    num_classes: int = 10
+    layers: Sequence[int] = (6, 6)
+
+    @nn.compact
+    def __call__(self, features, train: bool = False):
+        h = features
+        for si, (planes, blocks) in enumerate(zip((32, 64), self.layers)):
+            for bi in range(blocks):
+                stride = 2 if bi == 0 else 1
+                h = Bottleneck(
+                    planes, stride=stride, name=f"layer{si + 2}_block{bi}"
+                )(h, train=train)
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="fc")(h)

@@ -113,6 +113,27 @@ def create(
             has_batch_stats=bn, has_dropout=True, name=name,
         )
 
+    if name == "segnet":
+        from fedml_tpu.models.segnet import EncoderDecoder
+
+        return ModelDef(
+            EncoderDecoder(num_classes=num_classes, **kw),
+            input_shape, num_classes, has_batch_stats=True, name=name,
+        )
+
+    if name == "darts":
+        from fedml_tpu.models.darts import DARTSNetwork
+
+        return ModelDef(
+            DARTSNetwork(num_classes=num_classes, **kw),
+            input_shape, num_classes, has_batch_stats=True, name=name,
+        )
+
+    if name == "mnistgan":
+        from fedml_tpu.algorithms.fedgan import make_gan_model_def
+
+        return make_gan_model_def(**kw)
+
     if name == "efficientnet":
         from fedml_tpu.models.efficientnet import EfficientNet
 
@@ -125,5 +146,6 @@ def create(
     raise KeyError(
         f"unknown model {model_name!r}; available: lr, cnn, cnn_dropout, rnn, "
         "resnet56, resnet110, resnet18_gn..resnet152_gn, mobilenet, "
-        "mobilenet_v3, vgg11..vgg19(_bn), efficientnet"
+        "mobilenet_v3, vgg11..vgg19(_bn), efficientnet, segnet, darts, "
+        "mnistgan"
     )

@@ -1,0 +1,76 @@
+"""Decoder-only transformer LM with pluggable attention — the long-context
+flagship (green-field vs the reference, whose only NLP models are 2-layer
+LSTMs, fedml_api/model/nlp/rnn.py; SURVEY §5 marks sequence parallelism
+absent).
+
+The attention callable is injected so the SAME module runs single-chip
+(full causal attention) or sequence-parallel (ring attention inside
+shard_map — parallel/long_context.py). Pre-LN blocks, learned positional
+embeddings indexed by GLOBAL position (the seq-sharded path passes each
+shard's offset), GELU MLP. bfloat16-friendly: all matmuls keep bf16 inputs
+with fp32 softmax accumulation in the attention implementations."""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.parallel.ring_attention import full_attention
+
+causal_full_attention = functools.partial(full_attention, causal=True)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attn_fn: Callable = causal_full_attention
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, C = x.shape
+        H = self.num_heads
+        D = C // H
+        h = nn.LayerNorm(name="ln1")(x)
+        qkv = nn.Dense(3 * C, use_bias=False, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        attn = self.attn_fn(q, k, v)
+        attn = attn.reshape(B, T, C)
+        x = x + nn.Dense(C, use_bias=False, name="proj")(attn)
+        h = nn.LayerNorm(name="ln2")(x)
+        h = nn.Dense(self.mlp_ratio * C, name="mlp_up")(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(C, name="mlp_down")(h)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    num_layers: int = 2
+    num_heads: int = 4
+    embed_dim: int = 128
+    max_len: int = 4096
+    attn_fn: Callable = causal_full_attention
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset: int = 0, train: bool = False):
+        """tokens [B, T_local]; pos_offset = this shard's global start."""
+        B, T = tokens.shape
+        tok = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")(tokens)
+        pos_table = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.embed_dim),
+        )
+        pos = jnp.arange(T) + pos_offset
+        x = tok + pos_table[pos]
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                self.num_heads, attn_fn=self.attn_fn, name=f"block{i}"
+            )(x, train=train)
+        x = nn.LayerNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, name="head")(x)

@@ -1,0 +1,119 @@
+"""ctypes loader for the fastpack native library.
+
+Builds src/fastpack.cpp with g++ on first use (cached in build/), exposes
+:func:`gather_rows` and :func:`concat_buffers`. Every entry point has a pure
+numpy fallback, so the framework runs (slower) where no C++ toolchain
+exists. See src/fastpack.cpp for why these paths are native."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "src", "fastpack.cpp")
+_BUILD_DIR = os.path.join(_DIR, "build")
+_SO = os.path.join(_BUILD_DIR, "libfastpack.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                        "-std=c++17", "-pthread", _SRC, "-o", _SO + ".tmp",
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(_SO + ".tmp", _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.fp_gather_rows.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_char_p,
+            ]
+            lib.fp_concat.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_char_p,
+            ]
+            lib.fp_version.restype = ctypes.c_int
+            assert lib.fp_version() == 1
+            _lib = lib
+        except Exception:
+            _build_failed = True
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gather_rows(src: np.ndarray, order: np.ndarray, out: np.ndarray) -> None:
+    """out[i] = src[order[i]] over the leading axis (rows must be
+    contiguous). Falls back to numpy fancy indexing."""
+    lib = _load()
+    src = np.ascontiguousarray(src)
+    if (
+        lib is None
+        or not out.flags["C_CONTIGUOUS"]
+        or src.dtype != out.dtype
+        or src.shape[1:] != out.shape[1:]
+    ):
+        out[...] = src[order]
+        return
+    order64 = np.ascontiguousarray(order, dtype=np.int64)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.fp_gather_rows(
+        src.ctypes.data_as(ctypes.c_char_p),
+        order64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(order64),
+        row_bytes,
+        out.ctypes.data_as(ctypes.c_char_p),
+    )
+
+
+def concat_buffers(buffers: Sequence[bytes], header: bytes = b"") -> bytes:
+    """header + b''.join(buffers), assembled in one pass (threaded when
+    large). Falls back to bytes join."""
+    lib = _load()
+    if lib is None:
+        return header + b"".join(buffers)
+    lens = np.array([len(header)] + [len(b) for b in buffers], dtype=np.int64)
+    offsets = np.zeros_like(lens)
+    np.cumsum(lens[:-1], out=offsets[1:])
+    total = int(lens.sum())
+    out = ctypes.create_string_buffer(total)
+    all_bufs = [header] + list(buffers)
+    arr = (ctypes.c_char_p * len(all_bufs))(*all_bufs)
+    lib.fp_concat(
+        arr,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(all_bufs),
+        out,
+    )
+    return out.raw

@@ -1,0 +1,89 @@
+// fastpack — native runtime kernels for the host-side hot paths.
+//
+// The reference delegates all native work to torch/MPI libraries (SURVEY §2
+// native-code note); this framework's own host hot paths are (a) stacking
+// sampled clients' ragged shards into the padded device batch
+// (fedml_tpu/data/base.py stack_clients — row gather by permutation into a
+// preallocated zero buffer) and (b) assembling the transport wire image
+// (core/message.py to_bytes — concatenation of many array buffers). Both are
+// pure memory movement: this library does them with std::thread fan-out over
+// row/byte ranges. Loaded via ctypes (no pybind11 in the image); the Python
+// callers fall back to numpy when the shared object is unavailable.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int clamp_threads(int64_t work_items, int64_t min_per_thread) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  int64_t by_work = work_items / std::max<int64_t>(min_per_thread, 1);
+  return static_cast<int>(std::max<int64_t>(1, std::min<int64_t>(hw, by_work)));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows: dst[i] = src[order[i]] for i in [0, n_rows), each row
+// row_bytes wide. dst/src must not overlap.
+void fp_gather_rows(const char* src, const int64_t* order, int64_t n_rows,
+                    int64_t row_bytes, char* dst) {
+  int n_threads = clamp_threads(n_rows * row_bytes, 1 << 20);
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(dst + i * row_bytes, src + order[i] * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n_rows);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n_rows + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(n_rows, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(worker, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Concatenate n buffers into dst at the given offsets (offsets[i] is the
+// destination byte offset of buffer i; lens[i] its length).
+void fp_concat(const char** bufs, const int64_t* lens, const int64_t* offsets,
+               int64_t n, char* dst) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) total += lens[i];
+  int n_threads = clamp_threads(total, 1 << 22);
+  if (n_threads <= 1 || n < 2) {
+    for (int64_t i = 0; i < n; ++i) {
+      std::memcpy(dst + offsets[i], bufs[i], static_cast<size_t>(lens[i]));
+    }
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back([&, lo, hi]() {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + offsets[i], bufs[i],
+                    static_cast<size_t>(lens[i]));
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+int fp_version() { return 1; }
+
+}  // extern "C"

@@ -106,7 +106,9 @@ class DistributedFedAvgAPI(FedAvgAPI):
         self.mesh = mesh or make_mesh(
             config.mesh.client_shards, config.mesh.axis_name
         )
-        self.n_shards = self.mesh.devices.size
+        # pad to the number of shards along the CLIENT axis (the mesh may
+        # carry more axes, e.g. a "seq" axis for sequence parallelism)
+        self.n_shards = self.mesh.shape[self.mesh.axis_names[0]]
         self._data_sharding = NamedSharding(
             self.mesh, P(self.mesh.axis_names[0])
         )

@@ -1,0 +1,84 @@
+"""Sequence-parallel (SP) causal-LM training — the long-context training
+path: the sequence axis of every activation lives on a mesh axis; attention
+is ring attention over ICI; the loss is a psum-mean.
+
+Composable with FL: a 2-D Mesh ("clients", "seq") runs FL clients as one
+axis and splits each client's long sequences over the other — the layout
+SURVEY §2h calls for (collectives ride ICI). This module provides the 1-D
+"seq" step used by the flagship long-context trainer and the dryrun."""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.models.transformer import TransformerLM
+from fedml_tpu.parallel.ring_attention import ring_attention_sharded
+
+
+def make_sp_lm(vocab_size: int, axis_name: str = "seq", **model_kw) -> TransformerLM:
+    """TransformerLM wired with ring attention over ``axis_name`` (must be
+    called inside shard_map)."""
+    attn = functools.partial(
+        ring_attention_sharded, axis_name=axis_name, causal=True
+    )
+    return TransformerLM(vocab_size=vocab_size, attn_fn=attn, **model_kw)
+
+
+def make_sp_train_step(
+    mesh: Mesh,
+    vocab_size: int,
+    lr: float = 1e-3,
+    axis_name: str = "seq",
+    **model_kw,
+):
+    """Build (init_fn, step_fn) for sequence-parallel LM training.
+
+    step_fn(params, opt_state, tokens, targets) with tokens/targets
+    [B, T] sharded on T over the mesh; params replicated. The loss mean and
+    grads are psum'd over the ring — one SPMD program, no host round-trips.
+    """
+    model = make_sp_lm(vocab_size, axis_name, **model_kw)
+    opt = optax.adamw(lr)
+
+    def shard_body(params, opt_state, tokens, targets):
+        T_local = tokens.shape[1]
+        offset = jax.lax.axis_index(axis_name) * T_local
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens, pos_offset=offset)
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
+            # global mean over the full sequence
+            s = jax.lax.psum(jnp.sum(per_tok), axis_name)
+            n = jax.lax.psum(per_tok.size, axis_name)
+            return s / n
+
+        # shard_map's transpose inserts the cross-shard psum for replicated
+        # (P()) params itself — an explicit psum here would double-count.
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    data_spec = P(None, axis_name)
+    step = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(), data_spec, data_spec),
+        out_specs=(P(), P(), P()),
+    )
+
+    def init_fn(rng, example_tokens):
+        model_full = TransformerLM(vocab_size=vocab_size, **model_kw)
+        variables = model_full.init({"params": rng}, example_tokens[:, :8])
+        params = variables["params"]
+        return params, opt.init(params)
+
+    return init_fn, jax.jit(step)

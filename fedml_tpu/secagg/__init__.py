@@ -1,0 +1,27 @@
+from fedml_tpu.secagg.mpc import (
+    FIELD_PRIME,
+    modular_inv,
+    gen_lagrange_coeffs,
+    bgw_encode,
+    bgw_decode,
+    lcc_encode_with_points,
+    lcc_decode_with_points,
+    gen_additive_shares,
+    pk_gen,
+    key_agreement,
+)
+from fedml_tpu.secagg.secure_aggregation import SecureAggregator
+
+__all__ = [
+    "FIELD_PRIME",
+    "modular_inv",
+    "gen_lagrange_coeffs",
+    "bgw_encode",
+    "bgw_decode",
+    "lcc_encode_with_points",
+    "lcc_decode_with_points",
+    "gen_additive_shares",
+    "pk_gen",
+    "key_agreement",
+    "SecureAggregator",
+]

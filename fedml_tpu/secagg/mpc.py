@@ -1,0 +1,123 @@
+"""Finite-field MPC primitives for secure aggregation (ref:
+fedml_api/distributed/turboaggregate/mpc_function.py:4-271 — Shamir/BGW
+secret sharing, Lagrange-coded computing (LCC), additive shares, DH key
+agreement).
+
+The reference computes share-by-share with Python ints and np.object math;
+here everything is vectorized int64 over a Mersenne-prime field
+p = 2^31 − 1 (products of two residues stay < 2^62, exact in int64 — safe on
+accelerators too, where uint64 multiplies would overflow silently). Batched
+polynomial evaluation is a Vandermonde matmul — the MXU does secret sharing."""
+
+from __future__ import annotations
+
+import numpy as np
+
+FIELD_PRIME = 2**31 - 1  # Mersenne prime; fits int64 products
+
+
+def modular_inv(a, p: int = FIELD_PRIME):
+    """a^(p-2) mod p (Fermat; ref modular_inv:4-18 uses extended Euclid)."""
+    return pow(int(a) % p, p - 2, p)
+
+
+def _mod(a, p):
+    return np.mod(a, p).astype(np.int64)
+
+
+def _matmul_mod(A, B, p):
+    """Exact modular matmul: int64 with object fallback for big shapes.
+    Row-blocked to keep intermediate sums < 2^63."""
+    A = _mod(A, p)
+    B = _mod(B, p)
+    # sum of k products each < p^2 ≈ 4.6e18; block k so k*p^2 < 9.2e18
+    k = A.shape[-1]
+    block = max(1, int((2**63 - 1) // (int(p) ** 2)))
+    out = np.zeros((A.shape[0], B.shape[1]), np.int64)
+    for s in range(0, k, block):
+        out = _mod(out + A[:, s : s + block] @ B[s : s + block, :], p)
+    return out
+
+
+def gen_lagrange_coeffs(alpha_s, beta_s, p: int = FIELD_PRIME):
+    """U[i][j]: Lagrange basis l_j(alpha_i) over the field
+    (ref gen_Lagrange_coeffs:39-59)."""
+    alpha_s = [int(a) % p for a in alpha_s]
+    beta_s = [int(b) % p for b in beta_s]
+    U = np.zeros((len(alpha_s), len(beta_s)), np.int64)
+    for i, a in enumerate(alpha_s):
+        for j, b in enumerate(beta_s):
+            num, den = 1, 1
+            for l, bl in enumerate(beta_s):
+                if l == j:
+                    continue
+                num = num * ((a - bl) % p) % p
+                den = den * ((b - bl) % p) % p
+            U[i, j] = num * modular_inv(den, p) % p
+    return U
+
+
+def bgw_encode(X: np.ndarray, N: int, T: int, p: int = FIELD_PRIME, rng=None):
+    """Shamir/BGW: share secret matrix X [m, d] to N workers with threshold
+    T — evaluate the degree-T polynomial X + Σ R_t z^t at α_i = i+1
+    (ref BGW_encoding:62-76). Returns [N, m, d]."""
+    rng = rng or np.random.default_rng()
+    m, d = X.shape
+    coeffs = np.concatenate(
+        [
+            _mod(X, p)[None],
+            rng.integers(0, p, size=(T, m, d), dtype=np.int64),
+        ]
+    )  # [T+1, m, d]
+    alphas = np.arange(1, N + 1, dtype=np.int64)
+    # Vandermonde [N, T+1] @ coeffs [T+1, m*d]
+    V = np.stack([np.power(alphas, t) % p for t in range(T + 1)], axis=1)
+    flat = coeffs.reshape(T + 1, m * d)
+    return _matmul_mod(V, flat, p).reshape(N, m, d)
+
+
+def bgw_decode(shares: np.ndarray, worker_idx, p: int = FIELD_PRIME):
+    """Reconstruct the secret from ≥T+1 shares via Lagrange at z=0
+    (ref gen_BGW_lambda_s:78-88 + BGW_decoding:90-108)."""
+    alphas = [int(i) + 1 for i in worker_idx]
+    lam = gen_lagrange_coeffs([0], alphas, p)[0]  # [K]
+    K, m, d = shares.shape
+    flat = shares.reshape(K, m * d)
+    return _matmul_mod(lam[None, :], flat, p).reshape(m, d)
+
+
+def lcc_encode_with_points(X, alpha_s, beta_s, p: int = FIELD_PRIME):
+    """LCC: encode data blocks X [K, m, d] at evaluation points alpha_s via
+    Lagrange interpolation through (beta_j, X_j)
+    (ref LCC_encoding_with_points:227-247)."""
+    X = np.asarray(X, np.int64)
+    K, m, d = X.shape
+    U = gen_lagrange_coeffs(alpha_s, beta_s, p)  # [N, K]
+    return _matmul_mod(U, X.reshape(K, m * d), p).reshape(len(alpha_s), m, d)
+
+
+def lcc_decode_with_points(f_eval, eval_points, target_points, p: int = FIELD_PRIME):
+    """Decode targets from evaluations (ref LCC_decoding_with_points:249-260)."""
+    f_eval = np.asarray(f_eval, np.int64)
+    N, m, d = f_eval.shape
+    U = gen_lagrange_coeffs(target_points, eval_points, p)
+    return _matmul_mod(U, f_eval.reshape(N, m * d), p).reshape(len(target_points), m, d)
+
+
+def gen_additive_shares(x: np.ndarray, n_out: int, p: int = FIELD_PRIME, rng=None):
+    """Split x into n_out additive shares summing to x mod p
+    (ref Gen_Additive_SS:214-224)."""
+    rng = rng or np.random.default_rng()
+    parts = rng.integers(0, p, size=(n_out - 1,) + x.shape, dtype=np.int64)
+    last = _mod(_mod(x, p) - parts.sum(axis=0), p)
+    return np.concatenate([parts, last[None]], axis=0)
+
+
+def pk_gen(sk: int, p: int = FIELD_PRIME, g: int = 5):
+    """g^sk mod p (ref my_pk_gen:263-268)."""
+    return pow(g, int(sk), p)
+
+
+def key_agreement(my_sk: int, their_pk: int, p: int = FIELD_PRIME, g: int = 5):
+    """DH shared key their_pk^my_sk mod p (ref my_key_agreement:271+)."""
+    return pow(int(their_pk), int(my_sk), p)

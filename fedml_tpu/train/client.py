@@ -69,7 +69,17 @@ def make_task_loss(task: str) -> Callable:
         total = jnp.sum(mask) * y.shape[-1]
         return loss, correct, total
 
-    return {"classification": classification, "nwp": nwp, "tag": tag}[task]
+    def segmentation(logits, y, mask):
+        loss = L.masked_pixel_ce(logits, y, mask)
+        correct, total = L.masked_pixel_accuracy_stats(logits, y, mask)
+        return loss, correct, total
+
+    return {
+        "classification": classification,
+        "nwp": nwp,
+        "tag": tag,
+        "segmentation": segmentation,
+    }[task]
 
 
 def make_local_train(

@@ -56,6 +56,27 @@ def masked_seq_accuracy_stats(logits, labels, mask, pad_token: int = 0):
     return correct, jnp.sum(tok_mask)
 
 
+SEG_IGNORE_INDEX = 255  # ref fedseg CE ignore_index (MyModelTrainer.py)
+
+
+def masked_pixel_ce(logits, labels, mask, ignore_index: int = SEG_IGNORE_INDEX):
+    """Per-pixel CE for segmentation, skipping ignore-index pixels
+    (ref fedseg/MyModelTrainer.py criterion: CrossEntropyLoss(ignore_index=255)).
+
+    logits [B, H, W, C], labels int [B, H, W], mask float [B]."""
+    safe_labels = jnp.where(labels == ignore_index, 0, labels)
+    per_px = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    px_mask = (labels != ignore_index).astype(jnp.float32) * mask[:, None, None]
+    return _safe_div(jnp.sum(per_px * px_mask), jnp.sum(px_mask))
+
+
+def masked_pixel_accuracy_stats(logits, labels, mask, ignore_index: int = SEG_IGNORE_INDEX):
+    pred = jnp.argmax(logits, axis=-1)
+    px_mask = (labels != ignore_index).astype(jnp.float32) * mask[:, None, None]
+    correct = jnp.sum((pred == labels).astype(jnp.float32) * px_mask)
+    return correct, jnp.sum(px_mask)
+
+
 def tree_sq_norm(tree):
     return sum(jnp.sum(jnp.square(x)) for x in jax.tree_util.tree_leaves(tree))
 
