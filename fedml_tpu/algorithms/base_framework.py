@@ -110,7 +110,10 @@ class DecentralizedWorkerManager(ClientManager):
         self.value = np.asarray(value, np.float64)
         self.rounds = rounds
         self.round_idx = 0
-        self._inbox: Dict[int, np.ndarray] = {}
+        # Keyed by (round, sender): a fast neighbor's round r+1 message must
+        # not complete (or overwrite a value in) the round-r barrier (ref
+        # decentralized_worker_manager.py:29-46 per-round barrier semantics).
+        self._inbox: Dict[tuple, np.ndarray] = {}
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_GOSSIP, self._on_gossip)
@@ -123,21 +126,22 @@ class DecentralizedWorkerManager(ClientManager):
             self.send_message(m)
 
     def _on_gossip(self, msg: Message):
-        self._inbox[msg.get_sender_id()] = msg.get("value")
+        self._inbox[(int(msg.get("round")), msg.get_sender_id())] = msg.get("value")
         in_neighbors = self.topology.get_in_neighbor_idx_list(self.rank)
-        if len(self._inbox) < len(in_neighbors):
-            return
-        # weighted mix with the confusion-matrix row (ref __train:41-46; the
-        # reference's symmetric manager returns the row for both in/out,
-        # symmetric_topology_manager.py:55-61)
-        w = self.topology.get_out_neighbor_weights(self.rank)
-        mixed = self.value * w[self.rank]
-        for j, v in self._inbox.items():
-            mixed = mixed + np.asarray(v) * w[j]
-        self.value = mixed
-        self._inbox.clear()
-        self.round_idx += 1
-        if self.round_idx >= self.rounds:
-            self.finish()
-        else:
+        # Advance while the *current* round's barrier is complete; buffered
+        # future-round values stay in the inbox until their round arrives.
+        while all((self.round_idx, j) in self._inbox for j in in_neighbors):
+            # weighted mix with the confusion-matrix row (ref __train:41-46;
+            # the reference's symmetric manager returns the row for both
+            # in/out, symmetric_topology_manager.py:55-61)
+            w = self.topology.get_out_neighbor_weights(self.rank)
+            mixed = self.value * w[self.rank]
+            for j in in_neighbors:
+                v = self._inbox.pop((self.round_idx, j))
+                mixed = mixed + np.asarray(v) * w[j]
+            self.value = mixed
+            self.round_idx += 1
+            if self.round_idx >= self.rounds:
+                self.finish()
+                return
             self.start_gossip()

@@ -10,7 +10,10 @@ ONE `lax.scan`: per iteration a vmapped SGD step then the mixing step
 ``params ← W @ params`` (the row-stochastic confusion matrix of
 partition/topology.py applied with einsum — gossip as a matmul on the MXU).
 Push-Sum additionally carries the ω weights (client_pushsum.py:38-45):
-x ← W(x), ω ← Wω, estimate z = x/ω — correct averaging on the asymmetric
+x ← Wᵀx, ω ← Wᵀω, estimate z = x/ω. Push-Sum's debiasing requires a
+*column-stochastic* mixing matrix (mass is pushed out along out-edges and
+must be conserved); topology managers produce row-stochastic W, so the
+pushsum variant mixes with Wᵀ — correct averaging on the asymmetric
 (directed) topologies where plain DSGD mixing is biased."""
 
 from __future__ import annotations
@@ -48,6 +51,12 @@ def make_decentralized_run(
     targets, ref BCELoss on logistic regression). variant: "dsgd" | "pushsum".
     """
     W = jnp.asarray(mixing_matrix, jnp.float32)
+    if variant == "pushsum":
+        # Row-stochastic W does not conserve Σx under mixing; Push-Sum's
+        # x/ω debias is only unbiased with column-stochastic mixing, so
+        # push along the transpose (each worker splits its mass over
+        # out-neighbors).
+        W = W.T
     N = W.shape[0]
     loss_fn = loss_fn or _binary_loss(model)
     grad_fn = jax.value_and_grad(loss_fn)

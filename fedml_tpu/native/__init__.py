@@ -86,6 +86,15 @@ def gather_rows(src: np.ndarray, order: np.ndarray, out: np.ndarray) -> None:
         out[...] = src[order]
         return
     order64 = np.ascontiguousarray(order, dtype=np.int64)
+    # The native path is a raw memcpy per row: an out-of-range index would be
+    # a silent OOB read, unlike numpy's IndexError. Validate first.
+    if order64.size and (
+        order64.min() < 0
+        or order64.max() >= src.shape[0]
+        or len(order64) > out.shape[0]
+    ):
+        out[...] = src[order]  # numpy raises the proper IndexError
+        return
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
     lib.fp_gather_rows(
         src.ctypes.data_as(ctypes.c_char_p),

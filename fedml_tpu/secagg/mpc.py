@@ -70,8 +70,13 @@ def bgw_encode(X: np.ndarray, N: int, T: int, p: int = FIELD_PRIME, rng=None):
         ]
     )  # [T+1, m, d]
     alphas = np.arange(1, N + 1, dtype=np.int64)
-    # Vandermonde [N, T+1] @ coeffs [T+1, m*d]
-    V = np.stack([np.power(alphas, t) % p for t in range(T + 1)], axis=1)
+    # Vandermonde [N, T+1] @ coeffs [T+1, m*d]. Columns built iteratively
+    # mod p: np.power(alphas, t) wraps int64 once N^T >= 2^63 and silently
+    # corrupts the shares; col[t-1]*alphas keeps intermediates < p^2 < 2^62.
+    V = np.empty((N, T + 1), np.int64)
+    V[:, 0] = 1
+    for t in range(1, T + 1):
+        V[:, t] = V[:, t - 1] * alphas % p
     flat = coeffs.reshape(T + 1, m * d)
     return _matmul_mod(V, flat, p).reshape(N, m, d)
 

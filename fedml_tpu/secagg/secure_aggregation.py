@@ -1,7 +1,18 @@
 """Secure aggregation protocol: pairwise-masked sums with DH-agreed seeds —
 the TurboAggregate capability (ref fedml_api/distributed/turboaggregate/
 TA_decentralized_worker.py + mpc_function.py) as a complete, testable
-protocol: the server learns ONLY the sum of client updates.
+protocol: in the aggregation path the server only ever combines masked
+uploads, so the protocol *structure* reveals only the sum of client updates.
+
+SECURITY NOTE — this is a protocol simulation, not a cryptographic
+implementation (matching the reference, whose field/DH parameters are the
+same scale): DH runs in Z_p* with p = 2^31−1, whose smooth group order makes
+discrete logs easy (Pohlig–Hellman), and pair keys are truncated to 31 bits
+before seeding the PRG, so the masks are brute-forceable. The 31-bit
+Mersenne field is the right choice for exact int64 share arithmetic; real
+deployments must swap the key agreement to a standard large group (X25519
+etc.) and expand seeds through a proper KDF/CSPRNG — the protocol logic
+(masking, cancellation, BGW dropout recovery) is unchanged by that swap.
 
 Fixed-point encode → field; client i's upload is
 ``x_i + Σ_{j>i} PRG(k_ij) − Σ_{j<i} PRG(k_ij)  (mod p)``

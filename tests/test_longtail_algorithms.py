@@ -186,6 +186,47 @@ def test_bgw_share_reconstruct():
         np.testing.assert_array_equal(rec, X)
 
 
+def test_bgw_large_n_t_no_overflow():
+    """N=40, T=13 puts naive np.power(alphas, T) past 2^63 — the Vandermonde
+    must be built mod p or shares silently corrupt (round-1 advisor find)."""
+    from fedml_tpu.secagg import bgw_decode, bgw_encode
+
+    rng = np.random.default_rng(11)
+    X = rng.integers(0, 100000, size=(2, 3)).astype(np.int64)
+    N, T = 40, 13
+    shares = bgw_encode(X, N, T, rng=rng)
+    idx = list(range(20, 20 + T + 1))
+    np.testing.assert_array_equal(bgw_decode(shares[idx], idx), X)
+
+
+def test_pushsum_debiased_average_on_directed_topology():
+    """With lr=0 the run is pure mixing: Push-Sum's x/ω must converge to the
+    true average of initial params even on an asymmetric (directed)
+    topology, which requires column-stochastic (Wᵀ) mixing — row-stochastic
+    W does not conserve the sum (round-1 advisor find)."""
+    from fedml_tpu.algorithms.decentralized import DecentralizedAPI
+    from fedml_tpu.partition.topology import AsymmetricTopologyManager
+
+    N, T, D = 6, 300, 4
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(N, T, D)).astype(np.float32)
+    y = rng.integers(0, 2, size=(N, T)).astype(np.float32)
+    topo = AsymmetricTopologyManager(N, undirected_neighbor_num=2, seed=3)
+    topo.generate_topology()
+    model = ModelDef(LogisticRegression(num_classes=1), (D,), 1, name="lr")
+    api = DecentralizedAPI(model, topo, lr=0.0, variant="pushsum")
+    target = jax.tree_util.tree_map(
+        lambda p: np.asarray(p).mean(axis=0), api.params
+    )
+    api.run(x, y)
+    for got, want in zip(
+        jax.tree_util.tree_leaves(api.params), jax.tree_util.tree_leaves(target)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.broadcast_to(want, got.shape), atol=1e-3
+        )
+
+
 def test_lcc_encode_decode():
     from fedml_tpu.secagg import lcc_decode_with_points, lcc_encode_with_points
 
