@@ -1,20 +1,34 @@
-"""Headline benchmark: FEMNIST-CNN FedAvg rounds/sec on the available device.
+"""Headline benchmark — north-star workload + accuracy loop + MFU + bf16.
 
-Workload parity with the reference's north-star config (BASELINE.json /
-benchmark/README.md:54): Federated-EMNIST geometry (28×28×1, 62 classes,
-power-law client shards ~226 samples), CNNOriginalFedAvg, 10 clients/round,
-batch 20, E=1, SGD lr 0.1. Data is synthetic with the real geometry (the real
-h5 is not vendored; shapes/FLOPs match, so throughput is representative).
+Prints ONE JSON line. Headline metric: FEMNIST-CNN FedAvg rounds/sec at the
+reference's north-star config (BASELINE.json / benchmark/README.md:54 —
+28×28×1, 62 classes, power-law shards, CNNOriginalFedAvg, 10 clients/round,
+batch 20, E=1, SGD lr 0.1). Extra keys on the same line:
 
-Baseline: the reference publishes no wall-clock numbers (SURVEY §6). The
-comparison constant below is an estimate of the reference's per-round time on
-its documented MPI path: 10 clients × ~12 local steps of the 1.2M-param CNN
-(~0.25 s on a V100 worker including per-round model transfer — the reference
-serializes the full state dict through JSON lists per message,
-message.py:47-59,76-79, which alone costs ~1 s for 1.2M floats) → ~0.5
-rounds/sec. Printed as `vs_baseline` = ours / 0.5.
+- ``accuracy_runs``: wall-clock-to-accuracy (VERDICT r1 #2) — MNIST-geometry
+  LR to the >75% reference target (benchmark/README.md:12) and FEMNIST-
+  geometry CNN to 80% (north star). Real MNIST/FEMNIST downloads are not
+  available in this environment, so both runs use the synthetic stand-ins
+  with the real geometry (femnist_synth latent-class generator) — stated
+  here explicitly per VERDICT; wall-clock includes jit compile time.
+- ``mfu``: XLA-costed FLOPs of the compiled round / measured round time /
+  per-chip peak (utils/profiling.py; peak table by device_kind).
+- ``bf16``: resnet56/CIFAR cross-silo shapes (benchmark/README.md:105),
+  device-synchronized round time fp32 vs bfloat16 compute dtype.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+MEASUREMENT NOTE (fixes round-1's inflated number): through the remote TPU
+tunnel `jax.block_until_ready` returns before the dispatch queue drains, so
+round-1's 65 rounds/s was dispatch rate, not compute. Every timed segment
+here ends with a host fetch of a round metric (``float(m["loss_sum"])``),
+which drains the queue in program order — the numbers are true end-to-end
+wall-clock including host-side batch stacking, which async dispatch is free
+to overlap with device compute.
+
+Baseline: the reference publishes no wall-clock numbers (SURVEY §6).
+``vs_baseline`` compares against an ESTIMATE of the reference's MPI path on
+its documented hardware: 10 clients × ~12 local steps of the 1.2M-param CNN
+plus full-state-dict JSON-list serialization per message
+(message.py:47-59,76-79) → ~0.5 rounds/sec. Labeled estimate, not measured.
 """
 
 from __future__ import annotations
@@ -22,16 +36,37 @@ from __future__ import annotations
 import json
 import time
 
-REF_ROUNDS_PER_SEC = 0.5  # estimated 8xV100 MPI reference (see module doc)
+REF_ROUNDS_PER_SEC = 0.5  # estimated reference MPI path (see module doc)
 
 
-def main():
-    import jax
+def _sync(metrics) -> float:
+    """Drain the device queue: host-fetch a scalar produced by the last
+    dispatched round (program order ⇒ everything before it is done)."""
+    return float(metrics["loss_sum"])
 
+
+def _timed_rounds(api, start: int, n: int) -> float:
+    """Seconds per round over n rounds, properly synchronized."""
+    t0 = time.perf_counter()
+    m = None
+    for r in range(start, start + n):
+        _, m = api.train_round(r)
+    _sync(m)
+    return (time.perf_counter() - t0) / n
+
+
+def _make_api(config, data, model):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    return FedAvgAPI(config, data, model)
+
+
+def _north_star(jax):
+    """FEMNIST-geometry CNN throughput + MFU."""
     from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
     from fedml_tpu.data.femnist_synth import femnist_synthetic
     from fedml_tpu.models import create_model
-    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.utils import profiling
 
     config = RunConfig(
         data=DataConfig(dataset="femnist", batch_size=20, pad_bucket=4),
@@ -48,29 +83,185 @@ def main():
     )
     data = femnist_synthetic(num_clients=128, seed=0)
     model = create_model("cnn", "femnist", (28, 28, 1), 62)
-    api = FedAvgAPI(config, data, model)
+    api = _make_api(config, data, model)
 
-    # Warmup: compile every bucketed shape the timed rounds will see.
-    warmup_rounds = 3
-    timed_rounds = 20
-    for r in range(warmup_rounds):
-        api.train_round(r)
-    jax.block_until_ready(api.global_vars)
+    warmup, timed = 3, 20
+    m = None
+    for r in range(warmup):
+        _, m = api.train_round(r)
+    _sync(m)
+    sec_per_round = _timed_rounds(api, warmup, timed)
+    flops = api.round_flops(warmup)
+    dtype = config.train.compute_dtype
+    return {
+        "rounds_per_sec": round(1.0 / sec_per_round, 4),
+        "flops_per_round": flops,
+        "achieved_tflops": round(flops / sec_per_round / 1e12, 3) if flops else None,
+        "mfu": (
+            round(profiling.mfu(flops, 1.0 / sec_per_round, dtype), 5)
+            if flops
+            else None
+        ),
+        "device": jax.devices()[0].device_kind,
+    }
 
+
+def _time_to_accuracy(
+    config, data, model, target: float, max_rounds: int, eval_every: int
+):
+    api = _make_api(config, data, model)
     t0 = time.perf_counter()
-    for r in range(warmup_rounds, warmup_rounds + timed_rounds):
+    acc, r = 0.0, -1
+    for r in range(max_rounds):
         api.train_round(r)
-    jax.block_until_ready(api.global_vars)
-    dt = time.perf_counter() - t0
+        if (r + 1) % eval_every == 0:
+            _, acc = api.evaluate_global()
+            if acc >= target:
+                break
+    wall = time.perf_counter() - t0
+    return {
+        "dataset": data.name,
+        "model": model.name,
+        "target": target,
+        "accuracy": round(float(acc), 4),
+        "reached": bool(acc >= target),
+        "rounds": r + 1,
+        "wall_clock_s": round(wall, 2),
+    }
 
-    rounds_per_sec = timed_rounds / dt
+
+def _accuracy_runs():
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.femnist_synth import femnist_synthetic
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    runs = []
+    # MNIST + LR to >75 (ref benchmark/README.md:12: 1000 clients, 10/round,
+    # SGD lr .03) on MNIST-geometry synthetic blobs.
+    data = synthetic_classification(
+        num_clients=1000,
+        num_classes=10,
+        feat_shape=(28, 28, 1),
+        samples_per_client=60,
+        partition_method="hetero",
+        seed=0,
+    )
+    model = create_model("lr", "mnist", (28, 28, 1), 10)
+    cfg = RunConfig(
+        data=DataConfig(batch_size=10, pad_bucket=4),
+        fed=FedConfig(
+            client_num_in_total=1000,
+            client_num_per_round=10,
+            comm_round=1,
+            epochs=1,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.03),
+        model="lr",
+    )
+    runs.append(_time_to_accuracy(cfg, data, model, 0.75, 100, 5))
+
+    # FEMNIST + CNN to 80% (north star; ref target 84.9 on real data at
+    # >1500 rounds, benchmark/README.md:54).
+    data = femnist_synthetic(num_clients=256, seed=0)
+    model = create_model("cnn", "femnist", (28, 28, 1), 62)
+    cfg = RunConfig(
+        data=DataConfig(batch_size=20, pad_bucket=4),
+        fed=FedConfig(
+            client_num_in_total=256,
+            client_num_per_round=10,
+            comm_round=1,
+            epochs=1,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        model="cnn",
+    )
+    runs.append(_time_to_accuracy(cfg, data, model, 0.80, 200, 10))
+    return runs
+
+
+def _bf16_cross_silo(jax):
+    """resnet56 @ CIFAR cross-silo shapes: fp32 vs bf16 compute dtype."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.base import stack_clients
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+    from fedml_tpu.algorithms.fedavg import client_sampling
+    from fedml_tpu.utils import profiling
+
+    data = synthetic_classification(
+        num_clients=10,
+        num_classes=10,
+        feat_shape=(32, 32, 3),
+        samples_per_client=512,
+        partition_method="homo",
+        ragged=False,
+        seed=0,
+    )
+    model = create_model("resnet56", "cifar10", (32, 32, 3), 10)
+    out = {}
+    for dt in ("float32", "bfloat16"):
+        cfg = RunConfig(
+            data=DataConfig(batch_size=64),
+            fed=FedConfig(
+                client_num_in_total=10,
+                client_num_per_round=10,
+                comm_round=1,
+                epochs=1,
+                frequency_of_the_test=10_000,
+            ),
+            train=TrainConfig(client_optimizer="sgd", lr=0.1, compute_dtype=dt),
+            model="resnet56",
+        )
+        api = _make_api(cfg, data, model)
+        batch = stack_clients(data, client_sampling(0, 10, 10), 64, seed=1)
+        placed = jax.tree_util.tree_map(
+            jnp.asarray, api._place_batch(batch, jax.random.PRNGKey(1))
+        )
+        gv, m = api.round_fn(api.global_vars, *placed)  # compile
+        _sync(m)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            gv, m = api.round_fn(gv, *placed)
+        _sync(m)
+        sec = (time.perf_counter() - t0) / 5
+        flops = api.round_flops(0)
+        out[dt] = {
+            "round_ms": round(sec * 1000, 1),
+            "mfu": (
+                round(profiling.mfu(flops, 1.0 / sec, dt), 5) if flops else None
+            ),
+        }
+    out["speedup_bf16_over_fp32"] = round(
+        out["float32"]["round_ms"] / out["bfloat16"]["round_ms"], 2
+    )
+    return out
+
+
+def main():
+    import jax
+
+    north = _north_star(jax)
+    acc_runs = _accuracy_runs()
+    bf16 = _bf16_cross_silo(jax)
+
     print(
         json.dumps(
             {
                 "metric": "femnist_cnn_fedavg_rounds_per_sec",
-                "value": round(rounds_per_sec, 4),
+                "value": north["rounds_per_sec"],
                 "unit": "rounds/sec",
-                "vs_baseline": round(rounds_per_sec / REF_ROUNDS_PER_SEC, 2),
+                "vs_baseline": round(north["rounds_per_sec"] / REF_ROUNDS_PER_SEC, 2),
+                "baseline_is_estimate": True,
+                "sync": "host-fetch (block_until_ready is a no-op through the remote tunnel; r1 number was dispatch rate)",
+                "north_star": north,
+                "accuracy_runs": acc_runs,
+                "bf16_cross_silo_resnet56": bf16,
+                "data_note": "synthetic stand-ins with real dataset geometry; real downloads unavailable",
             }
         )
     )

@@ -28,7 +28,7 @@ from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import FederatedDataset, stack_clients
 from fedml_tpu.models import ModelDef
 from fedml_tpu.train.client import make_local_train
-from fedml_tpu.train.evaluate import evaluate, make_eval_fn
+from fedml_tpu.train.evaluate import make_eval_fn
 
 
 def weighted_average(stacked_tree, weights):
@@ -114,6 +114,9 @@ class FedAvgAPI:
     # Subclasses that read the pre-round global model after the round call
     # (e.g. FedOpt's pseudo-gradient) must disable buffer donation.
     _donate = True
+    # Subclasses with their own batch placement (the sharded API pads +
+    # shards host arrays over the mesh) disable the HBM-resident store.
+    _use_device_store = True
 
     def __init__(
         self,
@@ -134,6 +137,16 @@ class FedAvgAPI:
         self.round_fn = self._build_round_fn(local_train_fn)
         self.eval_fn = make_eval_fn(model, task)
         self.history: list = []
+        self._store = None
+        if self._use_device_store and config.data.device_cache:
+            from fedml_tpu.data.device_store import DeviceDataStore, fits_on_device
+
+            if fits_on_device(data):
+                try:
+                    self._store = DeviceDataStore(data)
+                except Exception:
+                    self._store = None  # ragged feature shapes etc.
+        self._test_dev = None
 
     def _build_round_fn(self, local_train_fn):
         return make_fedavg_round(
@@ -149,18 +162,69 @@ class FedAvgAPI:
         sampled = client_sampling(
             round_idx, self.data.num_clients, cfg.fed.client_num_per_round
         )
-        batch = stack_clients(
-            self.data,
-            sampled,
-            cfg.data.batch_size,
-            seed=cfg.seed * 1_000_003 + round_idx,
-            pad_bucket=cfg.data.pad_bucket,
-        )
+        batch = self._round_batch(sampled, round_idx)
         rng = jax.random.fold_in(self.rng, round_idx + 1)
         self.global_vars, metrics = self.round_fn(
             self.global_vars, *self._place_batch(batch, rng)
         )
         return sampled, metrics
+
+    def _stack(self, client_indices, seed: int):
+        """Clients as a dense batch: device-store gather (only an index
+        matrix crosses the wire) or host stacking fallback. Both paths use
+        the same seed/bucket contract, so the math is identical."""
+        cfg = self.config
+        if self._store is not None:
+            return self._store.round_batch(
+                client_indices,
+                cfg.data.batch_size,
+                seed=seed,
+                pad_bucket=cfg.data.pad_bucket,
+            )
+        return stack_clients(
+            self.data,
+            client_indices,
+            cfg.data.batch_size,
+            seed=seed,
+            pad_bucket=cfg.data.pad_bucket,
+        )
+
+    def _round_batch(self, sampled, round_idx: int):
+        return self._stack(sampled, self.config.seed * 1_000_003 + round_idx)
+
+    def evaluate_global(self):
+        """(loss, acc) of the global model on the central test set, with the
+        padded test batches cached on device (the host arrays would
+        otherwise be re-shipped every eval)."""
+        from fedml_tpu.train.evaluate import pad_to_batches
+
+        if self._test_dev is None:
+            xb, yb, mb = pad_to_batches(
+                np.asarray(self.data.test_x), np.asarray(self.data.test_y), 256
+            )
+            self._test_dev = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
+        m = self.eval_fn(self.global_vars, *self._test_dev)
+        count = float(m["count"])
+        return (
+            float(m["loss_sum"]) / max(count, 1e-9),
+            float(m["correct"]) / max(count, 1e-9),
+        )
+
+    def round_flops(self, round_idx: int = 0):
+        """XLA-costed FLOPs of one round call at this round's batch shapes
+        (None if the backend exposes no cost model). Lowering reuses the
+        jit cache, so this is cheap after the first round has compiled."""
+        from fedml_tpu.utils.profiling import compiled_flops
+
+        cfg = self.config
+        sampled = client_sampling(
+            round_idx, self.data.num_clients, cfg.fed.client_num_per_round
+        )
+        batch = self._round_batch(sampled, round_idx)
+        rng = jax.random.fold_in(self.rng, round_idx + 1)
+        return compiled_flops(
+            self.round_fn, self.global_vars, *self._place_batch(batch, rng)
+        )
 
     def _place_batch(self, batch, round_rng):
         """Device placement hook — the sharded subclass pads the client axis
@@ -190,15 +254,7 @@ class FedAvgAPI:
                 round_idx % cfg.fed.frequency_of_the_test == 0
                 or round_idx == cfg.fed.comm_round - 1
             ):
-                loss, acc = evaluate(
-                    self.model,
-                    self.global_vars,
-                    self.data.test_x,
-                    self.data.test_y,
-                    task=self.task,
-                    eval_fn=self.eval_fn,
-                )
-                row["Test/Loss"], row["Test/Acc"] = loss, acc
+                row["Test/Loss"], row["Test/Acc"] = self.evaluate_global()
             self.history.append(row)
             self.log_fn(row)
             final = row

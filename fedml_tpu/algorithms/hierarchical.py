@@ -22,7 +22,6 @@ import jax
 import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, weighted_average
-from fedml_tpu.data.base import stack_clients
 
 
 def assign_groups(num_clients: int, group_num: int, seed: int = 0) -> List[np.ndarray]:
@@ -68,13 +67,9 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
                 continue
             w_group = w_global
             for sub in range(cfg.fed.group_comm_round):
-                batch = stack_clients(
-                    self.data,
+                batch = self._stack(
                     g_clients,
-                    cfg.data.batch_size,
-                    seed=cfg.seed * 1_000_003
-                    + round_idx * 131 + gi * 17 + sub,
-                    pad_bucket=cfg.data.pad_bucket,
+                    cfg.seed * 1_000_003 + round_idx * 131 + gi * 17 + sub,
                 )
                 rng = jax.random.fold_in(
                     self.rng, (round_idx + 1) * 1009 + gi * 31 + sub

@@ -29,6 +29,11 @@ class DataConfig:
     # Bucket padded per-client sample counts to multiples of this to bound the
     # number of distinct jit shapes (see data/base.py).
     pad_bucket: int = 1
+    # Keep the whole dataset resident in device HBM and gather sampled
+    # clients on-device each round (data/device_store.py) — avoids the
+    # per-round host->device batch transfer. Auto-falls-back to host
+    # stacking when the dataset exceeds the HBM budget guard.
+    device_cache: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +64,10 @@ class TrainConfig:
     # FedProx proximal term; 0 = plain FedAvg. The reference's distributed
     # fedprox omits mu entirely (SURVEY §2b) — fixed here.
     prox_mu: float = 0.0
+    # Mixed-precision policy: params + optimizer state stay float32 (master
+    # weights); forward/backward run in this dtype. "bfloat16" is the TPU
+    # MXU-native dtype (the reference is fp32-only torch).
+    compute_dtype: str = "float32"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -40,6 +40,20 @@ def _next_pow2(x: int) -> int:
     return 1 << (x - 1).bit_length() if x > 1 else 1
 
 
+def bucket_steps(ns: Sequence[int], batch_size: int, pad_bucket: int):
+    """Shared shape contract for a stacked client batch: given per-client
+    sample counts, return (steps, bs, cap). Used by BOTH host stacking
+    (:func:`stack_clients`) and the device store
+    (data/device_store.py) — one definition, so the two paths can never
+    diverge. ``batch_size == -1`` = full batch (oracle mode)."""
+    max_n = max(ns)
+    bs = max_n if batch_size == -1 else batch_size
+    steps = _ceil_to(_ceil_to(max_n, bs) // bs, pad_bucket)
+    if batch_size != -1:
+        steps = _next_pow2(steps)
+    return steps, bs, steps * bs
+
+
 @dataclasses.dataclass
 class ClientBatch:
     """Dense, device-ready data for a set of sampled clients.
@@ -118,12 +132,7 @@ def stack_clients(
     (full-batch mode is exempt: S is 1 there, but the batch dim varies).
     """
     ns = [len(data.client_y[i]) for i in client_indices]
-    max_n = max(ns)
-    bs = max_n if batch_size == -1 else batch_size
-    steps = _ceil_to(_ceil_to(max_n, bs) // bs, pad_bucket)
-    if batch_size != -1:
-        steps = _next_pow2(steps)
-    cap = steps * bs
+    steps, bs, cap = bucket_steps(ns, batch_size, pad_bucket)
 
     rng = np.random.default_rng(seed)
     feat_shape = data.client_x[client_indices[0]].shape[1:]

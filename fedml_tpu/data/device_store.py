@@ -1,0 +1,98 @@
+"""HBM-resident federated data store.
+
+The reference re-materialises every sampled client's tensors on the training
+device each round (fedavg_api.py:59-63 re-points Client objects;
+my_model_trainer_classification.py:22 `.to(device)` per local train). On TPU
+— especially through a remote-device transport, where host→device bandwidth
+can be O(10 MB/s) — shipping the stacked batch every round dominates the
+round (measured: 1.3 s transfer vs 74 ms compute for the north-star CNN
+round). The TPU-native design: upload the *flat concatenation* of all client
+shards to HBM once, and per round send only a [C, S·B] int32 index matrix
+(tens of KB); the sampled clients' samples are gathered on-device.
+
+This also pins compiled shapes: the index matrix is bucketed exactly like
+:func:`fedml_tpu.data.base.stack_clients`, so rounds reuse the same small
+set of jitted shapes, and the per-round host work is building a few KB of
+indices instead of copying the batch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.data.base import ClientBatch, FederatedDataset, bucket_steps
+
+# HBM budget guard: datasets larger than this stay on host (override with
+# env FEDML_TPU_DEVICE_CACHE_MAX_BYTES; v5e has 16 GB per chip).
+_DEFAULT_MAX_BYTES = 4_000_000_000
+
+
+def fits_on_device(data: FederatedDataset) -> bool:
+    cap = int(
+        os.environ.get("FEDML_TPU_DEVICE_CACHE_MAX_BYTES", _DEFAULT_MAX_BYTES)
+    )
+    total = sum(cx.nbytes for cx in data.client_x) + sum(
+        cy.nbytes for cy in data.client_y
+    )
+    return total <= cap
+
+
+@jax.jit
+def _gather(flat_x, flat_y, idx, mask):
+    """Gather + zero padded slots (padded indices point at row 0; zeroing
+    keeps the result bit-identical to host stack_clients, which zero-pads)."""
+    x = jnp.take(flat_x, idx, axis=0)
+    y = jnp.take(flat_y, idx, axis=0)
+    mx = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim))
+    my = mask.reshape(mask.shape + (1,) * (y.ndim - mask.ndim))
+    return x * mx.astype(x.dtype), y * my.astype(y.dtype)
+
+
+class DeviceDataStore:
+    """Upload-once, gather-per-round client data store."""
+
+    def __init__(self, data: FederatedDataset):
+        counts = data.train_sample_counts
+        self.offsets = np.concatenate([[0], np.cumsum(counts)])
+        self.counts = counts
+        self.flat_x = jnp.asarray(np.concatenate(data.client_x, axis=0))
+        self.flat_y = jnp.asarray(np.concatenate(data.client_y, axis=0))
+
+    def round_batch(
+        self,
+        client_indices: Sequence[int],
+        batch_size: int,
+        seed: int = 0,
+        pad_bucket: int = 1,
+        shuffle: bool = True,
+    ) -> ClientBatch:
+        """Device-array ClientBatch for the sampled clients. Same bucketed
+        shape contract as :func:`stack_clients`; padded slots index row 0
+        and are mask-0."""
+        ns = [int(self.counts[i]) for i in client_indices]
+        steps, bs, cap = bucket_steps(ns, batch_size, pad_bucket)
+
+        rng = np.random.default_rng(seed)
+        C = len(client_indices)
+        idx = np.zeros((C, cap), dtype=np.int32)
+        mask = np.zeros((C, cap), dtype=np.float32)
+        for j, ci in enumerate(client_indices):
+            n = ns[j]
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            idx[j, :n] = self.offsets[ci] + order
+            mask[j, :n] = 1.0
+        mask_dev = jnp.asarray(mask)
+        x, y = _gather(self.flat_x, self.flat_y, jnp.asarray(idx), mask_dev)
+        feat = self.flat_x.shape[1:]
+        lab = self.flat_y.shape[1:]
+        return ClientBatch(
+            x=x.reshape((C, steps, bs) + feat),
+            y=y.reshape((C, steps, bs) + lab),
+            mask=mask_dev.reshape((C, steps, bs)),
+            num_samples=np.array(ns, dtype=np.float32),
+        )

@@ -95,6 +95,8 @@ class DistributedFedAvgAPI(FedAvgAPI):
     swaps the round function for the shard_map version and pads + places each
     round's batch sharded over the mesh."""
 
+    _use_device_store = False  # batches are padded + sharded from host
+
     def __init__(
         self,
         config: RunConfig,

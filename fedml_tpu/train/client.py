@@ -97,6 +97,16 @@ def make_local_train(
     """
     opt = build_client_optimizer(tc)
     task_loss = make_task_loss(task)
+    cdt = jnp.dtype(tc.compute_dtype)
+    mixed = cdt != jnp.dtype(jnp.float32)
+
+    def cast_floats(tree, dtype):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+            else a,
+            tree,
+        )
 
     def local_train(variables, x, y, mask, rng):
         params0, extra0 = _split_vars(variables)
@@ -107,9 +117,23 @@ def make_local_train(
         m_flat = mask.reshape((n_flat,))
 
         def loss_fn(params, extra, xb, yb, mb, step_rng):
+            # Mixed precision: fp32 master params are cast to the compute
+            # dtype inside the differentiated function (the cast is linear,
+            # so grads come back fp32); the loss itself is reduced in fp32.
+            if mixed:
+                params_c = cast_floats(params, cdt)
+                extra_c = cast_floats(extra, cdt)
+                xb_c = cast_floats(xb, cdt)
+            else:
+                params_c, extra_c, xb_c = params, extra, xb
             logits, new_vars = model.apply(
-                {"params": params, **extra}, xb, train=True, rng=step_rng
+                {"params": params_c, **extra_c}, xb_c, train=True, rng=step_rng
             )
+            logits = logits.astype(jnp.float32)
+            if mixed:
+                # Mutable collections (BN stats) return in compute dtype;
+                # restore fp32 so the scan carry keeps stable dtypes.
+                new_vars = cast_floats(new_vars, jnp.float32)
             task_l, correct, total = task_loss(logits, yb, mb)
             loss = task_l
             if tc.prox_mu:
