@@ -137,6 +137,11 @@ class FedAvgAPI:
         self.round_fn = self._build_round_fn(local_train_fn)
         self.eval_fn = make_eval_fn(model, task)
         self.history: list = []
+        # Resume support: CLI/--resume sets global_vars + start_round from a
+        # checkpoint; train() continues the round loop from there (the
+        # round-seeded sampling makes the continuation identical to the
+        # uninterrupted run).
+        self.start_round = 0
         self._store = None
         if self._use_device_store and config.data.device_cache:
             from fedml_tpu.data.device_store import DeviceDataStore, fits_on_device
@@ -240,7 +245,7 @@ class FedAvgAPI:
     def train(self) -> Dict[str, float]:
         cfg = self.config
         final = {}
-        for round_idx in range(cfg.fed.comm_round):
+        for round_idx in range(self.start_round, cfg.fed.comm_round):
             t0 = time.perf_counter()
             _, metrics = self.train_round(round_idx)
             count = float(metrics["count"])

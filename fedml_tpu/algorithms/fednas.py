@@ -6,10 +6,19 @@ model/cv/darts/architect.py:32-44).
 
 Each client alternates (a) architecture steps — ∇α L_val — and (b) weight
 steps — ∇w L_train — on its local split; the server sample-weight-averages w
-and α separately and records the derived genotype per round. This uses the
-first-order DARTS approximation (the reference's `--arch_search_method
-DARTS` default path; its 2nd-order unrolled variant, architect.py:32-44,
-is grad-of-grad in JAX and can slot into `arch_grad` later)."""
+and α separately and records the derived genotype per round.
+
+``arch_grad`` selects the architect:
+
+- ``"first"`` — first-order DARTS (the reference's default path): ∇α of the
+  validation loss at the current weights.
+- ``"second"`` — the unrolled architect (ref architect.py:32-44
+  `_compute_unrolled_model`): ∇α L_val(w − ξ·∇w L_train(w, α), α). The
+  reference approximates the resulting Hessian-vector product by finite
+  differences (architect.py `_hessian_vector_product`); here JAX
+  differentiates *through* the inner SGD step exactly (grad-of-grad),
+  which is both simpler and exact — the TPU-native flex the survey
+  schedules for this slot."""
 
 from __future__ import annotations
 
@@ -43,7 +52,13 @@ class FedNASAPI:
         arch_lr: float = 3e-3,
         batch_size: int = 16,
         seed: int = 0,
+        arch_grad: str = "first",
+        xi: float = None,
     ):
+        if arch_grad not in ("first", "second"):
+            raise ValueError(f"arch_grad must be 'first' or 'second', got {arch_grad!r}")
+        self.arch_grad = arch_grad
+        self.xi = w_lr if xi is None else xi  # unrolled inner-step lr (ref architect.py:34)
         self.data = data
         self.net = DARTSNetwork(
             num_classes=num_classes, ch=ch, cells=cells, steps=steps
@@ -57,7 +72,11 @@ class FedNASAPI:
         self.batch_size = batch_size
         self.genotype_history: List = []
         self._train_step = jax.jit(self._make_step(update_arch=False))
-        self._arch_step = jax.jit(self._make_step(update_arch=True))
+        self._arch_step = jax.jit(
+            self._make_second_order_arch_step()
+            if arch_grad == "second"
+            else self._make_step(update_arch=True)
+        )
 
     def _make_step(self, update_arch: bool):
         net = self.net
@@ -93,6 +112,42 @@ class FedNASAPI:
 
         return step
 
+    def _make_second_order_arch_step(self):
+        """Unrolled architect (ref architect.py:32-44): α-gradient of the
+        validation loss at w' = w − ξ·∇w L_train(w, α). JAX differentiates
+        through the inner step exactly — no finite-difference HVP. BN stats
+        are read, not mutated, inside the unrolled evaluation (weight steps
+        own the running stats)."""
+        net, opt, xi = self.net, self.arch_opt, self.xi
+
+        def raw_loss(arch, weights, bs, x, y):
+            variables = {"params": {**weights, **arch}}
+            if bs:
+                variables["batch_stats"] = bs
+            logits, _ = net.apply(variables, x, train=True, mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        def step(variables, opt_state, xv, yv, xt, yt):
+            arch, weights = _split_arch(variables["params"])
+            bs = variables.get("batch_stats", {})
+
+            def unrolled_val_loss(arch_p):
+                g_w = jax.grad(raw_loss, argnums=1)(arch_p, weights, bs, xt, yt)
+                w2 = jax.tree_util.tree_map(
+                    lambda w, g: w - xi * g, weights, g_w
+                )
+                return raw_loss(arch_p, w2, bs, xv, yv)
+
+            loss, grads = jax.value_and_grad(unrolled_val_loss)(arch)
+            updates, opt_state = opt.update(grads, opt_state, arch)
+            arch = optax.apply_updates(arch, updates)
+            out = {"params": {**weights, **arch}}
+            if bs:
+                out["batch_stats"] = bs
+            return out, opt_state, loss
+
+        return step
+
     def _local_search(self, variables, x, y, epochs: int):
         """ref FedNASTrainer.search: per epoch, arch step on val half +
         weight steps on train half."""
@@ -107,9 +162,20 @@ class FedNASAPI:
         loss = jnp.zeros(())
         for _ in range(epochs):
             for s in range(0, max(len(yv) - B + 1, 1), B):
-                variables, a_os, _ = self._arch_step(
-                    variables, a_os, jnp.asarray(xv[s : s + B]), jnp.asarray(yv[s : s + B])
-                )
+                if self.arch_grad == "second":
+                    t = s % max(len(yt) - B + 1, 1)
+                    variables, a_os, _ = self._arch_step(
+                        variables,
+                        a_os,
+                        jnp.asarray(xv[s : s + B]),
+                        jnp.asarray(yv[s : s + B]),
+                        jnp.asarray(xt[t : t + B]),
+                        jnp.asarray(yt[t : t + B]),
+                    )
+                else:
+                    variables, a_os, _ = self._arch_step(
+                        variables, a_os, jnp.asarray(xv[s : s + B]), jnp.asarray(yv[s : s + B])
+                    )
             for s in range(0, max(len(yt) - B + 1, 1), B):
                 variables, w_os, loss = self._train_step(
                     variables, w_os, jnp.asarray(xt[s : s + B]), jnp.asarray(yt[s : s + B])

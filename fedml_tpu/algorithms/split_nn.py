@@ -29,6 +29,40 @@ import optax
 from fedml_tpu.models import ModelDef
 
 
+def default_split_models(input_shape, num_classes: int, width: int = 32):
+    """Default bottom/top cut for the CLI: a conv feature extractor on the
+    client, dense head on the server (the reference cuts its CNN the same
+    way — clients hold convs, server holds the classifier,
+    SplitNNAPI.py:9-40)."""
+    import flax.linen as nn
+
+    class Bottom(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            if x.ndim == 2:  # flat features
+                return nn.relu(nn.Dense(width)(x))
+            x = nn.relu(nn.Conv(width, (3, 3), strides=(2, 2))(x))
+            x = nn.relu(nn.Conv(width, (3, 3), strides=(2, 2))(x))
+            return x.reshape((x.shape[0], -1))
+
+    class Top(nn.Module):
+        @nn.compact
+        def __call__(self, a, train=False):
+            a = nn.relu(nn.Dense(2 * width)(a))
+            return nn.Dense(num_classes)(a)
+
+    bottom = ModelDef(Bottom(), tuple(input_shape), num_classes, name="split_bottom")
+    feat_dim = (
+        width
+        if len(input_shape) == 1
+        else width
+        * max(1, (input_shape[0] + 3) // 4)
+        * max(1, (input_shape[1] + 3) // 4)
+    )
+    top = ModelDef(Top(), (feat_dim,), num_classes, name="split_top")
+    return bottom, top
+
+
 class SplitNNAPI:
     """Fused split-learning simulator over a client ring."""
 

@@ -20,6 +20,7 @@ TASKS = {
     "stackoverflow_lr": "tag",
     "stackoverflow_nwp": "nwp",
     "synthetic": "classification",
+    "seg_synth": "segmentation",
 }
 
 
@@ -55,6 +56,10 @@ def load(config) -> FederatedDataset:
         return synthetic_fedprox(
             alpha=alpha, beta=beta, num_clients=n_clients, seed=config.seed
         )
+    if name == "seg_synth":
+        from fedml_tpu.data.synthetic import synthetic_segmentation
+
+        return synthetic_segmentation(num_clients=n_clients, seed=config.seed)
     if name == "femnist_synth":
         from fedml_tpu.data.femnist_synth import femnist_synthetic
 

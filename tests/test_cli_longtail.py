@@ -1,0 +1,178 @@
+"""CLI completeness (VERDICT r1 #7): every algorithm package reachable from
+one command, plus --resume kill-and-continue and the second-order DARTS
+architect."""
+
+import json
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from fedml_tpu.cli import ALGORITHMS, main
+
+
+def _invoke(args):
+    result = CliRunner().invoke(main, args)
+    assert result.exit_code == 0, result.output
+    return json.loads(result.output.strip().splitlines()[-1])
+
+
+BASE = [
+    "--client_num_in_total", "3",
+    "--client_num_per_round", "3",
+    "--comm_round", "1",
+    "--batch_size", "8",
+]
+
+
+@pytest.mark.parametrize(
+    "algorithm,extra",
+    [
+        ("fedgkt", ["--dataset", "synthetic", "--lr", "0.05"]),
+        ("fedgan", ["--dataset", "synthetic", "--lr", "2e-4"]),
+        ("fedseg", ["--dataset", "seg_synth", "--model", "segnet", "--lr", "0.05"]),
+        ("fednas", ["--dataset", "synthetic", "--batch_size", "8"]),
+        ("split_nn", ["--dataset", "synthetic", "--lr", "0.1"]),
+        ("vertical_fl", ["--dataset", "synthetic", "--lr", "0.05"]),
+        ("decentralized", ["--dataset", "synthetic", "--lr", "0.1"]),
+        ("secagg", ["--dataset", "synthetic"]),
+    ],
+)
+def test_every_longtail_algorithm_reachable(algorithm, extra):
+    out = _invoke(["--algorithm", algorithm] + BASE + extra)
+    assert out  # one JSON row with run results
+    if algorithm == "secagg":
+        assert out["secure_sum_ok"] is True
+        assert out["dropped"] is not None  # dropout recovery exercised
+
+
+def test_cli_algorithm_tuple_is_complete():
+    """Guard: every algorithms/ package is wired (the r1 gap was 6/15)."""
+    assert set(ALGORITHMS) >= {
+        "fedavg", "fedopt", "fedprox", "fednova", "hierarchical",
+        "fedavg_robust", "fedgkt", "fedgan", "fedseg", "fednas",
+        "split_nn", "vertical_fl", "decentralized", "secagg",
+    }
+
+
+def test_resume_reproduces_uninterrupted_run(tmp_path):
+    """Kill-and-resume == uninterrupted: run 4 rounds straight; run 2 rounds,
+    'crash', resume from the checkpoint for rounds 2-3; final accuracy and
+    losses must match exactly (round-seeded sampling + restored params)."""
+    common = [
+        "--dataset", "synthetic", "--model", "lr",
+        "--client_num_in_total", "6", "--client_num_per_round", "3",
+        "--batch_size", "8", "--lr", "0.1",
+        "--frequency_of_the_test", "1",
+    ]
+    full = _invoke(common + ["--comm_round", "4"])
+
+    ck = str(tmp_path / "ck")
+    _invoke(common + ["--comm_round", "2", "--checkpoint_path", ck])
+    resumed = _invoke(
+        common + ["--comm_round", "4", "--checkpoint_path", ck, "--resume"]
+    )
+    assert resumed["round"] == full["round"] == 3
+    np.testing.assert_allclose(resumed["Test/Acc"], full["Test/Acc"], rtol=1e-6)
+    np.testing.assert_allclose(resumed["Test/Loss"], full["Test/Loss"], rtol=1e-5)
+
+
+def test_resume_from_midrun_crash(tmp_path, monkeypatch):
+    """The periodic (test-round) checkpoint must carry 'next round to run':
+    crash DURING round 2 (after round 1's save), resume, and match the
+    uninterrupted run exactly — guards the r2 off-by-one where a resumed
+    run re-applied an already-applied round."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    common = [
+        "--dataset", "synthetic", "--model", "lr",
+        "--client_num_in_total", "6", "--client_num_per_round", "3",
+        "--batch_size", "8", "--lr", "0.1",
+        "--frequency_of_the_test", "1",
+    ]
+    full = _invoke(common + ["--comm_round", "4"])
+
+    ck = str(tmp_path / "crash_ck")
+    orig = FedAvgAPI.train_round
+
+    def crashing(self, round_idx):
+        if round_idx == 2:
+            raise RuntimeError("simulated kill")
+        return orig(self, round_idx)
+
+    monkeypatch.setattr(FedAvgAPI, "train_round", crashing)
+    result = CliRunner().invoke(
+        main, common + ["--comm_round", "4", "--checkpoint_path", ck]
+    )
+    assert result.exit_code != 0  # crashed mid-run as intended
+    monkeypatch.setattr(FedAvgAPI, "train_round", orig)
+
+    resumed = _invoke(
+        common + ["--comm_round", "4", "--checkpoint_path", ck, "--resume"]
+    )
+    assert resumed["round"] == full["round"] == 3
+    np.testing.assert_allclose(resumed["Test/Acc"], full["Test/Acc"], rtol=1e-6)
+    np.testing.assert_allclose(resumed["Test/Loss"], full["Test/Loss"], rtol=1e-5)
+
+
+def test_resume_restores_server_opt_state(tmp_path):
+    """FedOpt + Adam: the server moments must survive kill-and-resume (the
+    checkpoint subsystem persists opt state; the CLI must round-trip it)."""
+    common = [
+        "--dataset", "synthetic", "--model", "lr",
+        "--client_num_in_total", "6", "--client_num_per_round", "3",
+        "--batch_size", "8", "--lr", "0.1",
+        "--frequency_of_the_test", "1",
+        "--algorithm", "fedopt", "--server_optimizer", "adam",
+        "--server_lr", "0.05",
+    ]
+    full = _invoke(common + ["--comm_round", "4"])
+    ck = str(tmp_path / "fedopt_ck")
+    _invoke(common + ["--comm_round", "2", "--checkpoint_path", ck])
+    resumed = _invoke(
+        common + ["--comm_round", "4", "--checkpoint_path", ck, "--resume"]
+    )
+    np.testing.assert_allclose(resumed["Test/Loss"], full["Test/Loss"], rtol=1e-5)
+    np.testing.assert_allclose(resumed["Test/Acc"], full["Test/Acc"], rtol=1e-6)
+
+
+def test_second_order_darts_differs_from_first():
+    """arch_grad='second' must run and move α differently from first-order
+    (the unrolled term ξ·∇²L is nonzero on a real problem)."""
+    from fedml_tpu.algorithms.fednas import FedNASAPI
+    from fedml_tpu.data.synthetic import synthetic_classification
+
+    data = synthetic_classification(
+        num_clients=2, num_classes=3, feat_shape=(8, 8, 3),
+        samples_per_client=32, partition_method="homo", ragged=False, seed=1,
+    )
+    alphas = {}
+    for mode in ("first", "second"):
+        api = FedNASAPI(
+            data, num_classes=3, input_shape=(8, 8, 3), ch=4, cells=1,
+            steps=2, batch_size=8, seed=0, arch_grad=mode,
+        )
+        before = np.asarray(api.variables["params"]["alpha_normal"]).copy()
+        api.train_round(0, client_num_per_round=2, epochs=1)
+        after = np.asarray(api.variables["params"]["alpha_normal"])
+        assert not np.allclose(before, after)
+        alphas[mode] = after
+    assert not np.allclose(alphas["first"], alphas["second"])
+
+
+def test_cli_profile_dir_writes_trace(tmp_path):
+    import os
+
+    prof = tmp_path / "prof"
+    _invoke(
+        [
+            "--dataset", "synthetic", "--model", "lr",
+            "--client_num_in_total", "3", "--client_num_per_round", "3",
+            "--comm_round", "1", "--batch_size", "8",
+            "--profile_dir", str(prof),
+        ]
+    )
+    # jax.profiler writes plugins/profile/<ts>/*; presence of anything is
+    # the contract
+    found = any(os.scandir(prof)) if prof.exists() else False
+    assert found
