@@ -229,22 +229,25 @@ class FedAvgClientManager(ClientManager):
         self.send_message(out)
 
 
-def run_loopback_federation(
+def run_federation(
     config: RunConfig,
     data: FederatedDataset,
     model: ModelDef,
+    comm_factory,
     task: str = "classification",
     log_fn=None,
 ):
-    """One-process federation over the loopback hub: 1 server + K client
-    actors in threads — the transport-path analog of the reference's mpirun
-    smoke runs (CI-script-framework.sh:16-23), but with a real exit-code/
-    join discipline. Returns the server manager (global_vars, history)."""
-    hub = LoopbackHub()
+    """One-process federation over any transport: 1 server + K client actors
+    in threads, each on ``comm_factory(rank)`` (a BaseCommManager) — the
+    transport-path analog of the reference's mpirun smoke runs
+    (CI-script-framework.sh:16-23), but with a real exit-code/join
+    discipline, and pluggable across loopback/gRPC/MQTT exactly like the
+    reference's ``--backend`` switch (client_manager.py:20-33). Returns the
+    server manager (global_vars, history)."""
     K = config.fed.client_num_per_round
     server = FedAvgServerManager(
         config,
-        LoopbackCommManager(hub, 0),
+        comm_factory(0),
         model,
         data=data,
         task=task,
@@ -257,7 +260,7 @@ def run_loopback_federation(
     clients = [
         FedAvgClientManager(
             config,
-            LoopbackCommManager(hub, rank),
+            comm_factory(rank),
             rank,
             LocalTrainer(config, data, model, task, local_train_fn=shared_train),
         )
@@ -293,3 +296,43 @@ def run_loopback_federation(
         if t.is_alive():
             raise RuntimeError("client thread failed to finish")
     return server
+
+
+def run_loopback_federation(
+    config: RunConfig,
+    data: FederatedDataset,
+    model: ModelDef,
+    task: str = "classification",
+    log_fn=None,
+):
+    """Federation over the in-process loopback hub (see run_federation)."""
+    hub = LoopbackHub()
+    return run_federation(
+        config,
+        data,
+        model,
+        lambda rank: LoopbackCommManager(hub, rank),
+        task=task,
+        log_fn=log_fn,
+    )
+
+
+def run_mqtt_federation(
+    config: RunConfig,
+    data: FederatedDataset,
+    model: ModelDef,
+    task: str = "classification",
+    log_fn=None,
+    host: str = None,
+    port: int = 1883,
+):
+    """Federation over MQTT pub/sub (ref mqtt_comm_manager.py:14-123):
+    embedded in-process broker by default, real broker when host given."""
+    from fedml_tpu.core.mqtt_comm import EmbeddedBroker, MqttCommManager
+
+    if host is None:
+        broker = EmbeddedBroker()
+        factory = lambda rank: MqttCommManager(rank, broker=broker)
+    else:
+        factory = lambda rank: MqttCommManager(rank, host=host, port=port)
+    return run_federation(config, data, model, factory, task=task, log_fn=log_fn)

@@ -13,12 +13,15 @@ and α separately and records the derived genotype per round.
 - ``"first"`` — first-order DARTS (the reference's default path): ∇α of the
   validation loss at the current weights.
 - ``"second"`` — the unrolled architect (ref architect.py:32-44
-  `_compute_unrolled_model`): ∇α L_val(w − ξ·∇w L_train(w, α), α). The
-  reference approximates the resulting Hessian-vector product by finite
-  differences (architect.py `_hessian_vector_product`); here JAX
-  differentiates *through* the inner SGD step exactly (grad-of-grad),
-  which is both simpler and exact — the TPU-native flex the survey
-  schedules for this slot."""
+  `_compute_unrolled_model`): ∇α L_val(w − ξ·∇w L_train(w, α), α). JAX
+  differentiates *through* the inner step (grad-of-grad) — no
+  finite-difference Hessian-vector product (the reference's
+  `_hessian_vector_product`). The unrolled virtual step here is plain
+  SGD (no momentum/wd), a standard simplification: the α-gradient is
+  exact for THAT virtual step, while the reference unrolls its
+  momentum+wd update and then approximates the HVP by finite
+  differences — two different approximations of the same second-order
+  objective."""
 
 from __future__ import annotations
 
@@ -114,10 +117,12 @@ class FedNASAPI:
 
     def _make_second_order_arch_step(self):
         """Unrolled architect (ref architect.py:32-44): α-gradient of the
-        validation loss at w' = w − ξ·∇w L_train(w, α). JAX differentiates
-        through the inner step exactly — no finite-difference HVP. BN stats
-        are read, not mutated, inside the unrolled evaluation (weight steps
-        own the running stats)."""
+        validation loss at w' = w − ξ·∇w L_train(w, α), differentiated
+        through the inner step by autodiff (no finite-difference HVP). The
+        virtual step is plain SGD — see the module docstring for how this
+        approximation relates to the reference's. BN stats are read, not
+        mutated, inside the unrolled evaluation (weight steps own the
+        running stats)."""
         net, opt, xi = self.net, self.arch_opt, self.xi
 
         def raw_loss(arch, weights, bs, x, y):

@@ -49,11 +49,14 @@ ALGORITHMS = (
     "decentralized",
     "secagg",
 )
-RUNTIMES = ("vmap", "mesh", "loopback")
+RUNTIMES = ("vmap", "mesh", "loopback", "mqtt")
 
 
 @click.command()
-@click.option("--model", default="lr", help="Model name (models/registry.py)")
+@click.option("--model", default="lr",
+              help="Model name (models/registry.py); fedgkt/fednas/split_nn/"
+                   "vertical_fl/decentralized/secagg use their own fixed "
+                   "architectures and ignore this flag")
 @click.option("--dataset", "dataset_name", default="synthetic", help="Dataset name (data/registry.py)")
 @click.option("--data_dir", type=click.Path(path_type=Path), default=Path("./data"))
 @click.option("--partition_method", type=click.Choice(("hetero", "homo", "hetero-fix")), default="hetero")
@@ -167,6 +170,7 @@ def run(**opt):
                     server_opt_state=getattr(api, "server_opt_state", None),
                 )
 
+    _validate_variant(opt)
     builder = _LONGTAIL.get(opt["algorithm"])
     if builder is not None:
         if opt["resume"]:
@@ -176,6 +180,13 @@ def run(**opt):
         if opt["runtime"] != "vmap":
             raise click.UsageError(
                 f"algorithm={opt['algorithm']} supports only --runtime vmap"
+            )
+        if opt["checkpoint_path"] and opt["algorithm"] != "fedseg":
+            # fail loudly rather than let a 50-round run discover at crash
+            # time that nothing was ever saved
+            raise click.UsageError(
+                f"--checkpoint_path is not supported for algorithm="
+                f"{opt['algorithm']} (supported: the FedAvg family and fedseg)"
             )
         with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
             final = builder(config, data, model, task, log_fn, opt)
@@ -187,8 +198,10 @@ def run(**opt):
     api_cell.append(api)
 
     if opt["resume"]:
-        if opt["runtime"] == "loopback":
-            raise click.UsageError("--resume is not supported for runtime=loopback")
+        if opt["runtime"] in ("loopback", "mqtt"):
+            raise click.UsageError(
+                f"--resume is not supported for runtime={opt['runtime']}"
+            )
         _restore(api, opt)
 
     with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
@@ -203,6 +216,27 @@ def run(**opt):
     logger.close()
     click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
     return api
+
+
+_VARIANTS = {
+    "decentralized": ("dsgd", "pushsum"),
+    "fednas": ("first", "second"),
+}
+
+
+def _validate_variant(opt):
+    v = opt.get("variant")
+    if v is None:
+        return
+    allowed = _VARIANTS.get(opt["algorithm"])
+    if allowed is None:
+        raise click.UsageError(
+            f"--variant has no meaning for algorithm={opt['algorithm']}"
+        )
+    if v not in allowed:
+        raise click.UsageError(
+            f"--variant for {opt['algorithm']} must be one of {allowed}, got {v!r}"
+        )
 
 
 def _jsonable(v):
@@ -231,17 +265,26 @@ def _restore(api, opt):
 
 
 def _build_api(algorithm, runtime, config, data, model, task, log_fn):
-    if runtime == "loopback":
+    if runtime in ("loopback", "mqtt"):
         if algorithm != "fedavg":
-            raise click.UsageError("runtime=loopback currently supports algorithm=fedavg")
-        from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+            raise click.UsageError(
+                f"runtime={runtime} currently supports algorithm=fedavg"
+            )
+        from fedml_tpu.algorithms.fedavg_transport import (
+            run_loopback_federation,
+            run_mqtt_federation,
+        )
+
+        runner_fn = (
+            run_mqtt_federation if runtime == "mqtt" else run_loopback_federation
+        )
 
         class _Runner:
             global_vars = None
             start_round = 0
 
             def train(self):
-                server = run_loopback_federation(config, data, model, task=task, log_fn=log_fn)
+                server = runner_fn(config, data, model, task=task, log_fn=log_fn)
                 _Runner.global_vars = server.global_vars
                 self.global_vars = server.global_vars
                 return server.history[-1] if server.history else {}

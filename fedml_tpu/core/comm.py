@@ -1,7 +1,8 @@
 """Backend-agnostic communication interface (ref:
 fedml_core/distributed/communication/base_com_manager.py:7-27 +
-observer.py:4-7). Same Observer contract so every backend — loopback, gRPC,
-or a future MQTT bridge — slots in identically."""
+observer.py:4-7). Same Observer contract so every backend — loopback
+(core/loopback.py), gRPC (core/grpc_comm.py), MQTT (core/mqtt_comm.py) —
+slots in identically."""
 
 from __future__ import annotations
 

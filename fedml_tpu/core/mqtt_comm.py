@@ -1,0 +1,132 @@
+"""MQTT communication backend — the edge/IoT federation leg (ref:
+fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14-123).
+
+Topic scheme (parity with the reference's ``_on_connect``:48-72 /
+``send_message``:100-123, which subscribes the server to a per-client
+upload topic and each client to its own downlink topic): every participant
+subscribes ``{prefix}/to_{rank}``; sending publishes the binary Message to
+``{prefix}/to_{receiver}``. Payloads are the dtype-preserving Message wire
+format — not the reference's JSON-listified tensors (message.py:47-59, the
+#1 perf sin per SURVEY §2h).
+
+Two broker paths behind one manager:
+
+- **Embedded broker** (default for tests/simulation): an in-process
+  topic-routed pub/sub hub with MQTT semantics (subscribe exact topics,
+  publish fan-out, QoS-0 at-most-once). The reference's own MQTT "test" is
+  a __main__ block against a public internet broker
+  (mqtt_comm_manager.py:131-150) — not runnable in CI; the embedded broker
+  makes the backend testable hermetically.
+- **paho-mqtt client** to a real broker (host/port), import-gated: this
+  environment does not vendor paho, so the path raises a clear error if
+  paho is missing but keeps full wire compatibility when present.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional, Set
+
+from fedml_tpu.core.comm import BaseCommManager
+from fedml_tpu.core.message import Message
+
+_STOP = object()
+
+
+class EmbeddedBroker:
+    """In-process MQTT-semantics broker: topic → subscriber queues.
+    QoS-0 (at-most-once) fan-out; thread-safe."""
+
+    def __init__(self):
+        self._subs: Dict[str, Set["queue.Queue"]] = {}
+        self._lock = threading.Lock()
+
+    def subscribe(self, topic: str, q: "queue.Queue") -> None:
+        with self._lock:
+            self._subs.setdefault(topic, set()).add(q)
+
+    def unsubscribe(self, topic: str, q: "queue.Queue") -> None:
+        with self._lock:
+            self._subs.get(topic, set()).discard(q)
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._lock:
+            targets = list(self._subs.get(topic, ()))
+        for q in targets:
+            q.put(payload)
+
+
+class MqttCommManager(BaseCommManager):
+    """BaseCommManager over MQTT pub/sub (embedded broker or paho client)."""
+
+    def __init__(
+        self,
+        rank: int,
+        broker: Optional[EmbeddedBroker] = None,
+        host: Optional[str] = None,
+        port: int = 1883,
+        topic_prefix: str = "fedml_tpu",
+    ):
+        super().__init__()
+        self.rank = rank
+        self.prefix = topic_prefix
+        self._q: "queue.Queue" = queue.Queue()
+        self._broker = broker
+        self._client = None
+        if broker is not None:
+            broker.subscribe(self._topic(rank), self._q)
+        elif host is not None:
+            self._client = self._connect_paho(host, port)
+        else:
+            raise ValueError("need either an EmbeddedBroker or a broker host")
+
+    def _topic(self, rank: int) -> str:
+        return f"{self.prefix}/to_{rank}"
+
+    def _connect_paho(self, host: str, port: int):
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as e:
+            raise RuntimeError(
+                "paho-mqtt is not installed; use MqttCommManager(broker="
+                "EmbeddedBroker()) for in-process federation, or install "
+                "paho-mqtt for a real broker"
+            ) from e
+
+        client = mqtt.Client(client_id=f"{self.prefix}_{self.rank}")
+        client.on_message = lambda c, u, m: self._q.put(m.payload)
+        # Subscribe from on_connect, not once after connect(): paho's loop
+        # thread auto-reconnects after a broker blip, and subscriptions are
+        # per-connection — resubscribing here keeps receiving after
+        # reconnects (the ref subscribes in _on_connect for the same
+        # reason, mqtt_comm_manager.py:48-72).
+        client.on_connect = lambda c, u, f, rc: c.subscribe(
+            self._topic(self.rank), qos=0
+        )
+        client.connect(host, port)
+        client.loop_start()
+        return client
+
+    def send_message(self, msg: Message) -> None:
+        topic = self._topic(msg.get_receiver_id())
+        payload = msg.to_bytes()
+        if self._broker is not None:
+            self._broker.publish(topic, payload)
+        else:
+            self._client.publish(topic, payload, qos=0)
+
+    def handle_receive_message(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                break
+            self.notify(Message.from_bytes(item))
+
+    def stop_receive_message(self) -> None:
+        self._q.put(_STOP)
+        if self._broker is not None:
+            self._broker.unsubscribe(self._topic(self.rank), self._q)
+        if self._client is not None:
+            self._client.loop_stop()
+            self._client.disconnect()

@@ -1,0 +1,103 @@
+"""Backdoor attack harness: model-replacement boosting vs the robust
+defenses — the measurable attack/defense pairing the reference evaluates
+with FedAvgRobustAggregator.py:14-60 + edge_case_examples.
+
+Threat model: attacker clients train on locally poisoned shards
+(data/edge_cases.py) and BOOST their upload toward model replacement,
+``w_i ← w_g + γ·(w_i − w_g)`` — with γ ≈ sampled-client count the boosted
+update survives averaging and installs the backdoor in one round. The
+norm-difference clipping defense (robust_aggregation.py) bounds exactly the
+boosted quantity, which is why it works: clipping reduces ASR while leaving
+honest (small-norm) updates untouched.
+
+Everything runs inside the jitted round: the boost is a per-client mask
+multiply vmapped over the stacked client axis, slotted as a post_train hook
+ahead of the defense (attack happens client-side, defense server-side)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import make_fedavg_round
+from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
+from fedml_tpu.robustness import RobustConfig, add_gaussian_noise, norm_diff_clip_tree
+
+
+@dataclasses.dataclass(frozen=True)
+class AttackConfig:
+    attacker_ids: tuple = ()
+    boost: float = 10.0  # γ; ≈ client_num_per_round for full replacement
+
+
+def make_attacked_robust_round(
+    model, config, robust: RobustConfig, attack: AttackConfig,
+    task="classification", local_train_fn=None, donate=True,
+):
+    def post_train(client_vars, global_vars, noise_rng, attack_mask):
+        # attacker-side boost: w_i <- w_g + γ(w_i - w_g) for masked clients
+        boost = jnp.where(attack_mask > 0, attack.boost, 1.0)
+        client_vars = jax.tree_util.tree_map(
+            lambda cv, gv: gv + boost.reshape((-1,) + (1,) * (cv.ndim - 1)) * (cv - gv),
+            client_vars,
+            global_vars,
+        )
+        # server-side defense
+        if robust.defense_type in ("norm_diff_clipping", "weak_dp"):
+            client_vars = jax.vmap(
+                lambda cv: norm_diff_clip_tree(cv, global_vars, robust.norm_bound)
+            )(client_vars)
+        return client_vars
+
+    def post_aggregate(new_global, noise_rng, attack_mask):
+        if robust.defense_type == "weak_dp":
+            return add_gaussian_noise(new_global, noise_rng, robust.stddev)
+        return new_global
+
+    return make_fedavg_round(
+        model, config, task=task, local_train_fn=local_train_fn,
+        donate=donate, post_train=post_train, post_aggregate=post_aggregate,
+    )
+
+
+class BackdoorFedAvgAPI(RobustFedAvgAPI):
+    """RobustFedAvgAPI under active attack: attacker clients' shards should
+    be poisoned (data/edge_cases.py); their uploads are boosted inside the
+    round; the configured defense then runs server-side."""
+
+    def __init__(self, config, data, model, robust=RobustConfig(), attack=AttackConfig(), **kw):
+        self.attack = attack
+        self._attacker_set = set(int(a) for a in attack.attacker_ids)
+        super().__init__(config, data, model, robust=robust, **kw)
+
+    def _build_round_fn(self, local_train_fn):
+        return make_attacked_robust_round(
+            self.model, self.config, self.robust, self.attack,
+            task=self.task, local_train_fn=local_train_fn, donate=self._donate,
+        )
+
+    def train_round(self, round_idx: int):
+        self._current_round = round_idx
+        return super().train_round(round_idx)
+
+    def _place_batch(self, batch, round_rng):
+        from fedml_tpu.algorithms.fedavg import client_sampling
+
+        base = super(RobustFedAvgAPI, self)._place_batch(batch, round_rng)
+        noise_rng = jax.random.fold_in(round_rng, 0x5EED)
+        sampled = client_sampling(
+            getattr(self, "_current_round", 0),
+            self.data.num_clients,
+            self.config.fed.client_num_per_round,
+        )
+        attack_mask = jnp.asarray(
+            np.array(
+                [1.0 if int(c) in self._attacker_set else 0.0 for c in sampled],
+                np.float32,
+            )
+        )
+        return base + (noise_rng, attack_mask)

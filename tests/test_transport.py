@@ -37,6 +37,79 @@ def test_message_binary_roundtrip():
     np.testing.assert_array_equal(la[1], np.full(3, 7, np.int64))
 
 
+def test_mqtt_federation_matches_simulator():
+    """Same oracle as the loopback test, over the MQTT backend's embedded
+    broker (ref mqtt topic scheme, mqtt_comm_manager.py:48-72,100-123) —
+    the VERDICT r1 #5 contract: federation==simulator over MQTT."""
+    import jax
+
+    from fedml_tpu.algorithms import FedAvgAPI
+    from fedml_tpu.algorithms.fedavg_transport import run_mqtt_federation
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_classification(
+        num_clients=4, num_classes=3, feat_shape=(5,), samples_per_client=12,
+        partition_method="homo", seed=9,
+    )
+    model_def = lambda: ModelDef(
+        module=LogisticRegression(num_classes=3), input_shape=(5,), num_classes=3, name="lr"
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=-1),
+        fed=FedConfig(
+            client_num_in_total=4, client_num_per_round=4, comm_round=3, epochs=1,
+            frequency_of_the_test=3,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+    sim = FedAvgAPI(cfg, data, model_def())
+    sim.train()
+
+    server = run_mqtt_federation(cfg, data, model_def())
+    assert server.round_idx == 3
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sim.global_vars),
+        jax.tree_util.tree_leaves(server.global_vars),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5)
+
+
+def test_mqtt_embedded_broker_pubsub():
+    """Broker semantics: exact-topic fan-out, unsubscribe stops delivery."""
+    import queue
+
+    from fedml_tpu.core.mqtt_comm import EmbeddedBroker
+
+    broker = EmbeddedBroker()
+    q1, q2 = queue.Queue(), queue.Queue()
+    broker.subscribe("fedml_tpu/to_1", q1)
+    broker.subscribe("fedml_tpu/to_1", q2)
+    broker.publish("fedml_tpu/to_1", b"hello")
+    assert q1.get(timeout=1) == b"hello" and q2.get(timeout=1) == b"hello"
+    broker.publish("fedml_tpu/to_2", b"other")  # nobody subscribed: dropped
+    broker.unsubscribe("fedml_tpu/to_1", q2)
+    broker.publish("fedml_tpu/to_1", b"again")
+    assert q1.get(timeout=1) == b"again"
+    assert q2.empty()
+
+
+def test_mqtt_paho_path_raises_without_paho():
+    from fedml_tpu.core.mqtt_comm import MqttCommManager
+
+    try:
+        import paho  # noqa: F401
+
+        pytest.skip("paho installed; error path not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(RuntimeError, match="paho-mqtt is not installed"):
+        MqttCommManager(0, host="localhost")
+
+
 def test_loopback_federation_matches_simulator():
     """Full-participation full-batch E=1: the transport path must equal the
     vmap simulator (which itself equals centralized — the reference's CI
