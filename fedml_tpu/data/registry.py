@@ -21,6 +21,8 @@ TASKS = {
     "stackoverflow_nwp": "nwp",
     "synthetic": "classification",
     "seg_synth": "segmentation",
+    "imagenet": "classification",
+    "landmarks": "classification",
 }
 
 
@@ -99,4 +101,6 @@ _FILE_LOADERS = {
     "fed_cifar100": ("fedml_tpu.data.tff_h5", "load_fed_cifar100"),
     "stackoverflow_lr": ("fedml_tpu.data.stackoverflow", "load_stackoverflow_lr"),
     "stackoverflow_nwp": ("fedml_tpu.data.stackoverflow", "load_stackoverflow_nwp"),
+    "imagenet": ("fedml_tpu.data.imagenet", "load_imagenet"),
+    "landmarks": ("fedml_tpu.data.landmarks", "load_landmarks"),
 }

@@ -1,0 +1,106 @@
+"""UCI SUSY / Room-Occupancy streaming loader (ref:
+fedml_api/data_preprocessing/data_loader_for_susy_and_ro — well,
+fedml_api/data_preprocessing/UCI/data_loader_for_susy_and_ro.py, 150 LoC).
+
+The reference feeds decentralized ONLINE learning: each client receives a
+stream of (x, y) samples; a β fraction of the stream is "adversarial" —
+distributed by k-means cluster (each client gets one cluster's regime, so
+streams are locally non-IID in time) — and the remainder is stochastic
+(round-robin of the shuffled tail). Labels are binary (SUSY signal /
+room occupied). Same construction here with a small numpy k-means (the
+reference uses sklearn.KMeans; the dependency isn't worth it for ≤16
+centroids), emitting the [N, T, D] / [N, T] worker-major arrays
+DecentralizedAPI consumes."""
+
+from __future__ import annotations
+
+import csv
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _kmeans(x: np.ndarray, k: int, seed: int = 0, iters: int = 20) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    centers = x[rng.choice(len(x), size=k, replace=False)]
+    assign = np.zeros(len(x), np.int64)
+    for _ in range(iters):
+        d = ((x[:, None, :] - centers[None]) ** 2).sum(-1)
+        new_assign = d.argmin(1)
+        if (new_assign == assign).all():
+            break
+        assign = new_assign
+        for c in range(k):
+            m = assign == c
+            if m.any():
+                centers[c] = x[m].mean(0)
+    return assign
+
+
+def read_uci_csv(
+    path: str, label_col: int = 0, max_rows: Optional[int] = None, skip_header: bool = False
+) -> Tuple[np.ndarray, np.ndarray]:
+    """CSV → (x [n, d] float32, y [n] {0,1}). SUSY: label first column;
+    Room Occupancy: label last (pass label_col=-1), header row present."""
+    xs, ys = [], []
+    with open(path) as f:
+        reader = csv.reader(f)
+        if skip_header:
+            next(reader, None)
+        for i, row in enumerate(reader):
+            if max_rows is not None and i >= max_rows:
+                break
+            vals = [v for v in row if v != ""]
+            y = float(vals[label_col])
+            feats = vals[:label_col] + vals[label_col + 1 :] if label_col != -1 else vals[:-1]
+            xs.append([float(v) for v in feats])
+            ys.append(int(y > 0.5))
+    return np.asarray(xs, np.float32), np.asarray(ys, np.int32)
+
+
+def load_uci_streaming(
+    path: str,
+    num_clients: int,
+    samples_per_client: int,
+    beta: float = 0.5,
+    label_col: int = 0,
+    skip_header: bool = False,
+    seed: int = 0,
+    max_rows: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Build the streaming tensors: x [N, T, D], y [N, T].
+
+    First β·T samples of each client's stream come from "its" k-means
+    cluster (adversarial regime, ref load_adversarial_data); the remaining
+    (1−β)·T are drawn round-robin from the shuffled remainder (stochastic
+    regime, ref load_stochastic_data)."""
+    x, y = read_uci_csv(
+        path, label_col=label_col, max_rows=max_rows, skip_header=skip_header
+    )
+    need = num_clients * samples_per_client
+    if len(y) < need:
+        raise ValueError(f"{path}: need {need} samples, file has {len(y)}")
+    rng = np.random.default_rng(seed)
+    T = samples_per_client
+    t_adv = int(round(beta * T))
+
+    assign = _kmeans(x, num_clients, seed=seed)
+    xs = np.zeros((num_clients, T, x.shape[1]), np.float32)
+    ys = np.zeros((num_clients, T), np.int32)
+    used = np.zeros(len(y), bool)
+    for c in range(num_clients):
+        idx = np.flatnonzero(assign == c)[:t_adv]
+        xs[c, : len(idx)] = x[idx]
+        ys[c, : len(idx)] = y[idx]
+        used[idx] = True
+    pool = np.flatnonzero(~used)
+    rng.shuffle(pool)
+    ptr = 0
+    for c in range(num_clients):
+        have = min(t_adv, int((assign == c).sum()))
+        take = T - have
+        sel = pool[ptr : ptr + take]
+        ptr += take
+        xs[c, have:] = x[sel]
+        ys[c, have:] = y[sel]
+    return xs, ys
