@@ -34,6 +34,7 @@ from fedml_tpu.config import (
 )
 
 ALGORITHMS = (
+    "centralized",
     "fedavg",
     "fedopt",
     "fedprox",
@@ -177,9 +178,13 @@ def run(**opt):
             raise click.UsageError(
                 f"--resume is not supported for algorithm={opt['algorithm']}"
             )
-        if opt["runtime"] != "vmap":
+        allowed_runtimes = (
+            ("vmap", "mesh") if opt["algorithm"] == "centralized" else ("vmap",)
+        )
+        if opt["runtime"] not in allowed_runtimes:
             raise click.UsageError(
-                f"algorithm={opt['algorithm']} supports only --runtime vmap"
+                f"algorithm={opt['algorithm']} supports only "
+                f"--runtime {'|'.join(allowed_runtimes)}"
             )
         if opt["checkpoint_path"] and opt["algorithm"] != "fedseg":
             # fail loudly rather than let a 50-round run discover at crash
@@ -518,7 +523,25 @@ def _run_secagg(config, data, model, task, log_fn, opt):
     return final
 
 
+def _run_centralized(config, data, model, task, log_fn, opt):
+    """Non-federated data-parallel baseline (ref
+    fedml_experiments/centralized/main.py DDP path): --runtime mesh shards
+    the batch over all devices; --comm_round doubles as the epoch count."""
+    from fedml_tpu.train.centralized import CentralizedTrainer
+
+    mesh = None
+    if opt["runtime"] == "mesh":
+        from fedml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(opt["client_shards"], "batch")
+    trainer = CentralizedTrainer(
+        config, data, model, task=task, mesh=mesh, log_fn=log_fn
+    )
+    return trainer.train()
+
+
 _LONGTAIL = {
+    "centralized": _run_centralized,
     "fedgkt": _run_fedgkt,
     "fedgan": _run_fedgan,
     "fedseg": _run_fedseg,

@@ -1,0 +1,173 @@
+"""Pretrained-weights path: torch checkpoint import/export for the ResNet zoo.
+
+The reference ships torch ``.pth`` checkpoints for resnet56 (metric logs under
+fedml_api/model/cv/pretrained/{CIFAR10,CIFAR100,CINIC10}/resnet56/; loaded by
+``resnet56(class_num, pretrained=True, path=...)`` — fedml_api/model/cv/
+resnet.py:200-222, which strips the DataParallel ``module.`` prefix and calls
+``load_state_dict``). FedGKT's server eval builds on those weights
+(resnet_pretrained, SURVEY §2d).
+
+TPU analog: a bidirectional mapping between the torch CIFAR-ResNet state-dict
+naming (``layer1.0.conv1.weight`` / ``downsample.0`` / ``bn1.running_mean``)
+and this repo's Flax ``CifarResNet`` variables (models/resnet.py —
+``layer1_block0/conv1/kernel`` etc.), with the layout transposes TPU wants:
+conv OIHW → HWIO, linear [O,I] → [I,O]. Import gives checkpoint parity with
+the reference; export + ``save_pretrained``/``load_pretrained`` (npz) is the
+train-and-save recipe for environments without the original downloads."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def _to_numpy(v) -> np.ndarray:
+    """torch.Tensor | ndarray | array-like → ndarray (no torch import)."""
+    if hasattr(v, "detach"):
+        v = v.detach()
+    if hasattr(v, "cpu"):
+        v = v.cpu()
+    if hasattr(v, "numpy"):
+        v = v.numpy()
+    return np.asarray(v)
+
+
+def _flax_path_to_torch_key(path: Tuple[str, ...]) -> str:
+    """('params','layer1_block0','conv1','kernel') → 'layer1.0.conv1.weight'.
+
+    Naming contract matches the reference's torch ResNet (resnet.py:113-222):
+    blocks are ``layer{s}.{b}.``, the shortcut is ``downsample.0`` (conv) /
+    ``downsample.1`` (bn), BN stats are ``running_mean``/``running_var``."""
+    collection, *mods, leaf = path
+    parts = []
+    for m in mods:
+        if m.startswith("layer") and "_block" in m:
+            stage, block = m.split("_block")
+            parts += [stage, block]
+        elif m == "downsample_conv":
+            parts += ["downsample", "0"]
+        elif m == "downsample_bn":
+            parts += ["downsample", "1"]
+        else:
+            parts.append(m)
+    if collection == "batch_stats":
+        leaf = {"mean": "running_mean", "var": "running_var"}[leaf]
+    else:
+        leaf = {"kernel": "weight", "scale": "weight", "bias": "bias"}[leaf]
+    return ".".join(parts + [leaf])
+
+
+def _leaf_kind(path: Tuple[str, ...], arr: np.ndarray) -> str:
+    if path[-1] == "kernel":
+        return "conv" if arr.ndim == 4 else "linear"
+    return "other"
+
+
+def _iter_leaves(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _iter_leaves(tree[k], prefix + (k,))
+    else:
+        yield prefix, tree
+
+
+def import_torch_state_dict(state_dict: Dict[str, object], template: dict) -> dict:
+    """Pour a torch state dict into a Flax variables template.
+
+    ``template`` is ``model.init(...)`` output (gives structure + expected
+    shapes); returns the same structure with values from ``state_dict``.
+    Strips the DataParallel ``module.`` prefix like the reference
+    (resnet.py:211-216). Raises KeyError/ValueError on missing keys or shape
+    mismatches — a silent partial load is worse than failing."""
+    sd = {
+        (k[len("module."):] if k.startswith("module.") else k): _to_numpy(v)
+        for k, v in state_dict.items()
+    }
+
+    def convert(path, tmpl_arr):
+        key = _flax_path_to_torch_key(path)
+        if key not in sd:
+            raise KeyError(
+                f"torch checkpoint is missing {key!r} (flax {'/'.join(path)})"
+            )
+        arr = sd[key]
+        kind = _leaf_kind(path, np.asarray(tmpl_arr))
+        if kind == "conv":
+            arr = arr.transpose(2, 3, 1, 0)  # OIHW → HWIO
+        elif kind == "linear":
+            arr = arr.transpose(1, 0)  # [O,I] → [I,O]
+        tmpl_arr = np.asarray(tmpl_arr)
+        if arr.shape != tmpl_arr.shape:
+            raise ValueError(
+                f"{key}: checkpoint shape {arr.shape} != model {tmpl_arr.shape}"
+            )
+        return arr.astype(tmpl_arr.dtype)
+
+    def walk(tree, prefix=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, prefix + (k,)) for k, v in tree.items()}
+        return convert(prefix, tree)
+
+    return walk(template)
+
+
+def export_torch_state_dict(variables: dict) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`import_torch_state_dict`: Flax variables → a
+    torch-naming state dict (numpy values), loadable by the reference's
+    ``model.load_state_dict`` after ``torch.from_numpy``."""
+    out = {}
+    for path, arr in _iter_leaves(variables):
+        arr = np.asarray(arr)
+        key = _flax_path_to_torch_key(path)
+        kind = _leaf_kind(path, arr)
+        if kind == "conv":
+            arr = arr.transpose(3, 2, 0, 1)  # HWIO → OIHW
+        elif kind == "linear":
+            arr = arr.transpose(1, 0)
+        out[key] = arr
+    return out
+
+
+def load_torch_checkpoint(path: str, template: dict) -> dict:
+    """Load a reference-format ``.pth`` (torch.save of {'state_dict': ...} or
+    a bare state dict — resnet.py:209-210) into a Flax template. Requires
+    torch (CPU) at call time only."""
+    import torch
+
+    # weights_only: reference-format checkpoints are pure tensor dicts; never
+    # opt into full pickle execution for a downloaded file.
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    state_dict = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else ckpt
+    return import_torch_state_dict(state_dict, template)
+
+
+def save_pretrained(path: str, variables: dict) -> None:
+    """Train-and-save recipe: flat npz of the variables tree (same wire
+    format family as utils/checkpoint.py, but standalone weights-only)."""
+    flat = {
+        "/".join(p): np.asarray(a) for p, a in _iter_leaves(variables)
+    }
+    np.savez(path, **flat)
+
+
+def load_pretrained(path: str, template: dict) -> dict:
+    """Load a :func:`save_pretrained` npz into a variables template."""
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+
+    def walk(tree, prefix=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, prefix + (k,)) for k, v in tree.items()}
+        key = "/".join(prefix)
+        if key not in flat:
+            raise KeyError(f"pretrained file is missing {key!r}")
+        arr = flat[key]
+        tmpl = np.asarray(tree)
+        if arr.shape != tmpl.shape:
+            raise ValueError(
+                f"{key}: saved shape {arr.shape} != model {tmpl.shape}"
+            )
+        return arr.astype(tmpl.dtype)
+
+    return walk(template)

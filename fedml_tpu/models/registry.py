@@ -16,10 +16,16 @@ def create(
     dataset_name: str,
     input_shape: Tuple[int, ...],
     num_classes: int,
+    pretrained: str | None = None,
     **kw,
 ) -> ModelDef:
     name = model_name.lower()
     ds = (dataset_name or "").lower()
+    if pretrained is not None:
+        # ref resnet56(pretrained=True, path=...) (resnet.py:200-222):
+        # build the model, then pour the checkpoint over init at first use.
+        model = create(model_name, dataset_name, input_shape, num_classes, **kw)
+        return _with_pretrained(model, pretrained)
 
     if name == "lr":
         from fedml_tpu.models.linear import LogisticRegression
@@ -149,3 +155,24 @@ def create(
         "mobilenet_v3, vgg11..vgg19(_bn), efficientnet, segnet, darts, "
         "mnistgan"
     )
+
+
+def _with_pretrained(model: ModelDef, path: str) -> ModelDef:
+    """Wrap ``model.init`` to return checkpoint weights: ``.pth`` goes through
+    the torch importer, ``.npz`` through the save_pretrained recipe
+    (models/pretrained.py)."""
+    import dataclasses
+
+    from fedml_tpu.models import pretrained as P
+
+    inner_init = model.init
+
+    def init(rng):
+        template = inner_init(rng)
+        if str(path).endswith(".pth"):
+            return P.load_torch_checkpoint(str(path), template)
+        return P.load_pretrained(str(path), template)
+
+    loaded = dataclasses.replace(model)
+    loaded.init = init  # type: ignore[method-assign]
+    return loaded

@@ -49,6 +49,47 @@ def _split_vars(variables: dict) -> Tuple[dict, dict]:
     return params, extra
 
 
+def cast_floats(tree, dtype):
+    """Cast every floating leaf; ints (labels, step counts) pass through."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+        else a,
+        tree,
+    )
+
+
+def make_mixed_forward(model: ModelDef, tc: TrainConfig):
+    """The shared mixed-precision forward: fp32 master params are cast to
+    ``tc.compute_dtype`` inside the differentiated function (the cast is
+    linear, so grads come back fp32); logits and mutable collections (BN
+    stats) are restored to fp32 so scan carries keep stable dtypes.
+
+    Returns ``fwd(params, extra, xb, step_rng) -> (logits_f32, new_extra_f32)``.
+    Used by both the per-client local-train scan and the centralized DP
+    trainer so the compute-dtype policy can never diverge between them."""
+    cdt = jnp.dtype(tc.compute_dtype)
+    mixed = cdt != jnp.dtype(jnp.float32)
+
+    def fwd(params, extra, xb, step_rng):
+        if mixed:
+            params_c = cast_floats(params, cdt)
+            extra_c = cast_floats(extra, cdt)
+            xb_c = cast_floats(xb, cdt)
+        else:
+            params_c, extra_c, xb_c = params, extra, xb
+        logits, new_vars = model.apply(
+            {"params": params_c, **extra_c}, xb_c, train=True, rng=step_rng
+        )
+        logits = logits.astype(jnp.float32)
+        if mixed:
+            new_vars = cast_floats(new_vars, jnp.float32)
+        _, new_extra = _split_vars(new_vars)
+        return logits, new_extra
+
+    return fwd
+
+
 def make_task_loss(task: str) -> Callable:
     """task → (loss, (correct, total)) (ref per-task MyModelTrainer impls)."""
 
@@ -97,16 +138,7 @@ def make_local_train(
     """
     opt = build_client_optimizer(tc)
     task_loss = make_task_loss(task)
-    cdt = jnp.dtype(tc.compute_dtype)
-    mixed = cdt != jnp.dtype(jnp.float32)
-
-    def cast_floats(tree, dtype):
-        return jax.tree_util.tree_map(
-            lambda a: a.astype(dtype)
-            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
-            else a,
-            tree,
-        )
+    fwd = make_mixed_forward(model, tc)
 
     def local_train(variables, x, y, mask, rng):
         params0, extra0 = _split_vars(variables)
@@ -117,28 +149,11 @@ def make_local_train(
         m_flat = mask.reshape((n_flat,))
 
         def loss_fn(params, extra, xb, yb, mb, step_rng):
-            # Mixed precision: fp32 master params are cast to the compute
-            # dtype inside the differentiated function (the cast is linear,
-            # so grads come back fp32); the loss itself is reduced in fp32.
-            if mixed:
-                params_c = cast_floats(params, cdt)
-                extra_c = cast_floats(extra, cdt)
-                xb_c = cast_floats(xb, cdt)
-            else:
-                params_c, extra_c, xb_c = params, extra, xb
-            logits, new_vars = model.apply(
-                {"params": params_c, **extra_c}, xb_c, train=True, rng=step_rng
-            )
-            logits = logits.astype(jnp.float32)
-            if mixed:
-                # Mutable collections (BN stats) return in compute dtype;
-                # restore fp32 so the scan carry keeps stable dtypes.
-                new_vars = cast_floats(new_vars, jnp.float32)
+            logits, new_extra = fwd(params, extra, xb, step_rng)
             task_l, correct, total = task_loss(logits, yb, mb)
             loss = task_l
             if tc.prox_mu:
                 loss = loss + 0.5 * tc.prox_mu * L.tree_sq_dist(params, params0)
-            _, new_extra = _split_vars(new_vars)
             # task_l (not loss) feeds the metrics so FedProx runs report plain
             # task loss, comparable to FedAvg and the reference's logs.
             return loss, (new_extra, task_l, correct, total)

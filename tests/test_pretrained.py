@@ -1,0 +1,100 @@
+"""Pretrained-weights path (ref resnet56(pretrained=True, path=...),
+fedml_api/model/cv/resnet.py:200-222): torch .pth import into the Flax
+resnet56, export back, and the npz save/load recipe."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.models import create_model
+from fedml_tpu.models.pretrained import (
+    export_torch_state_dict,
+    import_torch_state_dict,
+    load_pretrained,
+    load_torch_checkpoint,
+    save_pretrained,
+)
+
+
+@pytest.fixture(scope="module")
+def template():
+    import jax
+
+    model = create_model("resnet56", "cifar10", (16, 16, 3), 10)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(a) for a in jax.tree_util.tree_leaves(tree)]
+
+
+def test_torch_roundtrip(template):
+    _, variables = template
+    sd = export_torch_state_dict(variables)
+    # reference naming spot checks
+    assert "conv1.weight" in sd
+    assert "layer1.0.conv1.weight" in sd
+    assert "layer2.0.downsample.0.weight" in sd
+    assert "layer2.0.downsample.1.running_mean" in sd
+    assert "fc.weight" in sd and "fc.bias" in sd
+    assert sd["conv1.weight"].shape[0] == 16  # torch OIHW: O first
+    back = import_torch_state_dict(sd, variables)
+    for a, b in zip(_leaves(variables), _leaves(back)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_torch_pth_file_with_module_prefix(template, tmp_path):
+    torch = pytest.importorskip("torch")
+    _, variables = template
+    sd = {
+        "module." + k: torch.from_numpy(np.ascontiguousarray(v))
+        for k, v in export_torch_state_dict(variables).items()
+    }
+    path = tmp_path / "resnet56.pth"
+    torch.save({"state_dict": sd}, path)  # reference checkpoint format
+    back = load_torch_checkpoint(str(path), variables)
+    for a, b in zip(_leaves(variables), _leaves(back)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_imported_weights_run_forward(template):
+    import jax
+
+    model, variables = template
+    back = import_torch_state_dict(export_torch_state_dict(variables), variables)
+    x = np.random.default_rng(0).normal(size=(2, 16, 16, 3)).astype(np.float32)
+    ref_out, _ = model.apply(variables, x, train=False)
+    out, _ = model.apply(back, x, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=1e-6)
+
+
+def test_npz_recipe_and_shape_guard(template, tmp_path):
+    _, variables = template
+    path = str(tmp_path / "weights.npz")
+    save_pretrained(path, variables)
+    back = load_pretrained(path, variables)
+    for a, b in zip(_leaves(variables), _leaves(back)):
+        np.testing.assert_array_equal(a, b)
+
+    sd = export_torch_state_dict(variables)
+    sd["fc.weight"] = sd["fc.weight"][:, :3]
+    with pytest.raises(ValueError):
+        import_torch_state_dict(sd, variables)
+    del sd["fc.weight"]
+    with pytest.raises(KeyError):
+        import_torch_state_dict(sd, variables)
+
+
+def test_create_model_pretrained_kwarg(template, tmp_path):
+    import jax
+
+    _, variables = template
+    path = str(tmp_path / "w.npz")
+    save_pretrained(path, variables)
+    loaded = create_model(
+        "resnet56", "cifar10", (16, 16, 3), 10, pretrained=path
+    )
+    got = loaded.init(jax.random.PRNGKey(123))  # rng must not matter
+    for a, b in zip(_leaves(variables), _leaves(got)):
+        np.testing.assert_array_equal(a, b)
