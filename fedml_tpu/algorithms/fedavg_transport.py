@@ -317,6 +317,32 @@ def run_loopback_federation(
     )
 
 
+def run_shm_federation(
+    config: RunConfig,
+    data: FederatedDataset,
+    model: ModelDef,
+    task: str = "classification",
+    log_fn=None,
+    sock_dir: Optional[str] = None,
+):
+    """Federation over the shared-memory local transport (TRPC-equivalent,
+    ref trpc_comm_manager.py:25-114): bulk tensors ride POSIX shared memory,
+    only tiny control records cross the per-rank UNIX sockets."""
+    import tempfile
+
+    from fedml_tpu.core.shm_comm import ShmCommManager
+
+    with tempfile.TemporaryDirectory(prefix="fedml_shm_") as d:
+        return run_federation(
+            config,
+            data,
+            model,
+            lambda rank: ShmCommManager(rank, sock_dir or d),
+            task=task,
+            log_fn=log_fn,
+        )
+
+
 def run_mqtt_federation(
     config: RunConfig,
     data: FederatedDataset,

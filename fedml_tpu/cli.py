@@ -50,7 +50,7 @@ ALGORITHMS = (
     "decentralized",
     "secagg",
 )
-RUNTIMES = ("vmap", "mesh", "loopback", "mqtt")
+RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm")
 
 
 @click.command()
@@ -203,7 +203,7 @@ def run(**opt):
     api_cell.append(api)
 
     if opt["resume"]:
-        if opt["runtime"] in ("loopback", "mqtt"):
+        if opt["runtime"] in ("loopback", "mqtt", "shm"):
             raise click.UsageError(
                 f"--resume is not supported for runtime={opt['runtime']}"
             )
@@ -270,7 +270,7 @@ def _restore(api, opt):
 
 
 def _build_api(algorithm, runtime, config, data, model, task, log_fn):
-    if runtime in ("loopback", "mqtt"):
+    if runtime in ("loopback", "mqtt", "shm"):
         if algorithm != "fedavg":
             raise click.UsageError(
                 f"runtime={runtime} currently supports algorithm=fedavg"
@@ -278,11 +278,14 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn):
         from fedml_tpu.algorithms.fedavg_transport import (
             run_loopback_federation,
             run_mqtt_federation,
+            run_shm_federation,
         )
 
-        runner_fn = (
-            run_mqtt_federation if runtime == "mqtt" else run_loopback_federation
-        )
+        runner_fn = {
+            "mqtt": run_mqtt_federation,
+            "shm": run_shm_federation,
+            "loopback": run_loopback_federation,
+        }[runtime]
 
         class _Runner:
             global_vars = None

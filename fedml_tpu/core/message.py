@@ -68,8 +68,12 @@ class Message:
         return self.receiver_id
 
     # -- binary wire format --
-    def to_bytes(self) -> bytes:
-        buffers: List[bytes] = []
+    def to_wire_parts(self) -> Tuple[bytes, List[np.ndarray]]:
+        """(header, contiguous array buffers) — the wire image is their
+        concatenation. Lets transports with their own destination memory
+        (e.g. the shared-memory backend) assemble with ONE copy per buffer
+        instead of materialising an intermediate bytes object."""
+        buffers: List[np.ndarray] = []
         meta_params: Dict[str, Any] = {}
         for k, v in self.params.items():
             meta_params[k] = _encode_value(v, buffers)
@@ -81,59 +85,99 @@ class Message:
                 "params": meta_params,
             }
         ).encode("utf-8")
+        header = _MAGIC + struct.pack("<Q", len(meta)) + meta
+        return header, buffers
+
+    def wire_size(self) -> int:
+        header, buffers = self.to_wire_parts()
+        return len(header) + sum(int(b.nbytes) for b in buffers)
+
+    def write_into(self, view) -> int:
+        """Assemble the wire image directly into ``view`` (a writable
+        buffer, e.g. SharedMemory.buf). Returns bytes written. Callers that
+        also need the size should use ``to_wire_parts`` + ``write_wire_parts``
+        to serialize only once."""
+        header, buffers = self.to_wire_parts()
+        return write_wire_parts(view, header, buffers)
+
+    def to_bytes(self) -> bytes:
         from fedml_tpu import native
 
-        header = _MAGIC + struct.pack("<Q", len(meta)) + meta
+        header, buffers = self.to_wire_parts()
         # single-pass (threaded when large) wire-image assembly
-        return native.concat_buffers(buffers, header=header)
+        return native.concat_buffers([b.tobytes() for b in buffers], header=header)
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Message":
-        if data[:4] != _MAGIC:
+    def from_bytes(cls, data, copy: bool = True) -> "Message":
+        """Parse a wire image. With ``copy=False`` the decoded arrays alias
+        ``data`` (zero-copy receive — valid only while the underlying buffer
+        lives; the shared-memory backend relies on this)."""
+        if bytes(data[:4]) != _MAGIC:
             raise ValueError("bad message magic")
-        (meta_len,) = struct.unpack("<Q", data[4:12])
-        meta = json.loads(data[12 : 12 + meta_len].decode("utf-8"))
+        (meta_len,) = struct.unpack("<Q", bytes(data[4:12]))
+        meta = json.loads(bytes(data[12 : 12 + meta_len]).decode("utf-8"))
         msg = cls(meta["msg_type"], meta["sender_id"], meta["receiver_id"])
         offset = 12 + meta_len
         # buffers appear in descriptor-index order; walk descriptors sorted
-        # by index to compute offsets.
+        # by index to compute offsets. NOTE: the recursive helpers are
+        # module-level functions on purpose — recursive closures form
+        # reference cycles that keep ``data`` (possibly a mapped shared-
+        # memory view) alive until a gc pass, breaking prompt close().
         descs: List[Tuple[int, dict]] = []
-
-        def collect(node):
-            if isinstance(node, dict) and "__nd__" in node:
-                descs.append((node["__nd__"], node))
-            elif isinstance(node, dict):
-                for v in node.values():
-                    collect(v)
-            elif isinstance(node, list):
-                for v in node:
-                    collect(v)
-
-        collect(meta["params"])
+        _collect_descs(meta["params"], descs)
         offsets = {}
         for idx, d in sorted(descs, key=lambda t: t[0]):
             offsets[idx] = offset
             offset += d["nbytes"]
 
-        def decode(node):
-            if isinstance(node, dict) and "__nd__" in node:
-                o = offsets[node["__nd__"]]
-                a = np.frombuffer(
-                    data, dtype=np.dtype(node["dtype"]), count=int(np.prod(node["shape"], dtype=np.int64)) if node["shape"] else 1, offset=o
-                )
-                return a.reshape(node["shape"]).copy() if node["shape"] else a.copy()[0]
-            if isinstance(node, dict):
-                return {k: decode(v) for k, v in node.items()}
-            if isinstance(node, list):
-                return [decode(v) for v in node]
-            return node
-
         for k, v in meta["params"].items():
-            msg.params[k] = decode(v)
+            msg.params[k] = _decode_node(v, data, offsets, copy)
         return msg
 
 
-def _encode_value(v: Any, buffers: List[bytes]):
+def write_wire_parts(view, header: bytes, buffers: List[np.ndarray]) -> int:
+    """Write a ``to_wire_parts`` result into a writable buffer; returns bytes
+    written. One buffer-to-buffer copy per array, no intermediate bytes."""
+    mv = memoryview(view).cast("B")
+    o = len(header)
+    mv[:o] = header
+    for b in buffers:
+        n = int(b.nbytes)
+        mv[o : o + n] = memoryview(b).cast("B")
+        o += n
+    return o
+
+
+def _collect_descs(node, out: List[Tuple[int, dict]]) -> None:
+    if isinstance(node, dict) and "__nd__" in node:
+        out.append((node["__nd__"], node))
+    elif isinstance(node, dict):
+        for v in node.values():
+            _collect_descs(v, out)
+    elif isinstance(node, list):
+        for v in node:
+            _collect_descs(v, out)
+
+
+def _decode_node(node, data, offsets, copy: bool):
+    if isinstance(node, dict) and "__nd__" in node:
+        o = offsets[node["__nd__"]]
+        count = (
+            int(np.prod(node["shape"], dtype=np.int64)) if node["shape"] else 1
+        )
+        a = np.frombuffer(data, dtype=np.dtype(node["dtype"]), count=count, offset=o)
+        if node["shape"]:
+            a = a.reshape(node["shape"])
+            return a.copy() if copy else a
+        return a.copy()[0] if copy else a[0]
+    if isinstance(node, dict):
+        return {k: _decode_node(v, data, offsets, copy) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_decode_node(v, data, offsets, copy) for v in node]
+    return node
+
+
+def _encode_value(v: Any, buffers: List[np.ndarray]):
     """Scalars/strings inline; ndarrays (and jax arrays via __array__) become
     buffer descriptors; dicts/lists recurse (param pytrees ride along)."""
     if isinstance(v, (str, int, float, bool)) or v is None:
@@ -144,7 +188,7 @@ def _encode_value(v: Any, buffers: List[bytes]):
         return [_encode_value(x, buffers) for x in v]
     a = np.asarray(v)
     idx = len(buffers)
-    buffers.append(np.ascontiguousarray(a).tobytes())
+    buffers.append(np.ascontiguousarray(a))
     return {
         "__nd__": idx,
         "dtype": a.dtype.str,
