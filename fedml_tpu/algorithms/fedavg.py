@@ -103,6 +103,64 @@ def make_fedavg_round(
     return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
 
 
+def make_fedavg_multiround(
+    model: ModelDef,
+    config: RunConfig,
+    steps: int,
+    bs: int,
+    task: str = "classification",
+    local_train_fn: Optional[Callable] = None,
+):
+    """Fused multi-round FedAvg: T rounds as ONE jitted ``lax.scan`` over the
+    HBM-resident data store — zero host round-trips inside the chunk.
+
+    Per-round host work in the eager path (sampling, index building, metric
+    fetch, dispatch) dominates small-model rounds, especially through a
+    remote-device transport. Here the host precomputes only the per-round
+    gather indices (a few KB each; sampling parity with
+    FedAVGAggregator.py:80-88 is preserved because sampling stays host-side)
+    and the device runs the whole chunk:
+
+        fn(global_vars, flat_x, flat_y, idx [T,C,cap], mask [T,C,cap],
+           num_samples [T,C], round_ids [T], base_rng)
+            -> (global_vars', stacked per-round metrics)
+
+    Per-round math is identical to :func:`make_fedavg_round` at the same
+    (steps, bs): the round body, the fold_in/split PRNG stream, and the
+    weighted average are the same code."""
+    from fedml_tpu.data.device_store import _gather
+
+    local_train = local_train_fn or make_local_train(
+        model, config.train, config.fed.epochs, task=task
+    )
+
+    def multi_fn(global_vars, flat_x, flat_y, idx, mask, num_samples, round_ids, base_rng):
+        feat = flat_x.shape[1:]
+        lab = flat_y.shape[1:]
+        C = idx.shape[1]
+
+        def body(gv, per_round):
+            idx_r, mask_r, ns_r, rid = per_round
+            # shared gather-and-zero-padding contract with the eager path
+            x, y = _gather(flat_x, flat_y, idx_r, mask_r)
+            x = x.reshape((C, steps, bs) + feat)
+            y = y.reshape((C, steps, bs) + lab)
+            m = mask_r.reshape((C, steps, bs))
+            rng = jax.random.fold_in(base_rng, rid + 1)
+            keys = round_client_rngs(rng, C)
+            client_vars, metrics = jax.vmap(
+                local_train, in_axes=(None, 0, 0, 0, 0)
+            )(gv, x, y, m, keys)
+            new_global = weighted_average(client_vars, ns_r)
+            return new_global, jax.tree_util.tree_map(jnp.sum, metrics)
+
+        return jax.lax.scan(
+            body, global_vars, (idx, mask, num_samples, round_ids)
+        )
+
+    return jax.jit(multi_fn, donate_argnums=(0,))
+
+
 class FedAvgAPI:
     """Standalone FedAvg simulator (ref standalone/fedavg/fedavg_api.py:13-180).
 
@@ -117,6 +175,11 @@ class FedAvgAPI:
     # Subclasses with their own batch placement (the sharded API pads +
     # shards host arrays over the mesh) disable the HBM-resident store.
     _use_device_store = True
+    # Fused multi-round chunks (FedConfig.fused_rounds > 1) are only valid
+    # when the round is exactly the plain FedAvg body — subclasses that add
+    # per-round host-side work (server optimizer step, robust post hooks)
+    # set this False.
+    _supports_fused = True
 
     def __init__(
         self,
@@ -134,6 +197,8 @@ class FedAvgAPI:
         self.log_fn = log_fn or (lambda m: None)
         self.rng = jax.random.PRNGKey(config.seed)
         self.global_vars = model.init(jax.random.fold_in(self.rng, 0))
+        self._local_train_fn = local_train_fn
+        self._fused_fns: dict = {}  # (steps, bs) -> jitted multi-round fn
         self.round_fn = self._build_round_fn(local_train_fn)
         self.eval_fn = make_eval_fn(model, task)
         self.history: list = []
@@ -242,25 +307,121 @@ class FedAvgAPI:
             round_client_rngs(round_rng, batch.num_clients),
         )
 
+    def _fused_chunk_len(self, round_idx: int) -> int:
+        """Rounds [round_idx, round_idx+L) that can run as one fused chunk:
+        bounded by fused_rounds, the horizon, and the next eval round
+        (eval fires after rounds where r % frequency == 0)."""
+        cfg = self.config
+        if (
+            cfg.fed.fused_rounds <= 1
+            or not self._supports_fused
+            or self._store is None
+            # full-batch mode sets bs = max client size, which varies per
+            # round — chunks can't share one (steps, bs) shape
+            or cfg.data.batch_size == -1
+        ):
+            return 1
+        L = min(cfg.fed.fused_rounds, cfg.fed.comm_round - round_idx)
+        f = cfg.fed.frequency_of_the_test
+        for off in range(L):
+            if (round_idx + off) % f == 0:
+                # an eval round must be the LAST round of its chunk (eval
+                # reads global_vars right after that round)
+                return off + 1
+        return L
+
+    def train_rounds_fused(self, start_round: int, n_rounds: int):
+        """Run rounds [start_round, start_round+n_rounds) as one on-device
+        scan (see :func:`make_fedavg_multiround`). Returns stacked per-round
+        metrics {loss_sum, correct, count, steps: [T]}."""
+        from fedml_tpu.data.base import bucket_steps
+
+        cfg = self.config
+        store = self._store
+        if cfg.data.batch_size == -1:
+            raise ValueError(
+                "fused rounds do not support batch_size=-1 (full batch): "
+                "bs varies with each round's max client size"
+            )
+        per_round = []
+        max_steps = bs = 0
+        for off in range(n_rounds):
+            r = start_round + off
+            sampled = client_sampling(
+                r, self.data.num_clients, cfg.fed.client_num_per_round
+            )
+            per_round.append((r, sampled))
+            steps_r, bs, _ = bucket_steps(
+                [int(store.counts[i]) for i in sampled],
+                cfg.data.batch_size,
+                cfg.data.pad_bucket,
+            )
+            max_steps = max(max_steps, steps_r)
+        idxs, masks, ns = [], [], []
+        for r, sampled in per_round:
+            idx, mask, _, _ = store.round_indices(
+                sampled, cfg.data.batch_size, seed=cfg.seed * 1_000_003 + r,
+                pad_bucket=cfg.data.pad_bucket, force_steps=max_steps,
+            )
+            idxs.append(idx)
+            masks.append(mask)
+            ns.append([float(store.counts[i]) for i in sampled])
+        key = (max_steps, bs)
+        fn = self._fused_fns.get(key)
+        if fn is None:
+            fn = make_fedavg_multiround(
+                self.model, cfg, max_steps, bs, task=self.task,
+                local_train_fn=self._local_train_fn,
+            )
+            self._fused_fns[key] = fn
+        self.global_vars, metrics = fn(
+            self.global_vars,
+            store.flat_x,
+            store.flat_y,
+            jnp.asarray(np.stack(idxs)),
+            jnp.asarray(np.stack(masks)),
+            jnp.asarray(np.asarray(ns, np.float32)),
+            jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32),
+            self.rng,
+        )
+        return metrics
+
+    def _log_round(self, round_idx: int, metrics, round_time_s: float) -> dict:
+        cfg = self.config
+        count = float(metrics["count"])
+        row = {
+            "round": round_idx,
+            "Train/Loss": float(metrics["loss_sum"]) / max(count, 1e-9),
+            "Train/Acc": float(metrics["correct"]) / max(count, 1e-9),
+            "round_time_s": round_time_s,
+        }
+        if (
+            round_idx % cfg.fed.frequency_of_the_test == 0
+            or round_idx == cfg.fed.comm_round - 1
+        ):
+            row["Test/Loss"], row["Test/Acc"] = self.evaluate_global()
+        self.history.append(row)
+        self.log_fn(row)
+        return row
+
     def train(self) -> Dict[str, float]:
         cfg = self.config
         final = {}
-        for round_idx in range(self.start_round, cfg.fed.comm_round):
+        round_idx = self.start_round
+        while round_idx < cfg.fed.comm_round:
+            L = self._fused_chunk_len(round_idx)
             t0 = time.perf_counter()
-            _, metrics = self.train_round(round_idx)
-            count = float(metrics["count"])
-            row = {
-                "round": round_idx,
-                "Train/Loss": float(metrics["loss_sum"]) / max(count, 1e-9),
-                "Train/Acc": float(metrics["correct"]) / max(count, 1e-9),
-                "round_time_s": time.perf_counter() - t0,
-            }
-            if (
-                round_idx % cfg.fed.frequency_of_the_test == 0
-                or round_idx == cfg.fed.comm_round - 1
-            ):
-                row["Test/Loss"], row["Test/Acc"] = self.evaluate_global()
-            self.history.append(row)
-            self.log_fn(row)
-            final = row
+            if L > 1:
+                metrics = self.train_rounds_fused(round_idx, L)
+                dt = (time.perf_counter() - t0) / L
+                for off in range(L):
+                    m = {k: v[off] for k, v in metrics.items()}
+                    final = self._log_round(round_idx + off, m, dt)
+                round_idx += L
+            else:
+                _, metrics = self.train_round(round_idx)
+                final = self._log_round(
+                    round_idx, metrics, time.perf_counter() - t0
+                )
+                round_idx += 1
         return final

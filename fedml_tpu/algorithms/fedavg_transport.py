@@ -51,6 +51,9 @@ class FedAvgAggregator:
     def check_whether_all_receive(self) -> bool:
         return all(self._flags)
 
+    def received_count(self) -> int:
+        return len(self.model_dict)
+
     def aggregate(self) -> dict:
         idxs = sorted(self.model_dict)
         stacked = jax.tree_util.tree_map(
@@ -139,6 +142,13 @@ class FedAvgServerManager(ServerManager):
         self.worker_num = worker_num or config.fed.client_num_per_round
         self.aggregator = FedAvgAggregator(self.worker_num)
         self.round_idx = 0
+        # Straggler deadline state (FedConfig.deadline_s/min_clients). The
+        # timer thread races the comm receive loop; _round_lock serializes
+        # round completion.
+        self._round_lock = threading.Lock()
+        self._deadline_timer: Optional[threading.Timer] = None
+        self._deadline_passed = False
+        self.dropped_uploads = 0  # late round-tagged uploads discarded
         self.global_vars = jax.device_get(
             model.init(jax.random.fold_in(jax.random.PRNGKey(config.seed), 0))
         )
@@ -158,19 +168,68 @@ class FedAvgServerManager(ServerManager):
             msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
             msg.add_params(MT.ARG_ROUND_IDX, 0)
             self.send_message(msg)
+        self._arm_deadline()
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
             MT.C2S_SEND_MODEL, self._on_model_from_client
         )
 
-    def _on_model_from_client(self, msg: Message):
-        worker = msg.get_sender_id() - 1
-        self.aggregator.add_local_trained_result(
-            worker, msg.get(MT.ARG_MODEL_PARAMS), msg.get(MT.ARG_NUM_SAMPLES)
-        )
-        if not self.aggregator.check_whether_all_receive():
+    # -- straggler deadline (FedConfig.deadline_s) --
+    def _arm_deadline(self):
+        dl = self.config.fed.deadline_s
+        if not dl:
             return
+        self._deadline_passed = False
+        # round generation captured at arm time: cancel() cannot stop a
+        # callback already blocked on _round_lock, so a stale timer must
+        # recognise that its round has already completed
+        self._deadline_timer = threading.Timer(
+            dl, self._on_deadline, args=(self.round_idx,)
+        )
+        self._deadline_timer.daemon = True
+        self._deadline_timer.start()
+
+    def _disarm_deadline(self):
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+            self._deadline_timer = None
+        self._deadline_passed = False
+
+    def _quorum(self) -> int:
+        return max(1, min(self.config.fed.min_clients, self.worker_num))
+
+    def _on_deadline(self, armed_round: int):
+        with self._round_lock:
+            if armed_round != self.round_idx:
+                return  # stale timer: its round already completed
+            self._deadline_passed = True
+            if self.aggregator.received_count() >= self._quorum():
+                self._complete_round()
+            # else: below quorum — complete as soon as the quorum-th
+            # upload arrives (_on_model_from_client checks the flag)
+
+    def _on_model_from_client(self, msg: Message):
+        with self._round_lock:
+            upload_round = msg.get(MT.ARG_ROUND_IDX, self.round_idx)
+            if upload_round != self.round_idx:
+                # straggler reporting for an already-closed round
+                self.dropped_uploads += 1
+                return
+            worker = msg.get_sender_id() - 1
+            self.aggregator.add_local_trained_result(
+                worker, msg.get(MT.ARG_MODEL_PARAMS), msg.get(MT.ARG_NUM_SAMPLES)
+            )
+            if self.aggregator.check_whether_all_receive() or (
+                self._deadline_passed
+                and self.aggregator.received_count() >= self._quorum()
+            ):
+                self._complete_round()
+
+    def _complete_round(self):
+        """Aggregate whatever has arrived, eval, resample, broadcast.
+        Caller holds _round_lock."""
+        self._disarm_deadline()
         self.global_vars = self.aggregator.aggregate()
         row = {"round": self.round_idx}
         eval_now = self.data is not None and (
@@ -204,6 +263,7 @@ class FedAvgServerManager(ServerManager):
             msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
             msg.add_params(MT.ARG_ROUND_IDX, self.round_idx)
             self.send_message(msg)
+        self._arm_deadline()
 
 
 class FedAvgClientManager(ClientManager):
@@ -226,6 +286,9 @@ class FedAvgClientManager(ClientManager):
         out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
         out.add_params(MT.ARG_MODEL_PARAMS, weights)
         out.add_params(MT.ARG_NUM_SAMPLES, n)
+        # round tag: lets the server discard a straggler's upload for an
+        # already-closed round (FedConfig.deadline_s)
+        out.add_params(MT.ARG_ROUND_IDX, round_idx)
         self.send_message(out)
 
 
@@ -236,6 +299,7 @@ def run_federation(
     comm_factory,
     task: str = "classification",
     log_fn=None,
+    trainer_factory=None,
 ):
     """One-process federation over any transport: 1 server + K client actors
     in threads, each on ``comm_factory(rank)`` (a BaseCommManager) — the
@@ -257,13 +321,13 @@ def run_federation(
     shared_train = jax.jit(
         make_local_train(model, config.train, config.fed.epochs, task=task)
     )
-    clients = [
-        FedAvgClientManager(
-            config,
-            comm_factory(rank),
-            rank,
-            LocalTrainer(config, data, model, task, local_train_fn=shared_train),
+    make_trainer = trainer_factory or (
+        lambda rank: LocalTrainer(
+            config, data, model, task, local_train_fn=shared_train
         )
+    )
+    clients = [
+        FedAvgClientManager(config, comm_factory(rank), rank, make_trainer(rank))
         for rank in range(1, K + 1)
     ]
     errors: List[BaseException] = []

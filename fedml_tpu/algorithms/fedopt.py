@@ -47,6 +47,7 @@ def make_server_optimizer(sc: ServerConfig) -> optax.GradientTransformation:
 
 
 class FedOptAPI(FedAvgAPI):
+    _supports_fused = False  # per-round host-side work forbids chunk fusion
     """FedOpt simulator: FedAvgAPI with a server-optimizer step appended to
     each round (ref standalone/fedopt/fedopt_api.py:34-109)."""
 

@@ -33,6 +33,7 @@ def assign_groups(num_clients: int, group_num: int, seed: int = 0) -> List[np.nd
 
 
 class HierarchicalFedAvgAPI(FedAvgAPI):
+    _supports_fused = False  # per-round host-side work forbids chunk fusion
     """Two-level FedAvg simulator. Reuses the inherited jitted round function
     for every group sub-round; only the orchestration differs."""
 

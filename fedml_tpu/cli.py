@@ -95,6 +95,9 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm")
               help="Capture a jax.profiler device trace of the run into this dir")
 @click.option("--no_device_cache", is_flag=True, default=False,
               help="Disable the HBM-resident data store (data/device_store.py)")
+@click.option("--fused_rounds", type=int, default=1,
+              help="Run up to N rounds as one on-device lax.scan chunk "
+                   "(fedavg/fedprox + vmap runtime; needs the device cache)")
 @click.option("--ci", is_flag=True, default=False, help="CI short-circuit (1 round smoke)")
 def main(**opt):
     """Train a federated model on TPU."""
@@ -120,6 +123,7 @@ def build_config(opt) -> RunConfig:
             ci=opt["ci"],
             group_num=opt["group_num"],
             group_comm_round=opt["group_comm_round"],
+            fused_rounds=opt.get("fused_rounds", 1),
         ),
         train=TrainConfig(
             client_optimizer=opt["client_optimizer"],

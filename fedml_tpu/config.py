@@ -51,6 +51,19 @@ class FedConfig:
     # FedAvg sub-rounds inside every group before the cross-group average.
     group_num: int = 1
     group_comm_round: int = 1
+    # Straggler tolerance for the transport runtime (the reference's
+    # aggregator barrier waits forever — FedAVGAggregator.py:43-49, SURVEY §5
+    # "no straggler mitigation"). deadline_s > 0: after broadcasting, the
+    # server waits at most deadline_s for uploads; once the deadline passes
+    # and at least min_clients have reported, it aggregates the partial set
+    # and discards late round-tagged uploads. 0 = wait for all (ref parity).
+    deadline_s: float = 0.0
+    min_clients: int = 1
+    # Fused round chunks (vmap runtime + HBM data store only): run up to
+    # this many rounds as ONE jitted lax.scan — zero host round-trips inside
+    # the chunk. 1 = eager per-round dispatch. Chunks never span an eval
+    # round, so observed metrics are identical to the eager loop.
+    fused_rounds: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

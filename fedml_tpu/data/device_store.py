@@ -63,6 +63,40 @@ class DeviceDataStore:
         self.flat_x = jnp.asarray(np.concatenate(data.client_x, axis=0))
         self.flat_y = jnp.asarray(np.concatenate(data.client_y, axis=0))
 
+    def round_indices(
+        self,
+        client_indices: Sequence[int],
+        batch_size: int,
+        seed: int = 0,
+        pad_bucket: int = 1,
+        shuffle: bool = True,
+        force_steps: int = None,
+    ):
+        """Host-side index/mask matrices for one round's gather:
+        (idx [C, cap] int32, mask [C, cap] float32, steps, bs).
+        ``force_steps`` overrides the bucketed step count so a fused
+        multi-round scan can use one uniform shape across rounds (the extra
+        all-padding steps are gated no-ops in the local-train scan)."""
+        ns = [int(self.counts[i]) for i in client_indices]
+        steps, bs, cap = bucket_steps(ns, batch_size, pad_bucket)
+        if force_steps is not None:
+            if force_steps < steps:
+                raise ValueError(
+                    f"force_steps={force_steps} < required steps={steps}"
+                )
+            steps, cap = force_steps, force_steps * bs
+
+        rng = np.random.default_rng(seed)
+        C = len(client_indices)
+        idx = np.zeros((C, cap), dtype=np.int32)
+        mask = np.zeros((C, cap), dtype=np.float32)
+        for j, ci in enumerate(client_indices):
+            n = ns[j]
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            idx[j, :n] = self.offsets[ci] + order
+            mask[j, :n] = 1.0
+        return idx, mask, steps, bs
+
     def round_batch(
         self,
         client_indices: Sequence[int],
@@ -75,17 +109,11 @@ class DeviceDataStore:
         shape contract as :func:`stack_clients`; padded slots index row 0
         and are mask-0."""
         ns = [int(self.counts[i]) for i in client_indices]
-        steps, bs, cap = bucket_steps(ns, batch_size, pad_bucket)
-
-        rng = np.random.default_rng(seed)
+        idx, mask, steps, bs = self.round_indices(
+            client_indices, batch_size, seed=seed, pad_bucket=pad_bucket,
+            shuffle=shuffle,
+        )
         C = len(client_indices)
-        idx = np.zeros((C, cap), dtype=np.int32)
-        mask = np.zeros((C, cap), dtype=np.float32)
-        for j, ci in enumerate(client_indices):
-            n = ns[j]
-            order = rng.permutation(n) if shuffle else np.arange(n)
-            idx[j, :n] = self.offsets[ci] + order
-            mask[j, :n] = 1.0
         mask_dev = jnp.asarray(mask)
         x, y = _gather(self.flat_x, self.flat_y, jnp.asarray(idx), mask_dev)
         feat = self.flat_x.shape[1:]

@@ -164,7 +164,19 @@ def make_local_train(
             params, extra, opt_state = carry
             ep_rng = jax.random.fold_in(rng, epoch_idx)
             if reshuffle_each_epoch:
-                perm = jax.random.permutation(ep_rng, n_flat)
+                # Mask-aware shuffle: draw a key per slot, pin padded slots
+                # to +inf, argsort. Valid samples (slots 0..n-1 by the
+                # stacking contract) get a random order in the FIRST
+                # ceil(n/bs) minibatches; padding compacts to trailing
+                # all-padding steps (gated no-ops below). Because uniform
+                # draws are per-position (threefry partitionable) and valid
+                # slots always occupy the prefix, the minibatch composition
+                # is INDEPENDENT of the padded capacity — the fused
+                # multi-round scan (uniform chunk shapes) and the eager
+                # per-round path see identical math.
+                keys = jax.random.uniform(ep_rng, (n_flat,))
+                keys = jnp.where(m_flat > 0, keys, jnp.inf)
+                perm = jnp.argsort(keys)
             else:
                 perm = jnp.arange(n_flat)
             xe = x_flat[perm].reshape(x.shape)
