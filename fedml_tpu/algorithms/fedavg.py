@@ -359,13 +359,13 @@ class FedAvgAPI:
             max_steps = max(max_steps, steps_r)
         idxs, masks, ns = [], [], []
         for r, sampled in per_round:
-            idx, mask, _, _ = store.round_indices(
+            idx, mask, _, _, ns_r = store.round_indices(
                 sampled, cfg.data.batch_size, seed=cfg.seed * 1_000_003 + r,
                 pad_bucket=cfg.data.pad_bucket, force_steps=max_steps,
             )
             idxs.append(idx)
             masks.append(mask)
-            ns.append([float(store.counts[i]) for i in sampled])
+            ns.append(ns_r)
         key = (max_steps, bs)
         fn = self._fused_fns.get(key)
         if fn is None:

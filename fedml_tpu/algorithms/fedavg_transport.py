@@ -14,6 +14,7 @@ not JSON lists."""
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional
 
@@ -149,6 +150,7 @@ class FedAvgServerManager(ServerManager):
         self._deadline_timer: Optional[threading.Timer] = None
         self._deadline_passed = False
         self.dropped_uploads = 0  # late round-tagged uploads discarded
+        self.deadline_error: Optional[BaseException] = None
         self.global_vars = jax.device_get(
             model.init(jax.random.fold_in(jax.random.PRNGKey(config.seed), 0))
         )
@@ -200,18 +202,33 @@ class FedAvgServerManager(ServerManager):
         return max(1, min(self.config.fed.min_clients, self.worker_num))
 
     def _on_deadline(self, armed_round: int):
-        with self._round_lock:
-            if armed_round != self.round_idx:
-                return  # stale timer: its round already completed
-            self._deadline_passed = True
-            if self.aggregator.received_count() >= self._quorum():
-                self._complete_round()
+        try:
+            with self._round_lock:
+                if armed_round != self.round_idx:
+                    return  # stale timer: its round already completed
+                self._deadline_passed = True
+                if self.aggregator.received_count() >= self._quorum():
+                    self._complete_round()
+        except BaseException as e:  # noqa: BLE001
+            # the timer thread would otherwise swallow this and leave the
+            # server parked on its inbox forever; surface it through finish()
+            self.deadline_error = e
+            self.finish()
             # else: below quorum — complete as soon as the quorum-th
             # upload arrives (_on_model_from_client checks the flag)
 
     def _on_model_from_client(self, msg: Message):
         with self._round_lock:
-            upload_round = msg.get(MT.ARG_ROUND_IDX, self.round_idx)
+            # missing tag (pre-tag client version) fails SAFE: -1 never
+            # matches, so an unattributable upload is dropped, not averaged
+            # into whatever round happens to be open
+            upload_round = msg.get(MT.ARG_ROUND_IDX, -1)
+            if upload_round == -1:
+                logging.warning(
+                    "dropping untagged model upload from sender %s "
+                    "(client protocol predates round tags?)",
+                    msg.get_sender_id(),
+                )
             if upload_round != self.round_idx:
                 # straggler reporting for an already-closed round
                 self.dropped_uploads += 1
@@ -349,6 +366,10 @@ def run_federation(
         t.start()
     server.send_init_msg()
     server.run()  # blocks until FINISH or a client failure stops the loop
+    if getattr(server, "deadline_error", None) is not None:
+        for c in clients:
+            c.finish()
+        raise RuntimeError("server deadline path failed") from server.deadline_error
     if errors:
         # release the surviving client threads before raising — they would
         # otherwise park on inbox.get() for the process lifetime.

@@ -73,7 +73,9 @@ class DeviceDataStore:
         force_steps: int = None,
     ):
         """Host-side index/mask matrices for one round's gather:
-        (idx [C, cap] int32, mask [C, cap] float32, steps, bs).
+        (idx [C, cap] int32, mask [C, cap] float32, steps, bs, ns).
+        ``ns`` is the per-client true sample count — the single source for
+        aggregation weights (eager and fused paths must not re-derive it).
         ``force_steps`` overrides the bucketed step count so a fused
         multi-round scan can use one uniform shape across rounds (the extra
         all-padding steps are gated no-ops in the local-train scan)."""
@@ -95,7 +97,7 @@ class DeviceDataStore:
             order = rng.permutation(n) if shuffle else np.arange(n)
             idx[j, :n] = self.offsets[ci] + order
             mask[j, :n] = 1.0
-        return idx, mask, steps, bs
+        return idx, mask, steps, bs, ns
 
     def round_batch(
         self,
@@ -108,8 +110,7 @@ class DeviceDataStore:
         """Device-array ClientBatch for the sampled clients. Same bucketed
         shape contract as :func:`stack_clients`; padded slots index row 0
         and are mask-0."""
-        ns = [int(self.counts[i]) for i in client_indices]
-        idx, mask, steps, bs = self.round_indices(
+        idx, mask, steps, bs, ns = self.round_indices(
             client_indices, batch_size, seed=seed, pad_bucket=pad_bucket,
             shuffle=shuffle,
         )
