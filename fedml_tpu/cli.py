@@ -50,7 +50,7 @@ ALGORITHMS = (
     "decentralized",
     "secagg",
 )
-RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm")
+RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 
 
 @click.command()
@@ -98,6 +98,13 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm")
 @click.option("--fused_rounds", type=int, default=1,
               help="Run up to N rounds as one on-device lax.scan chunk "
                    "(fedavg/fedprox + vmap runtime; needs the device cache)")
+@click.option("--rank", type=int, default=None,
+              help="runtime=grpc: this process's rank (0 = server, 1..K = "
+                   "clients; ref main_fedavg_rpc.py --fl_worker_index)")
+@click.option("--ip_config", type=click.Path(path_type=Path), default=None,
+              help="runtime=grpc: CSV rank,ip table (ref grpc_ipconfig.csv); "
+                   "default localhost for all ranks")
+@click.option("--base_port", type=int, default=8890)
 @click.option("--ci", is_flag=True, default=False, help="CI short-circuit (1 round smoke)")
 def main(**opt):
     """Train a federated model on TPU."""
@@ -176,6 +183,16 @@ def run(**opt):
                 )
 
     _validate_variant(opt)
+    if opt["runtime"] == "grpc":
+        # true multi-process federation: this process is ONE participant
+        # (ref main_fedavg_rpc.py per-process drivers + run_*.sh launchers)
+        if opt["algorithm"] != "fedavg":
+            raise click.UsageError("runtime=grpc currently supports algorithm=fedavg")
+        final = _run_grpc_process(config, data, model, task, log_fn, opt)
+        logger.close()
+        click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
+        return None
+
     builder = _LONGTAIL.get(opt["algorithm"])
     if builder is not None:
         if opt["resume"]:
@@ -545,6 +562,55 @@ def _run_centralized(config, data, model, task, log_fn, opt):
         config, data, model, task=task, mesh=mesh, log_fn=log_fn
     )
     return trainer.train()
+
+
+def _run_grpc_process(config, data, model, task, log_fn, opt):
+    """One federation participant over gRPC: rank 0 = server FSM, rank 1..K
+    = client actor. Every process loads the same config/data (deterministic
+    partition from the shared seed), mirroring the reference's
+    one-process-per-worker model (FedAvgAPI.py:14-27)."""
+    from fedml_tpu.algorithms.fedavg_transport import (
+        FedAvgClientManager,
+        FedAvgServerManager,
+        LocalTrainer,
+    )
+    from fedml_tpu.core.grpc_comm import GrpcCommManager, read_ip_config
+
+    rank = opt["rank"]
+    if rank is None:
+        raise click.UsageError("runtime=grpc requires --rank")
+    K = config.fed.client_num_per_round
+    if opt["ip_config"]:
+        table = read_ip_config(str(opt["ip_config"]))
+    else:
+        table = {r: "127.0.0.1" for r in range(K + 1)}
+    comm = GrpcCommManager(rank, table, base_port=opt["base_port"])
+    if rank == 0:
+        server = FedAvgServerManager(
+            config, comm, model, data=data, task=task, worker_num=K,
+            log_fn=log_fn,
+        )
+        server.send_init_msg()
+        server.run()
+        if server.deadline_error is not None:
+            # release the client processes before surfacing the failure —
+            # they would otherwise park on their inboxes
+            from fedml_tpu.core.message import Message, MessageType as MT
+
+            for worker in range(1, K + 1):
+                try:
+                    server.send_message(Message(MT.FINISH, 0, worker))
+                except Exception:  # noqa: BLE001
+                    pass
+            raise RuntimeError(
+                "server deadline path failed"
+            ) from server.deadline_error
+        return server.history[-1] if server.history else {}
+    client = FedAvgClientManager(
+        config, comm, rank, LocalTrainer(config, data, model, task)
+    )
+    client.run()
+    return {"rank": rank, "finished": True}
 
 
 _LONGTAIL = {

@@ -63,6 +63,7 @@ class GrpcCommManager(BaseCommManager):
         self.base_port = base_port
         self._q: "queue.Queue" = queue.Queue()
         self._channels: Dict[int, object] = {}
+        self._handshaken: set = set()
         self._grpc = grpc
 
         def handle(request: bytes, context) -> bytes:
@@ -103,8 +104,21 @@ class GrpcCommManager(BaseCommManager):
             _METHOD, request_serializer=None, response_deserializer=None
         )
 
-    def send_message(self, msg: Message) -> None:
-        self._stub(msg.get_receiver_id())(msg.to_bytes())
+    def send_message(self, msg: Message, timeout: Optional[float] = 30.0) -> None:
+        # wait_for_ready on the FIRST send per peer only: multi-process
+        # federation has no startup-order guarantee (ref run_*.sh scripts
+        # just background processes), so the handshake send blocks until the
+        # peer's server is up. After that a dead peer must fail FAST —
+        # _complete_round broadcasts while holding the round lock, and a
+        # 10-minute stall there would freeze every live client too.
+        receiver = msg.get_receiver_id()
+        first = receiver not in self._handshaken
+        self._stub(receiver)(
+            msg.to_bytes(),
+            wait_for_ready=first,
+            timeout=120.0 if first else timeout,
+        )
+        self._handshaken.add(receiver)
 
     def handle_receive_message(self) -> None:
         while True:

@@ -40,6 +40,24 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _masked_scores(q, k, qi, ki, *, scale, causal, block_q, block_k):
+    """Scaled scores for one (Q block, K block) pair with the causal mask —
+    the ONE definition shared by forward and both backward kernels (a
+    divergence here is the classic silent fwd/bwd gradient mismatch)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [Bq, Bk]
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -57,19 +75,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def _step():
-        q = q_ref[0]  # [Bq, d]
-        k = k_ref[0]  # [Bk, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [Bq, Bk]
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+        s = _masked_scores(
+            q_ref[0], k_ref[0], qi, ki, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
         m_prev = m_ref[:, :1]  # [Bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)  # [Bq, Bk]
@@ -161,19 +170,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _step():
-        q = q_ref[0]
         k = k_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+        s = _masked_scores(
+            q_ref[0], k, qi, ki, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
         p = jnp.exp(s - lse_ref[0, 0][:, None])  # [Bq, Bk]
         dov = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
@@ -209,18 +210,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def _step():
         q = q_ref[0]
-        k = k_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+        s = _masked_scores(
+            q, k_ref[0], qi, ki, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
         p = jnp.exp(s - lse_ref[0, 0][:, None])  # [Bq, Bk]
         do = do_ref[0].astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
@@ -373,6 +366,13 @@ def flash_attention(
     q3 = q.reshape((-1, S, d))
     k3 = k.reshape((-1, Sk, d))
     v3 = v.reshape((-1, Sk, d))
+    if q3.shape[0] != k3.shape[0] or k3.shape != v3.shape:
+        # the grid is sized from Q's batch*heads; a smaller K/V (e.g. MQA
+        # [B, 1, S, d]) would clamp block indices on TPU → silently wrong
+        raise ValueError(
+            f"q/k/v leading (batch, heads) dims must match: q {q.shape}, "
+            f"k {k.shape}, v {v.shape} (broadcast MQA/GQA heads first)"
+        )
     out = _flash(q3, k3, v3, causal, block_q, block_k, interpret)
     return out.reshape(orig_shape)
 

@@ -80,6 +80,47 @@ def test_centralized_dp_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_sync_batchnorm_under_dp_mesh():
+    """The reference needs 457 LoC of sync-BN helpers (batchnorm_utils.py)
+    to make multi-GPU BatchNorm see the global batch. Under GSPMD the same
+    guarantee is automatic: BN's batch mean is a reduction over a sharded
+    axis, so XLA inserts the cross-device collective — batch_stats after a
+    DP step over 8 devices equal the single-device stats."""
+    import flax.linen as nn
+    import jax
+
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+
+    class BNNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            h = nn.Dense(8, name="fc1")(x)
+            h = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, name="bn"
+            )(h)
+            return nn.Dense(NUM_CLASSES, name="fc2")(nn.relu(h))
+
+    model = ModelDef(
+        BNNet(), input_shape=FEAT, num_classes=NUM_CLASSES,
+        has_batch_stats=True, name="bnnet",
+    )
+    data = _data()
+    single = CentralizedTrainer(_config(), data, model)
+    dp = CentralizedTrainer(
+        _config(), data, model, mesh=make_mesh(8, "batch")
+    )
+    for e in range(2):
+        single.train_epoch(e)
+        dp.train_epoch(e)
+    s_stats = jax.tree_util.tree_leaves(single.extra["batch_stats"])
+    d_stats = jax.tree_util.tree_leaves(dp.extra["batch_stats"])
+    for a, b in zip(s_stats, d_stats):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_centralized_full_batch_and_cli():
     from click.testing import CliRunner
     from fedml_tpu.cli import main
