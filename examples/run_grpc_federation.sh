@@ -19,6 +19,7 @@ common=(--algorithm fedavg --runtime grpc
         --base_port "$PORT" --seed 1)
 
 pids=()
+trap '[ "${#pids[@]}" -gt 0 ] && kill "${pids[@]}" 2>/dev/null || true' EXIT
 for rank in $(seq 1 "$CLIENTS"); do
   python -m fedml_tpu "${common[@]}" --rank "$rank" &
   pids+=($!)

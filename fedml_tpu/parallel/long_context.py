@@ -19,14 +19,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.models.transformer import TransformerLM
 from fedml_tpu.parallel.ring_attention import ring_attention_sharded
+from fedml_tpu.parallel.ulysses import ulysses_attention_sharded
 
 
-def make_sp_lm(vocab_size: int, axis_name: str = "seq", **model_kw) -> TransformerLM:
-    """TransformerLM wired with ring attention over ``axis_name`` (must be
-    called inside shard_map)."""
-    attn = functools.partial(
-        ring_attention_sharded, axis_name=axis_name, causal=True
-    )
+def make_sp_lm(
+    vocab_size: int,
+    axis_name: str = "seq",
+    sp_impl: str = "ring",
+    local_attn_fn=None,
+    **model_kw,
+) -> TransformerLM:
+    """TransformerLM wired with sequence-parallel attention over
+    ``axis_name`` (must be called inside shard_map). ``sp_impl``: "ring"
+    (K/V rotation, ring_attention.py) or "ulysses" (all-to-all head
+    re-sharding, ulysses.py; needs num_heads % axis_size == 0).
+    ``local_attn_fn`` (ulysses only) replaces the per-device attention core
+    on the gathered [B, T, H_local, D] blocks — e.g. a flash-backed callable
+    so long sequences never materialise T×T scores."""
+    if sp_impl == "ring":
+        if local_attn_fn is not None:
+            raise ValueError("local_attn_fn is only meaningful for ulysses")
+        attn = functools.partial(
+            ring_attention_sharded, axis_name=axis_name, causal=True
+        )
+    elif sp_impl == "ulysses":
+        attn = functools.partial(
+            ulysses_attention_sharded,
+            axis_name=axis_name,
+            causal=True,
+            attn_fn=local_attn_fn,
+        )
+    else:
+        raise ValueError(f"unknown sp_impl {sp_impl!r} (ring|ulysses)")
     return TransformerLM(vocab_size=vocab_size, attn_fn=attn, **model_kw)
 
 
@@ -35,6 +59,8 @@ def make_sp_train_step(
     vocab_size: int,
     lr: float = 1e-3,
     axis_name: str = "seq",
+    sp_impl: str = "ring",
+    local_attn_fn=None,
     **model_kw,
 ):
     """Build (init_fn, step_fn) for sequence-parallel LM training.
@@ -43,7 +69,18 @@ def make_sp_train_step(
     [B, T] sharded on T over the mesh; params replicated. The loss mean and
     grads are psum'd over the ring — one SPMD program, no host round-trips.
     """
-    model = make_sp_lm(vocab_size, axis_name, **model_kw)
+    if sp_impl == "ulysses":
+        heads = model_kw.get("num_heads", TransformerLM.num_heads)
+        n = mesh.shape[axis_name]
+        if heads % n:
+            raise ValueError(
+                f"ulysses needs num_heads % mesh axis size == 0; got "
+                f"num_heads={heads}, {axis_name}={n}"
+            )
+    model = make_sp_lm(
+        vocab_size, axis_name, sp_impl=sp_impl, local_attn_fn=local_attn_fn,
+        **model_kw,
+    )
     opt = optax.adamw(lr)
 
     def shard_body(params, opt_state, tokens, targets):
