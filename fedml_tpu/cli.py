@@ -83,6 +83,8 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--group_comm_round", type=int, default=1)
 @click.option("--compute_dtype", type=click.Choice(("float32", "bfloat16")), default="float32",
               help="Forward/backward dtype; params stay fp32 (master weights)")
+@click.option("--augment", type=click.Choice(("none", "cifar", "crop_flip")), default="none",
+              help="Device-side augmentation inside the jitted train step")
 @click.option("--variant", default=None,
               help="Algorithm sub-variant: decentralized dsgd|pushsum, fednas arch_grad first|second")
 @click.option("--seed", type=int, default=0)
@@ -139,6 +141,7 @@ def build_config(opt) -> RunConfig:
             momentum=opt["momentum"],
             prox_mu=opt["prox_mu"] if opt["algorithm"] == "fedprox" else 0.0,
             compute_dtype=opt.get("compute_dtype", "float32"),
+            augment=opt.get("augment", "none"),
         ),
         server=ServerConfig(
             server_optimizer=opt["server_optimizer"],

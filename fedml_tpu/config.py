@@ -81,6 +81,11 @@ class TrainConfig:
     # weights); forward/backward run in this dtype. "bfloat16" is the TPU
     # MXU-native dtype (the reference is fp32-only torch).
     compute_dtype: str = "float32"
+    # Device-side augmentation policy applied inside the jitted train step
+    # (train/augment.py): "none" | "cifar" (crop pad-4 + flip + Cutout 16,
+    # the reference's CifarDataLoader transforms, base.py:136-146) |
+    # "crop_flip".
+    augment: str = "none"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -7,8 +7,9 @@ synthesized by the LDA/homo partitioner (partition/noniid.py — the pure-numpy
 port of fedml_core/non_iid_partition/). Normalization constants match the
 reference exactly (cifar10/data_loader.py:6-7, cifar100:12-13, cinic10:14-15).
 Cutout/random-crop augmentation (base.py:136-146) is deliberately host-free:
-random augmentation belongs inside the jit'd train step (future work), and
-eval parity doesn't need it."""
+it runs inside the jit'd train step (train/augment.py, enabled with
+``TrainConfig.augment="cifar"``), so stored samples stay canonical and the
+HBM-resident store keeps working; eval parity doesn't need it."""
 
 from __future__ import annotations
 

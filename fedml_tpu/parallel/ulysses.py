@@ -84,9 +84,10 @@ def make_ulysses_attention(
             P(None, axis_name, None, None),
         ),
         out_specs=P(None, axis_name, None, None),
-        # pallas_call out_shapes carry no varying-mesh-axes info; a flash
-        # attn_fn inside this shard_map trips check_vma otherwise
-        check_vma=False,
+        # pallas_call out_shapes carry no varying-mesh-axes info, so a flash
+        # attn_fn would trip check_vma; keep validation ON for the default
+        # full-attention core
+        check_vma=(attn_fn is None),
     )
 
     @jax.jit

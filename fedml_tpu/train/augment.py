@@ -1,0 +1,109 @@
+"""Device-side data augmentation — random crop, horizontal flip, Cutout.
+
+The reference augments on the host via torchvision transforms + its own
+Cutout (fedml_api/data_preprocessing/base.py:136-146: RandomCrop(32, pad 4),
+RandomHorizontalFlip, Cutout(16) for the CIFAR/CINIC loaders). On TPU the
+host is the wrong place: per-sample torch-style transforms would serialize
+on CPU and re-ship the batch every step. Here augmentation is a pure
+jit-compiled function applied INSIDE the training step (hooked into
+train/client.make_mixed_forward), so it fuses with the forward pass and
+the HBM-resident data store keeps working — the stored samples stay
+canonical, each epoch sees fresh randomness via the step PRNG.
+
+All ops are static-shape: pad + per-sample dynamic_slice (crop), where-mask
+(flip), coordinate-compare mask (cutout)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _crop_one(rng, img, pad: int, fill):
+    H, W, C = img.shape
+    padded = jnp.pad(img, ((pad, pad), (pad, pad), (0, 0)))
+    if fill is not None:
+        border = jnp.pad(
+            jnp.ones((H, W, 1), img.dtype), ((pad, pad), (pad, pad), (0, 0))
+        )
+        padded = jnp.where(
+            border > 0, padded, jnp.asarray(fill, img.dtype)
+        )
+    oy = jax.random.randint(rng, (), 0, 2 * pad + 1)
+    ox = jax.random.randint(jax.random.fold_in(rng, 1), (), 0, 2 * pad + 1)
+    return jax.lax.dynamic_slice(padded, (oy, ox, 0), (H, W, C))
+
+
+def _flip_one(rng, img):
+    return jnp.where(jax.random.bernoulli(rng), img[:, ::-1, :], img)
+
+
+def _cutout_one(rng, img, size: int):
+    """Zero a size×size square at a random center (clipped at the edges —
+    the reference Cutout's np.clip semantics)."""
+    H, W, _ = img.shape
+    cy = jax.random.randint(rng, (), 0, H)
+    cx = jax.random.randint(jax.random.fold_in(rng, 1), (), 0, W)
+    ys = jnp.arange(H)
+    xs = jnp.arange(W)
+    half = size // 2
+    keep_y = (ys < cy - half) | (ys >= cy + half)
+    keep_x = (xs < cx - half) | (xs >= cx + half)
+    keep = keep_y[:, None] | keep_x[None, :]
+    return img * keep[:, :, None].astype(img.dtype)
+
+
+def make_augment(
+    crop_padding: int = 4,
+    flip: bool = True,
+    cutout_size: int = 16,
+    crop_fill=None,
+) -> Callable:
+    """Returns ``augment(rng, x)`` for x [B, H, W, C]: per-sample random
+    crop / horizontal flip / Cutout, vmapped over the batch.
+
+    ``crop_fill``: border value for the crop padding (scalar or [C]).
+    ``None`` pads with 0 — the MEAN pixel when inputs are already
+    normalized, which is a deliberate deviation from the reference pipeline
+    (RandomCrop pads black BEFORE Normalize, so its borders are
+    (0-mean)/std per channel); pass that value here for exact parity."""
+
+    def one(rng, img):
+        if img.ndim != 3:
+            raise ValueError(
+                f"augmentation expects image samples [H, W, C]; got shape "
+                f"{img.shape} — disable TrainConfig.augment for non-image "
+                "tasks"
+            )
+        if crop_padding:
+            img = _crop_one(
+                jax.random.fold_in(rng, 0), img, crop_padding, crop_fill
+            )
+        if flip:
+            img = _flip_one(jax.random.fold_in(rng, 1), img)
+        if cutout_size:
+            img = _cutout_one(jax.random.fold_in(rng, 2), img, cutout_size)
+        return img
+
+    def augment(rng, x):
+        keys = jax.random.split(rng, x.shape[0])
+        return jax.vmap(one)(keys, x)
+
+    return augment
+
+
+def resolve_augment(name: str) -> Optional[Callable]:
+    """TrainConfig.augment → augment fn. "none" → None; "cifar" → the
+    reference's CIFAR policy shape (crop pad 4 + flip + Cutout 16,
+    base.py:136-146; crop borders are mean-pixel, see make_augment's
+    crop_fill note); "crop_flip" → without Cutout."""
+    if name in ("", "none", None):
+        return None
+    if name == "cifar":
+        return make_augment(crop_padding=4, flip=True, cutout_size=16)
+    if name == "crop_flip":
+        return make_augment(crop_padding=4, flip=True, cutout_size=0)
+    raise ValueError(f"unknown augment policy {name!r} (none|cifar|crop_flip)")

@@ -63,15 +63,27 @@ def make_mixed_forward(model: ModelDef, tc: TrainConfig):
     """The shared mixed-precision forward: fp32 master params are cast to
     ``tc.compute_dtype`` inside the differentiated function (the cast is
     linear, so grads come back fp32); logits and mutable collections (BN
-    stats) are restored to fp32 so scan carries keep stable dtypes.
+    stats) are restored to fp32 so scan carries keep stable dtypes. When
+    ``tc.augment`` names a policy (train/augment.py), per-sample
+    augmentation runs here — inside jit, fused with the forward — so both
+    the federated and centralized paths share one definition.
 
     Returns ``fwd(params, extra, xb, step_rng) -> (logits_f32, new_extra_f32)``.
     Used by both the per-client local-train scan and the centralized DP
     trainer so the compute-dtype policy can never diverge between them."""
+    from fedml_tpu.train.augment import resolve_augment
+
     cdt = jnp.dtype(tc.compute_dtype)
     mixed = cdt != jnp.dtype(jnp.float32)
+    augment_fn = resolve_augment(getattr(tc, "augment", "none"))
 
     def fwd(params, extra, xb, step_rng):
+        if augment_fn is not None:
+            if step_rng is None:
+                # a silent PRNGKey(0) fallback would freeze one augmentation
+                # pattern for the whole run — fail loudly instead
+                raise ValueError("augmentation requires a step rng")
+            xb = augment_fn(jax.random.fold_in(step_rng, 7), xb)
         if mixed:
             params_c = cast_floats(params, cdt)
             extra_c = cast_floats(extra, cdt)

@@ -1,0 +1,101 @@
+"""Device-side augmentation (train/augment.py — ref CifarDataLoader
+transforms + Cutout, base.py:136-146): geometry, determinism, padded-zero
+invariance, and the TrainConfig.augment hook into the shared forward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.train.augment import make_augment, resolve_augment
+
+
+def _imgs(B=4, H=32, W=32, C=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(B, H, W, C)).astype(np.float32) + 1.0)
+
+
+def test_shapes_and_determinism():
+    aug = make_augment()
+    x = _imgs()
+    key = jax.random.PRNGKey(3)
+    a = aug(key, x)
+    b = aug(key, x)
+    assert a.shape == x.shape
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = aug(jax.random.PRNGKey(4), x)
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 0
+
+
+def test_cutout_zeroes_a_square():
+    aug = make_augment(crop_padding=0, flip=False, cutout_size=8)
+    x = _imgs()
+    out = np.asarray(aug(jax.random.PRNGKey(0), x))
+    for i in range(x.shape[0]):
+        zeroed = (out[i] == 0).all(axis=-1)
+        n = zeroed.sum()
+        # full square = 64; clipped at the edge can be less, never more
+        assert 0 < n <= 64
+        ys, xs = np.where(zeroed)
+        # zeroed region is a contiguous rectangle
+        assert (ys.max() - ys.min() + 1) * (xs.max() - xs.min() + 1) == n
+
+
+def test_crop_is_a_translation():
+    aug = make_augment(crop_padding=2, flip=False, cutout_size=0)
+    x = _imgs(B=8)
+    out = np.asarray(aug(jax.random.PRNGKey(1), x))
+    xn = np.asarray(x)
+    padded = np.pad(xn, ((0, 0), (2, 2), (2, 2), (0, 0)))
+    for i in range(8):
+        found = any(
+            np.array_equal(out[i], padded[i, oy : oy + 32, ox : ox + 32])
+            for oy in range(5)
+            for ox in range(5)
+        )
+        assert found
+
+
+def test_padded_zero_samples_stay_zero():
+    aug = resolve_augment("cifar")
+    z = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    out = aug(jax.random.PRNGKey(0), z)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(z))
+
+
+def test_train_step_with_augment_runs_and_none_is_identity():
+    from fedml_tpu.config import TrainConfig
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+    from fedml_tpu.train.client import make_local_train
+
+    model = ModelDef(
+        CNNOriginalFedAvg(num_classes=5), (28, 28, 1), 5, name="cnn"
+    )
+    variables = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 4, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 5, size=(2, 4)), jnp.int32)
+    m = jnp.ones((2, 4), jnp.float32)
+
+    out = {}
+    for policy in ("none", "crop_flip"):
+        tc = TrainConfig(client_optimizer="sgd", lr=0.1, augment=policy)
+        fn = jax.jit(make_local_train(model, tc, epochs=1))
+        new_vars, metrics = fn(variables, x, y, m, jax.random.PRNGKey(7))
+        assert np.isfinite(float(metrics["loss_sum"]))
+        out[policy] = new_vars
+    # augmentation actually changed the training trajectory
+    diffs = [
+        np.abs(np.asarray(a) - np.asarray(b)).max()
+        for a, b in zip(
+            jax.tree_util.tree_leaves(out["none"]),
+            jax.tree_util.tree_leaves(out["crop_flip"]),
+        )
+    ]
+    assert max(diffs) > 0
+
+
+def test_unknown_policy_raises():
+    with pytest.raises(ValueError):
+        resolve_augment("mixup")
