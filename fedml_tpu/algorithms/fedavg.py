@@ -217,6 +217,7 @@ class FedAvgAPI:
                 except Exception:
                     self._store = None  # ragged feature shapes etc.
         self._test_dev = None
+        self._local_eval_dev = None  # local_test_on_all_clients cache
 
     def _build_round_fn(self, local_train_fn):
         return make_fedavg_round(
@@ -262,23 +263,65 @@ class FedAvgAPI:
     def _round_batch(self, sampled, round_idx: int):
         return self._stack(sampled, self.config.seed * 1_000_003 + round_idx)
 
+    def local_test_on_all_clients(self, round_idx: int = 0) -> Dict[str, float]:
+        """Evaluate the global model on every client's local data (ref
+        fedavg_api.py:117-180 ``_local_test_on_all_clients``): train metrics
+        over all clients' train shards, test metrics over their test shards
+        (falling back to the central test set when the dataset has no
+        per-client test split). The reference aggregates per-client sums
+        with sample weights — identical to pooled evaluation, so the shards
+        are concatenated and run through the jitted eval fn in one pass.
+        ``fed.ci`` short-circuits to client 0 only (ref :162-167)."""
+        from fedml_tpu.train.evaluate import pad_to_batches
+
+        if self._local_eval_dev is None:
+            # the pooled shards are round-invariant: pad + place on device
+            # ONCE (same reason evaluate_global caches _test_dev)
+            ci = self.config.fed.ci
+            ids = [0] if ci else range(self.data.num_clients)
+            xs = np.concatenate([self.data.client_x[i] for i in ids], axis=0)
+            ys = np.concatenate([self.data.client_y[i] for i in ids], axis=0)
+            self._local_eval_dev = {
+                split: tuple(
+                    map(jnp.asarray, pad_to_batches(x, y, 256))
+                )
+                for split, (x, y) in {
+                    "Train": (xs, ys),
+                    "Test": self._client_test_pool(ids),
+                }.items()
+            }
+        from fedml_tpu.train.evaluate import metrics_to_loss_acc
+
+        row = {"round": round_idx}
+        for split, batches in self._local_eval_dev.items():
+            loss, acc = metrics_to_loss_acc(
+                self.eval_fn(self.global_vars, *batches)
+            )
+            row[f"{split}/Loss"], row[f"{split}/Acc"] = loss, acc
+        return row
+
+    def _client_test_pool(self, ids):
+        if self.data.client_test_x is not None:
+            return (
+                np.concatenate([self.data.client_test_x[i] for i in ids], axis=0),
+                np.concatenate([self.data.client_test_y[i] for i in ids], axis=0),
+            )
+        return np.asarray(self.data.test_x), np.asarray(self.data.test_y)
+
     def evaluate_global(self):
         """(loss, acc) of the global model on the central test set, with the
         padded test batches cached on device (the host arrays would
         otherwise be re-shipped every eval)."""
         from fedml_tpu.train.evaluate import pad_to_batches
 
+        from fedml_tpu.train.evaluate import metrics_to_loss_acc
+
         if self._test_dev is None:
             xb, yb, mb = pad_to_batches(
                 np.asarray(self.data.test_x), np.asarray(self.data.test_y), 256
             )
             self._test_dev = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
-        m = self.eval_fn(self.global_vars, *self._test_dev)
-        count = float(m["count"])
-        return (
-            float(m["loss_sum"]) / max(count, 1e-9),
-            float(m["correct"]) / max(count, 1e-9),
-        )
+        return metrics_to_loss_acc(self.eval_fn(self.global_vars, *self._test_dev))
 
     def round_flops(self, round_idx: int = 0):
         """XLA-costed FLOPs of one round call at this round's batch shapes
@@ -399,7 +442,15 @@ class FedAvgAPI:
             round_idx % cfg.fed.frequency_of_the_test == 0
             or round_idx == cfg.fed.comm_round - 1
         ):
-            row["Test/Loss"], row["Test/Acc"] = self.evaluate_global()
+            if cfg.fed.eval_on_clients:
+                local = self.local_test_on_all_clients(round_idx)
+                # local-train metrics describe ALL clients (not just this
+                # round's cohort) — override the cohort sums, ref schema
+                row.update(
+                    {k: v for k, v in local.items() if k != "round"}
+                )
+            else:
+                row["Test/Loss"], row["Test/Acc"] = self.evaluate_global()
         self.history.append(row)
         self.log_fn(row)
         return row

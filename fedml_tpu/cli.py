@@ -72,6 +72,9 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--epochs", type=int, default=1)
 @click.option("--comm_round", type=int, default=10)
 @click.option("--frequency_of_the_test", type=int, default=1)
+@click.option("--eval_on_clients", is_flag=True, default=False,
+              help="Eval on every client's local shards "
+                   "(ref _local_test_on_all_clients) instead of the central test set")
 @click.option("--algorithm", type=click.Choice(ALGORITHMS), default="fedavg")
 @click.option("--runtime", type=click.Choice(RUNTIMES), default="vmap")
 @click.option("--client_shards", type=int, default=None, help="Mesh shards (runtime=mesh); default all devices")
@@ -133,6 +136,7 @@ def build_config(opt) -> RunConfig:
             group_num=opt["group_num"],
             group_comm_round=opt["group_comm_round"],
             fused_rounds=opt.get("fused_rounds", 1),
+            eval_on_clients=opt.get("eval_on_clients", False),
         ),
         train=TrainConfig(
             client_optimizer=opt["client_optimizer"],

@@ -64,6 +64,10 @@ class FedConfig:
     # the chunk. 1 = eager per-round dispatch. Chunks never span an eval
     # round, so observed metrics are identical to the eager loop.
     fused_rounds: int = 1
+    # Eval rounds evaluate on every client's local train/test shards
+    # (ref _local_test_on_all_clients, fedavg_api.py:117-180) instead of the
+    # central test set.
+    eval_on_clients: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -48,10 +48,18 @@ def make_eval_fn(model: ModelDef, task: str = "classification"):
     return eval_fn
 
 
+def metrics_to_loss_acc(m) -> Tuple[float, float]:
+    """{loss_sum, correct, count} sums → (mean loss, accuracy). The one
+    derivation shared by every eval surface."""
+    count = float(m["count"])
+    return (
+        float(m["loss_sum"]) / max(count, 1e-9),
+        float(m["correct"]) / max(count, 1e-9),
+    )
+
+
 def evaluate(model: ModelDef, variables, x, y, batch_size: int = 256, task="classification", eval_fn=None):
     """Convenience host wrapper: returns (loss, accuracy)."""
     xb, yb, mb = pad_to_batches(np.asarray(x), np.asarray(y), batch_size)
     fn = eval_fn or make_eval_fn(model, task)
-    m = fn(variables, xb, yb, mb)
-    count = float(m["count"])
-    return float(m["loss_sum"]) / max(count, 1e-9), float(m["correct"]) / max(count, 1e-9)
+    return metrics_to_loss_acc(fn(variables, xb, yb, mb))

@@ -1,0 +1,121 @@
+"""local_test_on_all_clients (ref fedavg_api.py:117-180): pooled per-client
+evaluation equals the reference's weighted per-client aggregate; ci flag
+short-circuits to client 0; eval_on_clients wires it into the round loop."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import ModelDef
+from fedml_tpu.models.linear import LogisticRegression
+
+NUM_CLASSES = 3
+FEAT = (5,)
+
+
+def _data_with_client_tests():
+    base = synthetic_classification(
+        num_clients=5, num_classes=NUM_CLASSES, feat_shape=FEAT,
+        samples_per_client=20, partition_method="homo", seed=2,
+    )
+    rng = np.random.default_rng(9)
+    ctx = [
+        rng.normal(size=(6 + i, *FEAT)).astype(np.float32) for i in range(5)
+    ]
+    cty = [
+        rng.integers(0, NUM_CLASSES, size=(6 + i,)).astype(np.int32)
+        for i in range(5)
+    ]
+    return FederatedDataset(
+        name=base.name, client_x=base.client_x, client_y=base.client_y,
+        test_x=base.test_x, test_y=base.test_y, num_classes=base.num_classes,
+        client_test_x=ctx, client_test_y=cty,
+    )
+
+
+def _model():
+    return ModelDef(
+        LogisticRegression(num_classes=NUM_CLASSES), FEAT, NUM_CLASSES,
+        name="lr",
+    )
+
+
+def _cfg(ci=False, eval_on_clients=False):
+    return RunConfig(
+        data=DataConfig(batch_size=16),
+        fed=FedConfig(
+            client_num_in_total=5, client_num_per_round=5, comm_round=2,
+            frequency_of_the_test=1, ci=ci, eval_on_clients=eval_on_clients,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+
+
+def _ref_weighted_aggregate(api, xs_list, ys_list):
+    """Reference semantics: per-client sums, sample-weighted aggregate —
+    identical to pooled sums."""
+    from fedml_tpu.train.evaluate import pad_to_batches
+    import jax.numpy as jnp
+
+    tot_correct = tot_loss = tot_n = 0.0
+    for x, y in zip(xs_list, ys_list):
+        m = api.eval_fn(
+            api.global_vars, *map(jnp.asarray, pad_to_batches(x, y, 16))
+        )
+        tot_correct += float(m["correct"])
+        tot_loss += float(m["loss_sum"])
+        tot_n += float(m["count"])
+    return tot_loss / tot_n, tot_correct / tot_n
+
+
+def test_matches_per_client_weighted_aggregate():
+    data = _data_with_client_tests()
+    api = FedAvgAPI(_cfg(), data, _model())
+    row = api.local_test_on_all_clients(round_idx=0)
+    ref_tr_loss, ref_tr_acc = _ref_weighted_aggregate(
+        api, data.client_x, data.client_y
+    )
+    ref_te_loss, ref_te_acc = _ref_weighted_aggregate(
+        api, data.client_test_x, data.client_test_y
+    )
+    assert row["Train/Acc"] == pytest.approx(ref_tr_acc, abs=1e-6)
+    assert row["Train/Loss"] == pytest.approx(ref_tr_loss, rel=1e-5)
+    assert row["Test/Acc"] == pytest.approx(ref_te_acc, abs=1e-6)
+    assert row["Test/Loss"] == pytest.approx(ref_te_loss, rel=1e-5)
+
+
+def test_ci_short_circuits_to_client_zero():
+    data = _data_with_client_tests()
+    api = FedAvgAPI(_cfg(ci=True), data, _model())
+    row = api.local_test_on_all_clients()
+    ref_loss, ref_acc = _ref_weighted_aggregate(
+        api, data.client_x[:1], data.client_y[:1]
+    )
+    assert row["Train/Acc"] == pytest.approx(ref_acc, abs=1e-6)
+    assert row["Train/Loss"] == pytest.approx(ref_loss, rel=1e-5)
+
+
+def test_no_client_test_split_falls_back_to_central():
+    data = synthetic_classification(
+        num_clients=4, num_classes=NUM_CLASSES, feat_shape=FEAT,
+        samples_per_client=12, partition_method="homo", seed=1,
+    )
+    api = FedAvgAPI(_cfg(), data, _model())
+    row = api.local_test_on_all_clients()
+    loss, acc = api.evaluate_global()
+    assert row["Test/Acc"] == pytest.approx(acc, abs=1e-6)
+
+
+def test_eval_on_clients_in_round_loop():
+    data = _data_with_client_tests()
+    api = FedAvgAPI(_cfg(eval_on_clients=True), data, _model())
+    final = api.train()
+    assert "Test/Acc" in final and "Train/Acc" in final
+    # local eval overrode the cohort train metrics with all-client metrics
+    row0 = api.history[0]
+    check = api.local_test_on_all_clients()  # post-training model
+    assert np.isfinite(row0["Train/Loss"]) and np.isfinite(check["Train/Loss"])
