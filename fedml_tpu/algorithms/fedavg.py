@@ -74,6 +74,7 @@ def make_fedavg_round(
     donate: bool = True,
     post_train: Optional[Callable] = None,
     post_aggregate: Optional[Callable] = None,
+    aggregate_fn: Optional[Callable] = None,
 ):
     """Build the jitted FedAvg round function (vmap over clients, one chip).
 
@@ -94,7 +95,12 @@ def make_fedavg_round(
         )(global_vars, x, y, mask, client_rngs)
         if post_train is not None:
             client_vars = post_train(client_vars, global_vars, *extra)
-        new_global = weighted_average(client_vars, num_samples)
+        # aggregate_fn replaces the weighted average outright (Byzantine-
+        # robust aggregators: median/trimmed-mean/Krum)
+        if aggregate_fn is not None:
+            new_global = aggregate_fn(client_vars, num_samples)
+        else:
+            new_global = weighted_average(client_vars, num_samples)
         if post_aggregate is not None:
             new_global = post_aggregate(new_global, *extra)
         agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)

@@ -17,6 +17,7 @@ from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.robustness import (
     RobustConfig,
     add_gaussian_noise,
+    make_byzantine_aggregate,
     norm_diff_clip_tree,
 )
 
@@ -54,6 +55,7 @@ def make_robust_fedavg_round(
         donate=donate,
         post_train=post_train,
         post_aggregate=post_aggregate,
+        aggregate_fn=make_byzantine_aggregate(robust),
     )
 
 

@@ -32,6 +32,7 @@ from fedml_tpu.config import (
     ServerConfig,
     TrainConfig,
 )
+from fedml_tpu.robustness import BYZANTINE_AGGREGATORS, CLIP_DEFENSES
 
 ALGORITHMS = (
     "centralized",
@@ -82,6 +83,13 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--server_lr", type=float, default=1.0)
 @click.option("--server_momentum", type=float, default=0.0)
 @click.option("--prox_mu", type=float, default=0.01, help="FedProx proximal term (algorithm=fedprox)")
+@click.option("--defense", type=click.Choice(CLIP_DEFENSES + BYZANTINE_AGGREGATORS),
+              default="norm_diff_clipping",
+              help="fedavg_robust: clip/noise (ref) or Byzantine aggregator")
+@click.option("--num_byzantine", type=int, default=1,
+              help="assumed Byzantine client count (trimmed_mean trim-k, krum f)")
+@click.option("--multi_krum_m", type=int, default=3,
+              help="multi_krum: average the m best-scored clients")
 @click.option("--group_num", type=int, default=2, help="hierarchical: number of groups")
 @click.option("--group_comm_round", type=int, default=1)
 @click.option("--compute_dtype", type=click.Choice(("float32", "bfloat16")), default="float32",
@@ -227,7 +235,12 @@ def run(**opt):
         click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
         return None
 
-    api = _build_api(opt["algorithm"], opt["runtime"], config, data, model, task, log_fn)
+    api = _build_api(
+        opt["algorithm"], opt["runtime"], config, data, model, task, log_fn,
+        defense=opt.get("defense", "norm_diff_clipping"),
+        num_byzantine=opt.get("num_byzantine", 1),
+        multi_krum_m=opt.get("multi_krum_m", 3),
+    )
     api_cell.append(api)
 
     if opt["resume"]:
@@ -297,7 +310,8 @@ def _restore(api, opt):
         api.server_opt_state = restore_like(api.server_opt_state, opt_state)
 
 
-def _build_api(algorithm, runtime, config, data, model, task, log_fn):
+def _build_api(algorithm, runtime, config, data, model, task, log_fn,
+               defense="norm_diff_clipping", num_byzantine=1, multi_krum_m=3):
     if runtime in ("loopback", "mqtt", "shm"):
         if algorithm != "fedavg":
             raise click.UsageError(
@@ -353,8 +367,14 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn):
         return HierarchicalFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
     if algorithm == "fedavg_robust":
         from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
+        from fedml_tpu.robustness.robust_aggregation import RobustConfig
 
-        return RobustFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
+        return RobustFedAvgAPI(
+            config, data, model, task=task, log_fn=log_fn,
+            robust=RobustConfig(defense_type=defense,
+                                num_byzantine=num_byzantine,
+                                multi_krum_m=multi_krum_m),
+        )
     raise click.UsageError(f"unknown algorithm {algorithm}")
 
 

@@ -1,13 +1,27 @@
 from fedml_tpu.robustness.robust_aggregation import (
+    BYZANTINE_AGGREGATORS,
+    CLIP_DEFENSES,
     RobustConfig,
+    coordinate_median,
+    krum_aggregate,
+    krum_select,
+    make_byzantine_aggregate,
     norm_diff_clip_tree,
     add_gaussian_noise,
     tree_weight_norm,
+    trimmed_mean,
 )
 
 __all__ = [
+    "BYZANTINE_AGGREGATORS",
+    "CLIP_DEFENSES",
     "RobustConfig",
+    "coordinate_median",
+    "krum_aggregate",
+    "krum_select",
+    "make_byzantine_aggregate",
     "norm_diff_clip_tree",
     "add_gaussian_noise",
     "tree_weight_norm",
+    "trimmed_mean",
 ]

@@ -25,7 +25,12 @@ import numpy as np
 
 from fedml_tpu.algorithms.fedavg import make_fedavg_round
 from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
-from fedml_tpu.robustness import RobustConfig, add_gaussian_noise, norm_diff_clip_tree
+from fedml_tpu.robustness import (
+    RobustConfig,
+    add_gaussian_noise,
+    make_byzantine_aggregate,
+    norm_diff_clip_tree,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +66,7 @@ def make_attacked_robust_round(
     return make_fedavg_round(
         model, config, task=task, local_train_fn=local_train_fn,
         donate=donate, post_train=post_train, post_aggregate=post_aggregate,
+        aggregate_fn=make_byzantine_aggregate(robust),
     )
 
 
