@@ -16,6 +16,7 @@ import functools
 from typing import Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from fedml_tpu.parallel.ring_attention import full_attention
@@ -23,10 +24,47 @@ from fedml_tpu.parallel.ring_attention import full_attention
 causal_full_attention = functools.partial(full_attention, causal=True)
 
 
+class MoEMLP(nn.Module):
+    """Top-1 routed Mixture-of-Experts MLP: x [B, T, C] → (y [B, T, C],
+    aux) where aux is the Switch-Transformer load-balancing loss
+    (mean fraction-of-tokens × mean gate prob × E). Dense dispatch — every
+    expert computes every token, the top-1 mask selects — trades FLOPs for
+    static shapes; sharded P("ep", ...) over a mesh (parallel/
+    expert_parallel.py) the sum over experts becomes one all-reduce."""
+
+    num_experts: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        E, F = self.num_experts, self.mlp_ratio * C
+        gate_logits = nn.Dense(E, use_bias=False, name="gate")(x)  # [B,T,E]
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)  # [B,T]
+        mask = jax.nn.one_hot(top1, E, dtype=x.dtype)
+        frac = jnp.mean(mask, axis=(0, 1))
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        aux = E * jnp.sum(frac * mean_prob)
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(), (E, C, F))
+        w2 = self.param("w2", nn.initializers.lecun_normal(), (E, F, C))
+        h = jnp.einsum("btc,ecf->ebtf", x, w1)
+        h = nn.gelu(h)
+        y_e = jnp.einsum("ebtf,efc->ebtc", h, w2)
+        sel = mask * jnp.take_along_axis(probs, top1[..., None], axis=-1)
+        y = jnp.einsum("ebtc,bte->btc", y_e, sel)
+        return y, aux
+
+
 class TransformerBlock(nn.Module):
+    """Pre-LN block. ``moe_experts > 0`` swaps the dense MLP for MoEMLP, in
+    which case __call__ returns (x, aux) instead of x."""
+
     num_heads: int
     mlp_ratio: int = 4
     attn_fn: Callable = causal_full_attention
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -43,18 +81,28 @@ class TransformerBlock(nn.Module):
         attn = attn.reshape(B, T, C)
         x = x + nn.Dense(C, use_bias=False, name="proj")(attn)
         h = nn.LayerNorm(name="ln2")(x)
+        if self.moe_experts:
+            y, aux = MoEMLP(
+                self.moe_experts, self.mlp_ratio, name="moe"
+            )(h)
+            return x + y, aux
         h = nn.Dense(self.mlp_ratio * C, name="mlp_up")(h)
         h = nn.gelu(h)
         return x + nn.Dense(C, name="mlp_down")(h)
 
 
 class TransformerLM(nn.Module):
+    """``moe_experts > 0`` swaps every block's dense MLP for MoEMLP and
+    makes __call__ return (logits, mean aux loss) — MoE composes with any
+    attn_fn, including the sequence-parallel ring/ulysses cores."""
+
     vocab_size: int
     num_layers: int = 2
     num_heads: int = 4
     embed_dim: int = 128
     max_len: int = 4096
     attn_fn: Callable = causal_full_attention
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, tokens, pos_offset: int = 0, train: bool = False):
@@ -68,9 +116,21 @@ class TransformerLM(nn.Module):
         )
         pos = jnp.arange(T) + pos_offset
         x = tok + pos_table[pos]
+        aux_total = 0.0
         for i in range(self.num_layers):
-            x = TransformerBlock(
-                self.num_heads, attn_fn=self.attn_fn, name=f"block{i}"
-            )(x, train=train)
+            block = TransformerBlock(
+                self.num_heads,
+                attn_fn=self.attn_fn,
+                moe_experts=self.moe_experts,
+                name=f"block{i}",
+            )
+            if self.moe_experts:
+                x, aux = block(x, train=train)
+                aux_total = aux_total + aux
+            else:
+                x = block(x, train=train)
         x = nn.LayerNorm(name="ln_f")(x)
-        return nn.Dense(self.vocab_size, use_bias=False, name="head")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, name="head")(x)
+        if self.moe_experts:
+            return logits, aux_total / self.num_layers
+        return logits

@@ -12,10 +12,16 @@ from fedml_tpu.parallel.fedavg_sharded import (
     make_sharded_fedavg_round,
     DistributedFedAvgAPI,
 )
+from fedml_tpu.parallel.tensor_parallel import make_tp_train_step
+from fedml_tpu.parallel.expert_parallel import make_ep_train_step
+from fedml_tpu.parallel.pipeline import make_pp_train_step
 
 __all__ = [
     "make_mesh",
     "pad_client_batch",
     "make_sharded_fedavg_round",
     "DistributedFedAvgAPI",
+    "make_tp_train_step",
+    "make_ep_train_step",
+    "make_pp_train_step",
 ]

@@ -12,9 +12,19 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from fedml_tpu.data.base import ClientBatch
+
+
+def shardings_from_specs(mesh: Mesh, spec_tree):
+    """PartitionSpec tree → NamedSharding tree on ``mesh`` (specs are
+    themselves tuples, hence the explicit is_leaf)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
 
 
 def make_mesh(

@@ -1,0 +1,179 @@
+"""Pipeline parallelism (PP): GPipe-style microbatch pipeline over a ``pp``
+mesh axis, written as one shard_map program.
+
+Layout: S identical stages; the stacked stage parameters [S, ...] are
+sharded P("pp") so each device holds exactly its stage. The schedule is a
+``lax.scan`` over T = M + S − 1 ticks: each tick, stage 0 ingests the next
+microbatch, every stage applies its layer to the activation it holds, and
+activations rotate one step down the ring via ``lax.ppermute``. The last
+stage emits a finished microbatch on ticks t ≥ S−1. No data-dependent
+control flow — the bubble is masked arithmetic, so the whole pipeline jits
+to a single XLA program and differentiates (ppermute's transpose is the
+reverse permute; grads of per-stage params stay per-stage, no collective
+needed).
+
+The reference's closest concept is split learning (split_nn/client.py:24-34,
+server.py:40-60: model cut across processes, activations/grads exchanged
+per batch over MPI with turn-taking, no overlap). This module is its
+TPU-native superset: the same model-cut idea, but S stages, M in-flight
+microbatches, on-device exchange over ICI, and the compiler scheduling the
+overlap.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def mlp_stage_init(rng, width: int, hidden: int):
+    """One residual-MLP stage's params (the default stage used by tests and
+    the dryrun; any (params, x)→x callable works)."""
+    k1, k2 = jax.random.split(rng)
+    s = jax.nn.initializers.lecun_normal()
+    return {"w1": s(k1, (width, hidden)), "w2": s(k2, (hidden, width))}
+
+
+def mlp_stage_apply(params, x):
+    return x + jax.nn.gelu(x @ params["w1"]) @ params["w2"]
+
+
+def stack_stage_params(rng, num_stages: int, width: int, hidden: int):
+    """[S, ...]-stacked stage params — shard over P("pp") on the mesh."""
+    rngs = jax.random.split(rng, num_stages)
+    return jax.vmap(lambda r: mlp_stage_init(r, width, hidden))(rngs)
+
+
+def sequential_apply(stacked_params, x, stage_apply=mlp_stage_apply):
+    """Reference semantics: run the S stages in sequence on one device —
+    the oracle the pipeline must match exactly."""
+
+    def body(h, p):
+        return stage_apply(p, h), None
+
+    out, _ = jax.lax.scan(body, x, stacked_params)
+    return out
+
+
+def make_pipeline_fn(
+    mesh: Mesh,
+    pp_axis: str = "pp",
+    stage_apply: Callable = mlp_stage_apply,
+):
+    """Build ``pipeline(stacked_params, microbatches) -> outputs``.
+
+    ``stacked_params``: [S, ...] tree sharded P(pp_axis).
+    ``microbatches``: [M, mb, width] (replicated; every device sees the
+    stream, only stage 0 consumes it).
+    Returns [M, mb, width] outputs (the last stage's results, psum-broadcast
+    so every shard returns the full tensor).
+    """
+    S = mesh.shape[pp_axis]
+
+    def shard_body(stacked_params, microbatches):
+        # inside shard_map the local params block is [1, ...] — this device's
+        # stage
+        params = jax.tree_util.tree_map(lambda v: v[0], stacked_params)
+        M = microbatches.shape[0]
+        stage = jax.lax.axis_index(pp_axis)
+        T = M + S - 1
+        mb_shape = microbatches.shape[1:]
+
+        def tick(carry, t):
+            act, outs = carry
+            # stage 0 ingests microbatch t (zeros once the stream is done)
+            feed = microbatches[jnp.minimum(t, M - 1)] * (t < M)
+            act = jnp.where(stage == 0, feed, act)
+            act = stage_apply(params, act)
+            # last stage emits microbatch t-(S-1) at tick t
+            emit_idx = t - (S - 1)
+            valid = jnp.logical_and(stage == S - 1, emit_idx >= 0)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(valid, act, outs[jnp.maximum(emit_idx, 0)]),
+                jnp.maximum(emit_idx, 0),
+                axis=0,
+            )
+            # rotate activations one stage down the ring
+            act = jax.lax.ppermute(
+                act, pp_axis, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (act, outs), None
+
+        # the carry is device-varying (each stage holds a different
+        # activation); mark the device-invariant zeros as varying so the
+        # scan carry types line up
+        act0 = jax.lax.pcast(
+            jnp.zeros(mb_shape, microbatches.dtype), (pp_axis,), to="varying"
+        )
+        outs0 = jax.lax.pcast(
+            jnp.zeros((M,) + mb_shape, microbatches.dtype),
+            (pp_axis,),
+            to="varying",
+        )
+        (_, outs), _ = jax.lax.scan(
+            tick, (act0, outs0), jnp.arange(T)
+        )
+        # only the last stage holds real outputs; broadcast to all shards
+        outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, pp_axis)
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(pp_axis), P()),
+        out_specs=P(),
+    )
+
+
+def make_pp_train_step(
+    mesh: Mesh,
+    width: int,
+    hidden: int,
+    lr: float = 1e-3,
+    pp_axis: str = "pp",
+    stage_apply: Callable = mlp_stage_apply,
+    stage_init: Callable = None,
+):
+    """(init_fn, step_fn) for pipeline-parallel regression training.
+
+    step_fn(params, opt_state, microbatches, targets) — microbatches
+    [M, mb, width], targets same; loss = mean squared error over all
+    microbatches, differentiated straight through the scanned ppermute
+    pipeline.
+
+    A custom ``stage_apply`` must come with the matching
+    ``stage_init(rng) -> one stage's params`` (the default pair is the
+    residual MLP stage above)."""
+    if (stage_apply is not mlp_stage_apply) != (stage_init is not None):
+        raise ValueError(
+            "stage_apply and stage_init must be overridden together"
+        )
+    if stage_init is None:
+        stage_init = lambda r: mlp_stage_init(r, width, hidden)  # noqa: E731
+    pipeline = make_pipeline_fn(mesh, pp_axis, stage_apply)
+    opt = optax.adam(lr)
+
+    def step(params, opt_state, microbatches, targets):
+        def loss_fn(p):
+            preds = pipeline(p, microbatches)
+            return jnp.mean(jnp.square(preds - targets))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_fn(rng):
+        from jax.sharding import NamedSharding
+
+        rngs = jax.random.split(rng, mesh.shape[pp_axis])
+        params = jax.vmap(stage_init)(rngs)
+        params = jax.device_put(params, NamedSharding(mesh, P(pp_axis)))
+        return params, opt.init(params)
+
+    return init_fn, jax.jit(step)
