@@ -98,13 +98,22 @@ def _north_star(jax, compute_dtype="float32"):
 
     api = _north_star_api(compute_dtype)
 
-    warmup, timed = 3, 20
+    warmup, timed = 3, 40
     m = None
-    for r in range(warmup):
+    # warm by running through the ENTIRE timed window once: every (steps)
+    # size class the sampler will produce compiles here, so no compile can
+    # land inside the timing
+    for r in range(warmup + timed):
         _, m = api.train_round(r)
     _sync(m)
     sec_per_round = _timed_rounds(api, warmup, timed)
-    flops = api.round_flops(warmup)
+    # mean FLOPs over the SAME rounds the timing averaged (step classes
+    # differ per round; one round's cost would skew MFU) — cheap, since
+    # lowering reuses the jit cache
+    per_round = [api.round_flops(r) for r in range(warmup, warmup + timed)]
+    flops = (
+        sum(per_round) / len(per_round) if all(per_round) else None
+    )
     return {
         "rounds_per_sec": round(1.0 / sec_per_round, 4),
         "flops_per_round": flops,

@@ -45,12 +45,18 @@ def bucket_steps(ns: Sequence[int], batch_size: int, pad_bucket: int):
     sample counts, return (steps, bs, cap). Used by BOTH host stacking
     (:func:`stack_clients`) and the device store
     (data/device_store.py) — one definition, so the two paths can never
-    diverge. ``batch_size == -1`` = full batch (oracle mode)."""
+    diverge. ``batch_size == -1`` = full batch (oracle mode).
+
+    Step counts are size-class bucketed: power-of-two up to 16, multiples
+    of 8 above. Pure pow2 wastes up to ~2× compute in padded (masked
+    no-op) steps at larger counts — e.g. 21 real steps padded to 32; the
+    8-step classes cap that waste at <⅓ while keeping the set of compiled
+    shapes small."""
     max_n = max(ns)
     bs = max_n if batch_size == -1 else batch_size
     steps = _ceil_to(_ceil_to(max_n, bs) // bs, pad_bucket)
     if batch_size != -1:
-        steps = _next_pow2(steps)
+        steps = _next_pow2(steps) if steps <= 16 else _ceil_to(steps, 8)
     return steps, bs, steps * bs
 
 
@@ -126,10 +132,12 @@ def stack_clients(
     the degenerate config the CI oracle uses (ref fedavg full-batch mode,
     CI-script-fedavg.sh:42).
 
-    Steps-per-epoch S is ceil(max_n / B) rounded up to the next power of two
-    (and to ``pad_bucket``) so repeated rounds with ragged client sizes reuse a
-    small set of compiled shapes instead of recompiling per distinct max-size
-    (full-batch mode is exempt: S is 1 there, but the batch dim varies).
+    Steps-per-epoch S is ceil(max_n / B) rounded up to its size class
+    (see :func:`bucket_steps`: pow2 up to 16, multiples of 8 above, and to
+    ``pad_bucket``) so repeated rounds with ragged client sizes reuse a
+    small set of compiled shapes instead of recompiling per distinct
+    max-size (full-batch mode is exempt: S is 1 there, but the batch dim
+    varies).
     """
     ns = [len(data.client_y[i]) for i in client_indices]
     steps, bs, cap = bucket_steps(ns, batch_size, pad_bucket)
