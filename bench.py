@@ -108,11 +108,32 @@ def _north_star(jax, compute_dtype="float32"):
     _sync(m)
     sec_per_round = _timed_rounds(api, warmup, timed)
     # mean FLOPs over the SAME rounds the timing averaged (step classes
-    # differ per round; one round's cost would skew MFU) — cheap, since
-    # lowering reuses the jit cache
-    per_round = [api.round_flops(r) for r in range(warmup, warmup + timed)]
+    # differ per round; one round's cost would skew MFU). FLOPs depend
+    # only on the (steps, bs) class, so cost each distinct class once and
+    # weight by how often the window hits it.
+    from collections import Counter
+
+    from fedml_tpu.algorithms.fedavg import client_sampling
+    from fedml_tpu.data.base import bucket_steps
+
+    classes = Counter()
+    rep_round = {}
+    for r in range(warmup, warmup + timed):
+        sampled = client_sampling(
+            r, api.data.num_clients, api.config.fed.client_num_per_round
+        )
+        key = bucket_steps(
+            [len(api.data.client_y[i]) for i in sampled],
+            api.config.data.batch_size,
+            api.config.data.pad_bucket,
+        )[:2]
+        classes[key] += 1
+        rep_round.setdefault(key, r)
+    class_flops = {k: api.round_flops(rep_round[k]) for k in classes}
     flops = (
-        sum(per_round) / len(per_round) if all(per_round) else None
+        sum(class_flops[k] * n for k, n in classes.items()) / timed
+        if all(class_flops.values())
+        else None
     )
     return {
         "rounds_per_sec": round(1.0 / sec_per_round, 4),

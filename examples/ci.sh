@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# CI gate — the TPU-native analog of the reference's shell-script CI
+# (CI-script-fedavg.sh / CI-script-framework.sh / CI-install.sh pattern,
+# SURVEY §4): lint gate, fast unit tier, end-to-end CLI smoke runs on tiny
+# configs, and the federated==centralized oracle. Unlike the reference's
+# fire-and-forget background runs (CI-script-framework.sh:16-23 — no exit
+# code checked), every step here fails the gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# syntax gate only — pyflakes isn't in this image; the ref's pyflakes gate
+# (CI-script-*.sh:6) additionally catches undefined names/unused imports
+echo "== syntax gate =="
+python -m compileall -q fedml_tpu tests bench.py __graft_entry__.py
+
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export JAX_PLATFORMS=cpu
+
+echo "== fast unit tier =="
+python -m pytest tests/ -q -m 'not slow' -x
+
+echo "== CLI smoke: one round per algorithm family (ref CI-script-fedavg.sh:33-39) =="
+for algo in fedavg fedopt fedprox fednova hierarchical fedavg_robust; do
+  python -m fedml_tpu --algorithm "$algo" --model lr --dataset synthetic \
+    --client_num_in_total 8 --client_num_per_round 4 --comm_round 1 \
+    --epochs 1 --ci > /dev/null
+  echo "  $algo ok"
+done
+
+echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
+python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+echo "CI GREEN"
