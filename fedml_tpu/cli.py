@@ -86,10 +86,26 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--defense", type=click.Choice(CLIP_DEFENSES + BYZANTINE_AGGREGATORS),
               default="norm_diff_clipping",
               help="fedavg_robust: clip/noise (ref) or Byzantine aggregator")
+@click.option("--norm_bound", type=float, default=5.0,
+              help="norm_diff_clipping/weak_dp: clip ||w_i - w_g|| to this")
+@click.option("--noise_stddev", type=float, default=0.025,
+              help="weak_dp: Gaussian noise stddev after averaging")
 @click.option("--num_byzantine", type=int, default=1,
               help="assumed Byzantine client count (trimmed_mean trim-k, krum f)")
 @click.option("--multi_krum_m", type=int, default=3,
               help="multi_krum: average the m best-scored clients")
+@click.option("--attack", type=click.Choice(("none", "backdoor")), default="none",
+              help="fedavg_robust: simulate attackers (poisoned shards + "
+                   "boosted uploads, ref edge_case_examples) and report "
+                   "Backdoor/ASR")
+@click.option("--num_attackers", type=int, default=1,
+              help="attack=backdoor: clients 0..k-1 are attackers")
+@click.option("--attack_boost", type=float, default=10.0,
+              help="model-replacement boost γ on attacker uploads")
+@click.option("--poison_frac", type=float, default=0.5,
+              help="fraction of each attacker shard triggered+relabeled")
+@click.option("--target_label", type=int, default=0,
+              help="backdoor target class")
 @click.option("--group_num", type=int, default=2, help="hierarchical: number of groups")
 @click.option("--group_comm_round", type=int, default=1)
 @click.option("--compute_dtype", type=click.Choice(("float32", "bfloat16")), default="float32",
@@ -178,6 +194,36 @@ def run(**opt):
     sample_shape = tuple(data.client_x[0].shape[1:])
     model = create_model(config.model, config.data.dataset, sample_shape, data.num_classes)
 
+    poison_spec = attack_cfg = None
+    if opt.get("attack", "none") == "backdoor":
+        if opt["algorithm"] != "fedavg_robust" or opt["runtime"] != "vmap":
+            raise click.UsageError(
+                "--attack backdoor requires --algorithm fedavg_robust "
+                "--runtime vmap"
+            )
+        from fedml_tpu.data.edge_cases import PoisonSpec, poison_clients
+        from fedml_tpu.robustness.backdoor import AttackConfig
+
+        k = opt.get("num_attackers", 1)
+        if not 0 < k < data.num_clients:
+            raise click.UsageError(
+                f"--num_attackers must be in [1, {data.num_clients - 1}]"
+            )
+        poison_spec = PoisonSpec(
+            target_label=opt.get("target_label", 0),
+            poison_frac=opt.get("poison_frac", 0.5),
+        )
+        # attacker ids derived ONCE — the poisoned shards and the boosted
+        # uploads must target the same client set
+        attack_cfg = AttackConfig(
+            attacker_ids=tuple(range(k)),
+            boost=opt.get("attack_boost", 10.0),
+        )
+        data = poison_clients(
+            data, attacker_ids=attack_cfg.attacker_ids, spec=poison_spec,
+            seed=config.seed,
+        )
+
     logger = MetricsLogger(str(opt["log_dir"]) if opt["log_dir"] else None)
     api_cell = []
 
@@ -240,6 +286,9 @@ def run(**opt):
         defense=opt.get("defense", "norm_diff_clipping"),
         num_byzantine=opt.get("num_byzantine", 1),
         multi_krum_m=opt.get("multi_krum_m", 3),
+        norm_bound=opt.get("norm_bound", 5.0),
+        noise_stddev=opt.get("noise_stddev", 0.025),
+        attack_cfg=attack_cfg,
     )
     api_cell.append(api)
 
@@ -252,6 +301,18 @@ def run(**opt):
 
     with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
         final = api.train()
+    if poison_spec is not None:
+        from fedml_tpu.data.edge_cases import attack_success_rate
+
+        final = dict(final or {})
+        final["Backdoor/ASR"] = attack_success_rate(
+            model, api.global_vars, data, poison_spec, eval_fn=api.eval_fn
+        )
+        # persist the attack metric alongside the per-round rows
+        log_fn({
+            "round": config.fed.comm_round - 1,
+            "Backdoor/ASR": final["Backdoor/ASR"],
+        })
     if opt["checkpoint_path"]:
         save_checkpoint(
             str(opt["checkpoint_path"]),
@@ -311,7 +372,8 @@ def _restore(api, opt):
 
 
 def _build_api(algorithm, runtime, config, data, model, task, log_fn,
-               defense="norm_diff_clipping", num_byzantine=1, multi_krum_m=3):
+               defense="norm_diff_clipping", num_byzantine=1, multi_krum_m=3,
+               norm_bound=5.0, noise_stddev=0.025, attack_cfg=None):
     if runtime in ("loopback", "mqtt", "shm"):
         if algorithm != "fedavg":
             raise click.UsageError(
@@ -369,11 +431,20 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
         from fedml_tpu.robustness.robust_aggregation import RobustConfig
 
+        robust = RobustConfig(defense_type=defense,
+                              norm_bound=norm_bound,
+                              stddev=noise_stddev,
+                              num_byzantine=num_byzantine,
+                              multi_krum_m=multi_krum_m)
+        if attack_cfg is not None:
+            from fedml_tpu.robustness.backdoor import BackdoorFedAvgAPI
+
+            return BackdoorFedAvgAPI(
+                config, data, model, task=task, log_fn=log_fn, robust=robust,
+                attack=attack_cfg,
+            )
         return RobustFedAvgAPI(
-            config, data, model, task=task, log_fn=log_fn,
-            robust=RobustConfig(defense_type=defense,
-                                num_byzantine=num_byzantine,
-                                multi_krum_m=multi_krum_m),
+            config, data, model, task=task, log_fn=log_fn, robust=robust,
         )
     raise click.UsageError(f"unknown algorithm {algorithm}")
 

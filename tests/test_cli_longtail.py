@@ -176,3 +176,33 @@ def test_cli_profile_dir_writes_trace(tmp_path):
     # the contract
     found = any(os.scandir(prof)) if prof.exists() else False
     assert found
+
+
+def test_cli_backdoor_attack_reports_asr():
+    """--attack backdoor end-to-end: undefended ASR is high, a tight
+    clipping bound collapses it (the ref's poisoned-task eval loop,
+    FedAvgRobustAggregator.py:14-60, as one CLI flag)."""
+    atk = [
+        "--algorithm", "fedavg_robust", "--attack", "backdoor",
+        "--num_attackers", "2", "--attack_boost", "8",
+        "--dataset", "synthetic", "--model", "lr",
+        "--client_num_in_total", "8", "--client_num_per_round", "8",
+        "--comm_round", "4", "--epochs", "1",
+        "--frequency_of_the_test", "100",
+    ]
+    nodef = _invoke(atk + ["--defense", "no_defense"])
+    clipped = _invoke(atk + ["--defense", "norm_diff_clipping",
+                             "--norm_bound", "0.3"])
+    assert nodef["Backdoor/ASR"] > 0.5
+    assert clipped["Backdoor/ASR"] < 0.5 * nodef["Backdoor/ASR"]
+    assert clipped["Test/Acc"] > 0.6
+
+
+def test_cli_attack_requires_robust_vmap():
+    result = CliRunner().invoke(
+        main,
+        ["--algorithm", "fedavg", "--attack", "backdoor"] + BASE
+        + ["--dataset", "synthetic"],
+    )
+    assert result.exit_code != 0
+    assert "fedavg_robust" in result.output
