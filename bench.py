@@ -235,6 +235,28 @@ def _accuracy_runs():
     )
     runs.append(_time_to_accuracy(cfg, data, model, 0.75, 100, 5))
 
+    # Shakespeare-geometry RNN to the ref's 56.9% target
+    # (benchmark/README.md:56: 715 clients/10 per round, >1200 rounds on
+    # real leaf data; here the synthetic Markov stand-in with matched
+    # shapes — vocab 90, 80-char windows, scan-LSTM).
+    from fedml_tpu.data.synthetic import synthetic_shakespeare
+
+    data = synthetic_shakespeare(num_clients=64, seed=0)
+    model = create_model("rnn", "shakespeare", (80,), 90)
+    cfg = RunConfig(
+        data=DataConfig(batch_size=10, pad_bucket=4),
+        fed=FedConfig(
+            client_num_in_total=64,
+            client_num_per_round=10,
+            comm_round=1,
+            epochs=2,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.8),
+        model="rnn",
+    )
+    runs.append(_time_to_accuracy(cfg, data, model, 0.569, 150, 10))
+
     # FEMNIST + CNN to 80% (north star; ref target 84.9 on real data at
     # >1500 rounds, benchmark/README.md:54) — fp32 and bf16 (the bf16 row
     # is the accuracy-parity evidence for the MXU-native throughput row).

@@ -11,6 +11,7 @@ TASKS = {
     "mnist": "classification",
     "femnist": "classification",
     "femnist_synth": "classification",
+    "shakespeare_synth": "classification",  # next-char from 80-char window
     "shakespeare": "classification",  # next-char from 80-char window
     "fed_shakespeare": "nwp",
     "fed_cifar100": "classification",
@@ -66,6 +67,10 @@ def load(config) -> FederatedDataset:
         from fedml_tpu.data.femnist_synth import femnist_synthetic
 
         return femnist_synthetic(num_clients=n_clients, seed=config.seed)
+    if name == "shakespeare_synth":
+        from fedml_tpu.data.synthetic import synthetic_shakespeare
+
+        return synthetic_shakespeare(num_clients=n_clients, seed=config.seed)
     if name in _FILE_LOADERS:
         import importlib
 
@@ -84,7 +89,8 @@ def load(config) -> FederatedDataset:
             seed=config.seed,
         )
     available = ", ".join(
-        ["synthetic", "synthetic_<a>_<b>", "femnist_synth"]
+        ["synthetic", "synthetic_<a>_<b>", "femnist_synth",
+         "shakespeare_synth", "seg_synth"]
         + sorted(_FILE_LOADERS)
         + ["cifar10", "cifar100", "cinic10"]
     )

@@ -293,3 +293,26 @@ def test_lending_club_vfl_runs(tmp_path):
     data = load_lending_club(p)
     _, stats = run_vfl(data, epochs=5, lr=0.05, batch_size=16)
     assert np.isfinite(stats["loss"])
+
+
+def test_synthetic_shakespeare_geometry():
+    """shakespeare_synth: leaf-shakespeare shapes (80-char int windows,
+    vocab 90), ragged shards, deterministic under seed, and the y label is
+    the chain's next char (x windows stride by one)."""
+    from fedml_tpu.data.synthetic import synthetic_shakespeare
+
+    d1 = synthetic_shakespeare(num_clients=6, samples_per_client=20, seed=3)
+    d2 = synthetic_shakespeare(num_clients=6, samples_per_client=20, seed=3)
+    assert d1.num_clients == 6
+    sizes = {len(y) for y in d1.client_y}
+    assert len(sizes) > 1  # ragged
+    for cx, cy in zip(d1.client_x, d1.client_y):
+        assert cx.shape[1:] == (80,) and cx.dtype == np.int32
+        assert cx.min() >= 0 and cx.max() < 90
+        assert cy.min() >= 0 and cy.max() < 90
+        # windows stride one char over one chain: next window starts with
+        # this window shifted left, and y is the char that completes it
+        np.testing.assert_array_equal(cx[1, :-1], cx[0, 1:])
+        assert cy[0] == cx[1, -1]
+    np.testing.assert_array_equal(d1.client_x[0], d2.client_x[0])
+    np.testing.assert_array_equal(d1.test_y, d2.test_y)
