@@ -1,0 +1,59 @@
+"""Measure the REFERENCE's standalone FedAvg rounds/sec on this host (CPU
+torch) at the north-star workload shapes: femnist-geometry CNN, 128
+clients, 10/round, batch 20, E=1, SGD lr .1. Drives the reference code at
+/root/reference unmodified (wandb stubbed; the fork's broken
+`FedML.` absolute import aliased first)."""
+import importlib.util, sys, time, types
+import numpy as np
+import torch
+
+sys.path.insert(0, "/root/reference")
+sys.modules["wandb"] = types.SimpleNamespace(log=lambda *a, **k: None)
+
+# resnet_gn.py:9 does `from FedML.fedml_api...` (broken in the fork, SURVEY
+# notes it). Load group_normalization straight from its file and pre-seed
+# the FedML alias chain BEFORE any fedml_api.model import runs __init__.
+spec = importlib.util.spec_from_file_location(
+    "group_normalization",
+    "/root/reference/fedml_api/model/cv/group_normalization.py",
+)
+gn = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(gn)
+for name in ("FedML", "FedML.fedml_api", "FedML.fedml_api.model",
+             "FedML.fedml_api.model.cv"):
+    sys.modules.setdefault(name, types.ModuleType(name))
+sys.modules["FedML.fedml_api.model.cv.group_normalization"] = gn
+
+from fedml_api.model.cv.cnn import CNNOriginalFedAvg
+from fedml_api.standalone.fedavg.fedavg_api import FedAvgAPI
+from fedml_api.standalone.fedavg.my_model_trainer_classification import MyModelTrainer
+
+sys.path.insert(0, "/root/repo")
+from fedml_tpu.data.femnist_synth import femnist_synthetic
+data = femnist_synthetic(num_clients=128, seed=0)
+
+def loader(x, y, bs=20):
+    x = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2).squeeze(1)
+    y = torch.tensor(np.asarray(y), dtype=torch.long)
+    ds = torch.utils.data.TensorDataset(x, y)
+    return torch.utils.data.DataLoader(ds, batch_size=bs, shuffle=True)
+
+train_local = {i: loader(data.client_x[i], data.client_y[i]) for i in range(128)}
+test_local = {i: loader(data.client_x[i][:4], data.client_y[i][:4]) for i in range(128)}
+nums = {i: len(data.client_y[i]) for i in range(128)}
+dataset = [sum(nums.values()), 256, None, None, nums, train_local, test_local, 62]
+
+class Args:
+    dataset_name = "femnist"; client_num_in_total = 128; client_num_per_round = 10
+    comm_round = 5; epochs = 1; batch_size = 20; lr = 0.1; wd = 0.0
+    client_optimizer = "sgd"; frequency_of_the_test = 10_000; ci = False
+
+model = CNNOriginalFedAvg(only_digits=False)
+trainer = MyModelTrainer(model=model, dataset_name="femnist",
+                         client_optimizer="sgd", lr=0.1, wd=0.0, epochs=1)
+api = FedAvgAPI(dataset, torch.device("cpu"), Args(), trainer)
+api._local_test_on_all_clients = lambda r: None
+t0 = time.perf_counter()
+api.train()
+dt = time.perf_counter() - t0
+print(f"ref_standalone_fedavg sec/round={dt/Args.comm_round:.3f} rounds/sec={Args.comm_round/dt:.4f}")

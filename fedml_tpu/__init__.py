@@ -23,4 +23,4 @@ round programs), ``core`` (Message/Observer transport for cross-silo
 federation), ``utils`` (metrics, checkpointing, logging).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
