@@ -17,10 +17,13 @@ Subpackages
 - ``models``      flax model zoo (ref: fedml_api/model/)
 - ``train``       jit-compiled local training / evaluation operators
 - ``algorithms``  FL algorithms (ref: fedml_api/{distributed,standalone}/)
-
-Planned (in build order, SURVEY §7): ``parallel`` (mesh utilities + sharded
-round programs), ``core`` (Message/Observer transport for cross-silo
-federation), ``utils`` (metrics, checkpointing, logging).
+- ``parallel``    mesh runtime: sharded FedAvg, ring/Ulysses SP, TP, EP, PP
+- ``core``        Message/Observer transport (gRPC/MQTT/shm/loopback)
+- ``ops``         Pallas TPU kernels (flash attention)
+- ``robustness``  defenses (clip/DP, Byzantine aggregators) + backdoor harness
+- ``secagg``      field MPC + pairwise-mask secure aggregation
+- ``utils``       metrics, checkpoint/resume, profiling
+- ``native``      C++ fastpack host ops (ctypes)
 """
 
 __version__ = "0.2.0"
