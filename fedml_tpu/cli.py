@@ -404,10 +404,16 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         return _Runner()
 
     if runtime == "mesh":
-        from fedml_tpu.parallel import DistributedFedAvgAPI
+        from fedml_tpu.parallel import DistributedFedAvgAPI, DistributedFedOptAPI
 
+        if algorithm == "fedopt":
+            return DistributedFedOptAPI(
+                config, data, model, task=task, log_fn=log_fn
+            )
         if algorithm not in ("fedavg", "fedprox"):
-            raise click.UsageError("runtime=mesh currently supports fedavg/fedprox")
+            raise click.UsageError(
+                "runtime=mesh currently supports fedavg/fedprox/fedopt"
+            )
         return DistributedFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
 
     # vmap simulator runtimes (ref standalone/*)

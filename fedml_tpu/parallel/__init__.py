@@ -11,6 +11,7 @@ from fedml_tpu.parallel.mesh import make_mesh, pad_client_batch
 from fedml_tpu.parallel.fedavg_sharded import (
     make_sharded_fedavg_round,
     DistributedFedAvgAPI,
+    DistributedFedOptAPI,
 )
 from fedml_tpu.parallel.tensor_parallel import make_tp_train_step
 from fedml_tpu.parallel.expert_parallel import make_ep_train_step
@@ -21,6 +22,7 @@ __all__ = [
     "pad_client_batch",
     "make_sharded_fedavg_round",
     "DistributedFedAvgAPI",
+    "DistributedFedOptAPI",
     "make_tp_train_step",
     "make_ep_train_step",
     "make_pp_train_step",

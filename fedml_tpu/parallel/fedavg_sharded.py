@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, round_client_rngs
+from fedml_tpu.algorithms.fedopt import FedOptAPI
 from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import ClientBatch, FederatedDataset
 from fedml_tpu.models import ModelDef
@@ -39,6 +40,7 @@ def make_sharded_fedavg_round(
     mesh: Mesh,
     task: str = "classification",
     local_train_fn: Optional[Callable] = None,
+    donate: bool = True,
 ):
     """Build the jitted sharded round function.
 
@@ -85,7 +87,7 @@ def make_sharded_fedavg_round(
         in_specs=(P(), data_spec, data_spec, data_spec, data_spec, data_spec),
         out_specs=(P(), P()),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 class DistributedFedAvgAPI(FedAvgAPI):
@@ -123,6 +125,7 @@ class DistributedFedAvgAPI(FedAvgAPI):
             self.mesh,
             task=self.task,
             local_train_fn=local_train_fn,
+            donate=self._donate,
         )
 
     def _place_batch(self, batch: ClientBatch, round_rng):
@@ -144,3 +147,14 @@ class DistributedFedAvgAPI(FedAvgAPI):
             put(batch.num_samples),
             put(client_rngs),
         )
+
+
+class DistributedFedOptAPI(FedOptAPI, DistributedFedAvgAPI):
+    """FedOpt (server optimizer on the pseudo-gradient, ref
+    FedOptAggregator.py:95-117) over the multi-chip mesh runtime.
+
+    Cooperative MRO does all the work: FedOptAPI.train_round wraps the
+    round with the jitted server step, DistributedFedAvgAPI supplies the
+    shard_map round function and sharded batch placement. Donation is off
+    (FedOptAPI._donate) because the server step reads the pre-round params
+    after the round call."""
