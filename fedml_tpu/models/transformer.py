@@ -34,6 +34,12 @@ class MoEMLP(nn.Module):
 
     num_experts: int
     mlp_ratio: int = 4
+    # When tokens are sharded over a mesh axis (sequence parallelism), the
+    # Switch aux is a product of token-means — averaging per-shard finished
+    # products is biased by the cross-shard covariance. Setting stats_axis
+    # pmeans frac/mean_prob BEFORE the product, so aux is the exact global
+    # load-balance loss (identical on every shard).
+    stats_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
@@ -45,6 +51,10 @@ class MoEMLP(nn.Module):
         mask = jax.nn.one_hot(top1, E, dtype=x.dtype)
         frac = jnp.mean(mask, axis=(0, 1))
         mean_prob = jnp.mean(probs, axis=(0, 1))
+        if self.stats_axis is not None:
+            frac, mean_prob = jax.lax.pmean(
+                (frac, mean_prob), self.stats_axis
+            )
         aux = E * jnp.sum(frac * mean_prob)
 
         w1 = self.param("w1", nn.initializers.lecun_normal(), (E, C, F))
@@ -65,6 +75,7 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     attn_fn: Callable = causal_full_attention
     moe_experts: int = 0
+    moe_stats_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -83,7 +94,8 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(name="ln2")(x)
         if self.moe_experts:
             y, aux = MoEMLP(
-                self.moe_experts, self.mlp_ratio, name="moe"
+                self.moe_experts, self.mlp_ratio,
+                stats_axis=self.moe_stats_axis, name="moe",
             )(h)
             return x + y, aux
         h = nn.Dense(self.mlp_ratio * C, name="mlp_up")(h)
@@ -103,6 +115,7 @@ class TransformerLM(nn.Module):
     max_len: int = 4096
     attn_fn: Callable = causal_full_attention
     moe_experts: int = 0
+    moe_stats_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens, pos_offset: int = 0, train: bool = False):
@@ -122,6 +135,7 @@ class TransformerLM(nn.Module):
                 self.num_heads,
                 attn_fn=self.attn_fn,
                 moe_experts=self.moe_experts,
+                moe_stats_axis=self.moe_stats_axis,
                 name=f"block{i}",
             )
             if self.moe_experts:

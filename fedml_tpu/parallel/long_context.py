@@ -51,6 +51,10 @@ def make_sp_lm(
         )
     else:
         raise ValueError(f"unknown sp_impl {sp_impl!r} (ring|ulysses)")
+    if model_kw.get("moe_experts"):
+        # exact global Switch aux under the seq sharding (MoEMLP pmeans the
+        # routing stats over this axis before forming the product)
+        model_kw.setdefault("moe_stats_axis", axis_name)
     return TransformerLM(vocab_size=vocab_size, attn_fn=attn, **model_kw)
 
 
@@ -61,6 +65,7 @@ def make_sp_train_step(
     axis_name: str = "seq",
     sp_impl: str = "ring",
     local_attn_fn=None,
+    aux_coef: float = 0.01,
     **model_kw,
 ):
     """Build (init_fn, step_fn) for sequence-parallel LM training.
@@ -68,6 +73,10 @@ def make_sp_train_step(
     step_fn(params, opt_state, tokens, targets) with tokens/targets
     [B, T] sharded on T over the mesh; params replicated. The loss mean and
     grads are psum'd over the ring — one SPMD program, no host round-trips.
+    Pass ``moe_experts=E`` to run MoE blocks under SP (expert weights
+    replicated here; shard them over a second mesh axis for true EP×SP).
+    ``aux_coef`` weighs the Switch load-balance loss, same knob as
+    expert_parallel.make_ep_train_step.
     """
     if sp_impl == "ulysses":
         heads = model_kw.get("num_heads", TransformerLM.num_heads)
@@ -88,14 +97,21 @@ def make_sp_train_step(
         offset = jax.lax.axis_index(axis_name) * T_local
 
         def loss_fn(p):
-            logits = model.apply({"params": p}, tokens, pos_offset=offset)
+            out = model.apply({"params": p}, tokens, pos_offset=offset)
+            if model.moe_experts:
+                # (logits, aux): aux is already the exact GLOBAL Switch
+                # load-balance loss (MoEMLP pmeans the routing stats over
+                # the seq axis), identical on every shard — no reduction
+                logits, aux = out
+            else:
+                logits, aux = out, 0.0
             per_tok = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
             )
             # global mean over the full sequence
             s = jax.lax.psum(jnp.sum(per_tok), axis_name)
             n = jax.lax.psum(per_tok.size, axis_name)
-            return s / n
+            return s / n + aux_coef * aux
 
         # shard_map's transpose inserts the cross-shard psum for replicated
         # (P()) params itself — an explicit psum here would double-count.
@@ -113,7 +129,11 @@ def make_sp_train_step(
     )
 
     def init_fn(rng, example_tokens):
-        model_full = TransformerLM(vocab_size=vocab_size, **model_kw)
+        # init runs OUTSIDE shard_map — stats_axis (a pmean axis) must be
+        # unset here; param structure doesn't depend on it
+        model_full = TransformerLM(
+            vocab_size=vocab_size, **{**model_kw, "moe_stats_axis": None}
+        )
         variables = model_full.init({"params": rng}, example_tokens[:, :8])
         params = variables["params"]
         return params, opt.init(params)

@@ -137,3 +137,36 @@ def test_pp_train_step_learns():
     for _ in range(30):
         params, opt_state, loss = step(params, opt_state, x, tgt)
     assert float(loss) < 0.7 * float(first)
+
+
+def test_moe_composes_with_sequence_parallel():
+    """TransformerLM(moe_experts=E) under the ring-attention SP trainer:
+    the sharded loss must equal the single-device MoE LM loss EXACTLY
+    (ring attention is exact; MoEMLP pmeans the routing stats over the
+    seq axis before forming the Switch aux product, so the aux is the
+    global load-balance loss, not a biased mean of per-shard products)."""
+    import optax
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.parallel.long_context import make_sp_train_step
+
+    toks, tgts = _tokens(2)
+    mesh = _mesh([("seq", 4)])
+    init, step = make_sp_train_step(
+        mesh, V, lr=1e-2, num_layers=1, num_heads=2, embed_dim=16,
+        max_len=T, moe_experts=2, aux_coef=0.01,
+    )
+    params, opt_state = init(jax.random.PRNGKey(5), toks)
+
+    model = TransformerLM(
+        vocab_size=V, num_layers=1, num_heads=2, embed_dim=16, max_len=T,
+        moe_experts=2,
+    )
+    logits, aux = model.apply({"params": jax.device_get(params)}, toks)
+    ref = float(
+        jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, tgts)
+        )
+        + 0.01 * aux
+    )
+    params, opt_state, loss = step(params, opt_state, toks, tgts)
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
