@@ -125,6 +125,7 @@ def stack_clients(
     seed: int = 0,
     pad_bucket: int = 1,
     shuffle: bool = True,
+    force_steps: Optional[int] = None,
 ) -> ClientBatch:
     """Build a dense ClientBatch for the sampled clients.
 
@@ -141,6 +142,16 @@ def stack_clients(
     """
     ns = [len(data.client_y[i]) for i in client_indices]
     steps, bs, cap = bucket_steps(ns, batch_size, pad_bucket)
+    if force_steps is not None:
+        # Callers that co-batch several stacks into one program (the
+        # hierarchical mesh runtime pads every group to the global step
+        # count) force a uniform S. Extra steps are all-padding no-ops and
+        # the mask-aware shuffle keeps minibatch composition independent of
+        # capacity (train/client.py epoch_body), so the math is unchanged.
+        if force_steps < steps:
+            raise ValueError(f"force_steps={force_steps} < required {steps}")
+        steps = force_steps
+        cap = steps * bs
 
     rng = np.random.default_rng(seed)
     feat_shape = data.client_x[client_indices[0]].shape[1:]

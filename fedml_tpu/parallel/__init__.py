@@ -16,6 +16,15 @@ from fedml_tpu.parallel.fedavg_sharded import (
 from fedml_tpu.parallel.tensor_parallel import make_tp_train_step
 from fedml_tpu.parallel.expert_parallel import make_ep_train_step
 from fedml_tpu.parallel.pipeline import make_pp_train_step
+from fedml_tpu.parallel.hierarchical_sharded import (
+    HierarchicalShardedAPI,
+    make_hierarchical_sharded_round,
+)
+from fedml_tpu.parallel.multihost import (
+    hybrid_mesh,
+    initialize_multihost,
+    mesh_traffic_summary,
+)
 
 __all__ = [
     "make_mesh",
@@ -26,4 +35,9 @@ __all__ = [
     "make_tp_train_step",
     "make_ep_train_step",
     "make_pp_train_step",
+    "HierarchicalShardedAPI",
+    "make_hierarchical_sharded_round",
+    "hybrid_mesh",
+    "initialize_multihost",
+    "mesh_traffic_summary",
 ]

@@ -63,9 +63,16 @@ def lda_partition(
     classes = np.unique(labels)
     rng = np.random.default_rng(seed)
 
-    current_min = 0
+    # The per-client minimum can never exceed the mean shard size, so the
+    # reference's fixed ≥10 requirement (noniid_partition.py:44) is
+    # unsatisfiable on small datasets and its retry loop would spin forever
+    # — cap at the achievable value. A retry bound guards the remaining
+    # (probabilistic) loop; at any feasible min_size it trips only if the
+    # draw distribution makes the target astronomically unlikely.
+    min_size = min(min_size, n_total // num_clients)
+    current_min = -1
     batches: List[List[int]] = [[] for _ in range(num_clients)]
-    while current_min < min_size:
+    for _ in range(10_000):
         batches = [[] for _ in range(num_clients)]
         for c in classes:
             class_idxs = np.where(labels == c)[0]
@@ -73,6 +80,13 @@ def lda_partition(
                 rng, alpha, batches, class_idxs, n_total, num_clients
             )
         current_min = min(len(b) for b in batches)
+        if current_min >= min_size:
+            break
+    else:
+        raise RuntimeError(
+            f"LDA partition: could not reach min {min_size} samples/client "
+            f"(n={n_total}, clients={num_clients}, alpha={alpha}) in 10k draws"
+        )
 
     out: Dict[int, np.ndarray] = {}
     for i, batch in enumerate(batches):

@@ -1,0 +1,137 @@
+"""Two-level mesh hierarchical FL == host-loop hierarchical FL, and the
+hybrid DCN×ICI mesh helpers (parallel/multihost.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI, assign_groups
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel.hierarchical_sharded import HierarchicalShardedAPI
+from fedml_tpu.parallel.multihost import (
+    devices_by_host,
+    hybrid_mesh,
+    initialize_multihost,
+    mesh_traffic_summary,
+)
+
+
+def _cfg(group_num, group_comm_round, rounds=2, batch_size=4):
+    return RunConfig(
+        data=DataConfig(batch_size=batch_size, pad_bucket=1),
+        fed=FedConfig(
+            client_num_in_total=12,
+            client_num_per_round=8,
+            comm_round=rounds,
+            epochs=1,
+            group_num=group_num,
+            group_comm_round=group_comm_round,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        model="lr",
+        seed=3,
+    )
+
+
+def _data():
+    return synthetic_classification(
+        num_clients=12,
+        num_classes=5,
+        feat_shape=(6,),
+        samples_per_client=40,
+        partition_method="hetero",
+        seed=1,
+    )
+
+
+@pytest.mark.parametrize("group_num,group_comm_round", [(2, 2), (4, 1)])
+def test_mesh_hierarchical_equals_host_loop(group_num, group_comm_round):
+    """The one-program two-level round reproduces the host loop exactly
+    (same sampling, stacking seeds, PRNG streams — only the execution
+    strategy differs). With 4 groups and 8 sampled of 12 clients, some
+    groups can be empty — exercising the zero-weight gating."""
+    data = _data()
+    cfg = _cfg(group_num, group_comm_round)
+    groups = assign_groups(data.num_clients, group_num, seed=cfg.seed)
+    model = create_model("lr", "synthetic", (6,), 5)
+
+    host = HierarchicalFedAvgAPI(cfg, data, model, groups=groups)
+    mesh = hybrid_mesh("groups", "clients", dcn_size=group_num)
+    sharded = HierarchicalShardedAPI(cfg, data, model, mesh=mesh, groups=groups)
+
+    for r in range(cfg.fed.comm_round):
+        _, m_host = host.train_round(r)
+        _, m_mesh = sharded.train_round(r)
+        for k in ("loss_sum", "correct", "count"):
+            np.testing.assert_allclose(
+                float(m_host[k]), float(m_mesh[k]), rtol=1e-5, atol=1e-5
+            )
+
+    flat_host = np.concatenate(
+        [np.ravel(l) for l in jax.tree_util.tree_leaves(host.global_vars)]
+    )
+    flat_mesh = np.concatenate(
+        [np.ravel(l) for l in jax.tree_util.tree_leaves(sharded.global_vars)]
+    )
+    np.testing.assert_allclose(flat_host, flat_mesh, rtol=2e-5, atol=2e-5)
+
+
+def test_mesh_hierarchical_full_batch():
+    """batch_size=-1 (the oracle's degenerate config) resolves to one
+    uniform shape across groups and still matches the host loop."""
+    data = _data()
+    cfg = _cfg(2, 1, batch_size=-1)
+    groups = assign_groups(data.num_clients, 2, seed=cfg.seed)
+    model = create_model("lr", "synthetic", (6,), 5)
+    host = HierarchicalFedAvgAPI(cfg, data, model, groups=groups)
+    mesh = hybrid_mesh("groups", "clients", dcn_size=2)
+    sharded = HierarchicalShardedAPI(cfg, data, model, mesh=mesh, groups=groups)
+    host.train_round(0)
+    sharded.train_round(0)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(host.global_vars),
+        jax.tree_util.tree_leaves(sharded.global_vars),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5)
+
+
+def test_hybrid_mesh_layout():
+    """Single-process: 8 CPU devices fold into the requested DCN×ICI grid;
+    all axes are intra-process, so traffic summary reports ici."""
+    mesh = hybrid_mesh("groups", "clients", dcn_size=2)
+    assert mesh.shape == {"groups": 2, "clients": 4}
+    assert mesh_traffic_summary(mesh) == {"groups": "ici", "clients": "ici"}
+    grid = devices_by_host()
+    assert grid.shape[0] == 1  # one process in tests
+    with pytest.raises(ValueError):
+        hybrid_mesh(dcn_size=3)  # 8 % 3 != 0
+
+
+def test_hybrid_mesh_multi_process_layout():
+    """Fabricated two-host device set: rows follow process_index, so the
+    outer axis crosses DCN and the inner axis stays on ICI."""
+
+    class FakeDev:
+        def __init__(self, pid, did):
+            self.process_index, self.id = pid, did
+
+    devs = [FakeDev(p, d) for p in (1, 0) for d in (3, 1, 0, 2)]
+    grid = devices_by_host(devs)
+    assert [[d.process_index for d in row] for row in grid.tolist()] == [
+        [0, 0, 0, 0],
+        [1, 1, 1, 1],
+    ]
+    assert [d.id for d in grid[0]] == [0, 1, 2, 3]
+    # uneven hosts are rejected
+    with pytest.raises(ValueError):
+        devices_by_host(devs + [FakeDev(2, 0)])
+
+
+def test_initialize_multihost_noop(monkeypatch):
+    """Unconfigured single-process call is a safe no-op."""
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert initialize_multihost() is False
+    assert initialize_multihost(num_processes=1) is False
