@@ -32,6 +32,16 @@ def assign_groups(num_clients: int, group_num: int, seed: int = 0) -> List[np.nd
     return [np.sort(g) for g in np.array_split(perm, group_num)]
 
 
+def resolve_groups(groups, num_clients: int, group_num: int, seed: int) -> List[np.ndarray]:
+    """Normalize an explicit group list or fall back to :func:`assign_groups`
+    — the ONE definition both the host-loop and mesh hierarchical APIs use,
+    so their group semantics can never diverge (their exact equality is a
+    test contract, tests/test_hierarchical_sharded.py)."""
+    if groups is not None:
+        return [np.asarray(g) for g in groups]
+    return assign_groups(num_clients, group_num, seed=seed)
+
+
 class HierarchicalFedAvgAPI(FedAvgAPI):
     _supports_fused = False  # per-round host-side work forbids chunk fusion
     """Two-level FedAvg simulator. Reuses the inherited jitted round function
@@ -43,12 +53,8 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
 
     def __init__(self, config, data, model, groups: Sequence[np.ndarray] = None, **kw):
         super().__init__(config, data, model, **kw)
-        self.groups = (
-            [np.asarray(g) for g in groups]
-            if groups is not None
-            else assign_groups(
-                data.num_clients, config.fed.group_num, seed=config.seed
-            )
+        self.groups = resolve_groups(
+            groups, data.num_clients, config.fed.group_num, config.seed
         )
         self._avg = jax.jit(weighted_average)
 

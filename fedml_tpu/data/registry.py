@@ -12,6 +12,7 @@ TASKS = {
     "femnist": "classification",
     "femnist_synth": "classification",
     "shakespeare_synth": "classification",  # next-char from 80-char window
+    "shakespeare_synth_lm": "nwp",  # per-position next-char (transformer LM)
     "shakespeare": "classification",  # next-char from 80-char window
     "fed_shakespeare": "nwp",
     "fed_cifar100": "classification",
@@ -71,6 +72,12 @@ def load(config) -> FederatedDataset:
         from fedml_tpu.data.synthetic import synthetic_shakespeare
 
         return synthetic_shakespeare(num_clients=n_clients, seed=config.seed)
+    if name == "shakespeare_synth_lm":
+        from fedml_tpu.data.synthetic import synthetic_shakespeare
+
+        return synthetic_shakespeare(
+            num_clients=n_clients, seed=config.seed, seq_targets=True
+        )
     if name in _FILE_LOADERS:
         import importlib
 
@@ -90,7 +97,7 @@ def load(config) -> FederatedDataset:
         )
     available = ", ".join(
         ["synthetic", "synthetic_<a>_<b>", "femnist_synth",
-         "shakespeare_synth", "seg_synth"]
+         "shakespeare_synth", "shakespeare_synth_lm", "seg_synth"]
         + sorted(_FILE_LOADERS)
         + ["cifar10", "cifar100", "cinic10"]
     )

@@ -112,6 +112,7 @@ def synthetic_shakespeare(
     seq_len: int = 80,
     vocab_size: int = 90,
     seed: int = 0,
+    seq_targets: bool = False,
 ) -> FederatedDataset:
     """Shakespeare-GEOMETRY next-char data (ref shakespeare: 80-char
     windows over a 90-char vocab, leaf JSON user shards) from a synthetic
@@ -138,7 +139,14 @@ def synthetic_shakespeare(
     def windows(n: int, state: int):
         text = chain(n + seq_len, state)
         x = np.stack([text[i : i + seq_len] for i in range(n)]).astype(np.int32)
-        y = text[seq_len : seq_len + n].astype(np.int32)
+        if seq_targets:
+            # causal-LM labels: every position's next char (task "nwp",
+            # transformer path) instead of the window's single next char
+            y = np.stack(
+                [text[i + 1 : i + 1 + seq_len] for i in range(n)]
+            ).astype(np.int32)
+        else:
+            y = text[seq_len : seq_len + n].astype(np.int32)
         return x, y
 
     client_x, client_y = [], []
@@ -149,7 +157,7 @@ def synthetic_shakespeare(
         client_y.append(y)
     xt, yt = windows(256, 1)
     return FederatedDataset(
-        name="shakespeare_synth",
+        name="shakespeare_synth_lm" if seq_targets else "shakespeare_synth",
         client_x=client_x,
         client_y=client_y,
         test_x=xt,

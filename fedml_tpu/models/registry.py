@@ -72,6 +72,26 @@ def create(
             m, input_shape, m.vocab_size, input_dtype=jnp.int32, name="rnn",
         )
 
+    if name == "transformer":
+        # Federated causal-LM fine-tuning — the FedNLP leg (the reference
+        # only carries a pointer README, applications/FedNLP/README.md; its
+        # in-repo NLP ceiling is the 2-layer LSTM). num_classes = vocab
+        # size; trains under task="nwp" like the RNNs, so every federated
+        # algorithm (FedAvg/FedOpt/FedProx/...) runs it unchanged.
+        from fedml_tpu.models.transformer import TransformerLM
+
+        if kw.get("moe_experts"):
+            raise ValueError(
+                "MoE transformers return (logits, aux) and train through "
+                "parallel/expert_parallel.py, not the federated ModelDef path"
+            )
+        kw.setdefault("max_len", int(input_shape[0]))
+        m = TransformerLM(vocab_size=num_classes, **kw)
+        return ModelDef(
+            m, input_shape, num_classes, input_dtype=jnp.int32,
+            name="transformer",
+        )
+
     if name in ("resnet56", "resnet110"):
         from fedml_tpu.models import resnet
 
@@ -151,9 +171,9 @@ def create(
 
     raise KeyError(
         f"unknown model {model_name!r}; available: lr, cnn, cnn_dropout, rnn, "
-        "resnet56, resnet110, resnet18_gn..resnet152_gn, mobilenet, "
-        "mobilenet_v3, vgg11..vgg19(_bn), efficientnet, segnet, darts, "
-        "mnistgan"
+        "transformer, resnet56, resnet110, resnet18_gn..resnet152_gn, "
+        "mobilenet, mobilenet_v3, vgg11..vgg19(_bn), efficientnet, segnet, "
+        "darts, mnistgan"
     )
 
 

@@ -31,15 +31,23 @@ def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    auto: bool = False,
 ) -> bool:
     """Bring this process into the global device set.
 
     Thin guard around ``jax.distributed.initialize``: no-op (returns False)
-    when the run is single-process — either nothing is configured (no args,
-    no JAX_COORDINATOR_ADDRESS / auto-detectable cluster env) or
-    num_processes == 1 — so drivers can call it unconditionally. Replaces
+    when the run is single-process — nothing is explicitly configured (no
+    args, no JAX_COORDINATOR_ADDRESS) and ``auto`` is off — or when
+    num_processes == 1, so drivers can call it unconditionally. Replaces
     the reference's ``MPI.COMM_WORLD`` rank/size bootstrap
     (FedAvgAPI.py:14-18) and ``init_process_group("nccl")``.
+
+    ``auto=True`` additionally hands control to jax's cluster auto-detection
+    (Cloud TPU pod metadata, SLURM, …) with no explicit arguments, treating
+    a detection failure as "single process". It is opt-in rather than the
+    default because auto-detection probes environment services — in an
+    air-gapped or test environment that probe is wasted work (and this
+    container has no egress at all).
 
     CRITICAL ORDERING: nothing here may touch the XLA backend before
     ``initialize`` — ``jax.devices()`` / ``jax.process_count()`` would
@@ -50,8 +58,17 @@ def initialize_multihost(
     if jax.distributed.is_initialized():
         return True
     env_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coordinator_address is None and env_addr is None and num_processes is None:
-        return False
+    explicit = not (
+        coordinator_address is None and env_addr is None and num_processes is None
+    )
+    if not explicit:
+        if not auto:
+            return False
+        try:
+            jax.distributed.initialize()  # cluster auto-detection
+        except (RuntimeError, ValueError):
+            return False  # no detectable cluster → single process
+        return True
     if num_processes == 1:
         return False
     jax.distributed.initialize(

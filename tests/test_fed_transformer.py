@@ -1,0 +1,80 @@
+"""Federated transformer-LM fine-tuning (the FedNLP leg — the reference
+ships only a pointer README, applications/FedNLP/README.md, and its in-repo
+NLP ceiling is the 2-layer LSTM of model/nlp/rnn.py)."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.config import (
+    DataConfig,
+    FedConfig,
+    RunConfig,
+    ServerConfig,
+    TrainConfig,
+)
+from fedml_tpu.data.synthetic import synthetic_shakespeare
+from fedml_tpu.models import create_model
+
+
+def _setup(num_clients=8):
+    data = synthetic_shakespeare(num_clients=num_clients, seed=0, seq_targets=True)
+    model = create_model(
+        "transformer", "shakespeare_synth", (80,), 90,
+        num_layers=1, num_heads=2, embed_dim=32,
+    )
+    return data, model
+
+
+def test_fedavg_transformer_nwp_learns():
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    data, model = _setup()
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8, pad_bucket=1),
+        fed=FedConfig(
+            client_num_in_total=data.num_clients,
+            client_num_per_round=4,
+            comm_round=4,
+            epochs=1,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.5),
+        model="transformer",
+        seed=0,
+    )
+    api = FedAvgAPI(cfg, data, model, task="nwp")
+    losses = []
+    for r in range(cfg.fed.comm_round):
+        _, m = api.train_round(r)
+        losses.append(float(m["loss_sum"]) / max(float(m["count"]), 1))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # the LM is learning the Markov stream
+
+
+def test_transformer_registry_rejects_moe():
+    with pytest.raises(ValueError):
+        create_model("transformer", "shakespeare", (80,), 90, moe_experts=4)
+
+
+def test_fedopt_transformer_runs():
+    """Server-optimizer family composes with the transformer unchanged."""
+    from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+    data, model = _setup()
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8, pad_bucket=1),
+        fed=FedConfig(
+            client_num_in_total=data.num_clients,
+            client_num_per_round=4,
+            comm_round=1,
+            epochs=1,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.5),
+        server=ServerConfig(server_optimizer="adam", server_lr=0.01),
+        model="transformer",
+        seed=0,
+    )
+    api = FedOptAPI(cfg, data, model, task="nwp")
+    _, m = api.train_round(0)
+    assert np.isfinite(float(m["loss_sum"]))
