@@ -234,8 +234,27 @@ class FedAvgServerManager(ServerManager):
                 self.dropped_uploads += 1
                 return
             worker = msg.get_sender_id() - 1
+            params = msg.get(MT.ARG_MODEL_PARAMS)
+            if params is None:
+                # compressed uplink: reconstruct against this round's
+                # broadcast model (the round tag above guarantees the
+                # upload belongs to the currently open round). The codec
+                # comes from the MESSAGE's protocol tag, so a client whose
+                # --compression differs from the server's still decodes
+                # correctly instead of wedging the FSM.
+                payload = msg.get(MT.ARG_MODEL_DELTA)
+                method = msg.get(MT.ARG_COMPRESSION)
+                if payload is None or method is None:
+                    raise ValueError(
+                        f"model upload from sender {msg.get_sender_id()} "
+                        "carries neither model_params nor a tagged "
+                        "compressed delta"
+                    )
+                from fedml_tpu.core import compression as CZ
+
+                params = CZ.decode_update(payload, self.global_vars, method)
             self.aggregator.add_local_trained_result(
-                worker, msg.get(MT.ARG_MODEL_PARAMS), msg.get(MT.ARG_NUM_SAMPLES)
+                worker, params, msg.get(MT.ARG_NUM_SAMPLES)
             )
             if self.aggregator.check_whether_all_receive() or (
                 self._deadline_passed
@@ -299,9 +318,24 @@ class FedAvgClientManager(ClientManager):
     def _on_sync(self, msg: Message):
         self.trainer.update_dataset(msg.get(MT.ARG_CLIENT_INDEX))
         round_idx = msg.get(MT.ARG_ROUND_IDX)
-        weights, n = self.trainer.train(round_idx, msg.get(MT.ARG_MODEL_PARAMS))
+        w_round = msg.get(MT.ARG_MODEL_PARAMS)
+        weights, n = self.trainer.train(round_idx, w_round)
         out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
-        out.add_params(MT.ARG_MODEL_PARAMS, weights)
+        comp = self.config.comm.compression
+        if comp != "none":
+            # uplink compression (core/compression.py): send the encoded
+            # round delta; the server reconstructs against the same w_round
+            from fedml_tpu.core import compression as CZ
+
+            out.add_params(
+                MT.ARG_MODEL_DELTA,
+                CZ.encode_update(
+                    weights, w_round, comp, self.config.comm.topk_frac
+                ),
+            )
+            out.add_params(MT.ARG_COMPRESSION, comp)
+        else:
+            out.add_params(MT.ARG_MODEL_PARAMS, weights)
         out.add_params(MT.ARG_NUM_SAMPLES, n)
         # round tag: lets the server discard a straggler's upload for an
         # already-closed round (FedConfig.deadline_s)

@@ -25,6 +25,7 @@ import click
 import numpy as np
 
 from fedml_tpu.config import (
+    CommConfig,
     DataConfig,
     FedConfig,
     MeshConfig,
@@ -127,6 +128,12 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--fused_rounds", type=int, default=1,
               help="Run up to N rounds as one on-device lax.scan chunk "
                    "(fedavg/fedprox + vmap runtime; needs the device cache)")
+@click.option("--compression", type=click.Choice(("none", "int8", "topk")), default="none",
+              help="Transport runtimes: compress the client uplink update "
+                   "(core/compression.py) — int8 quantization or top-k "
+                   "sparsification of the round delta")
+@click.option("--topk_frac", type=float, default=0.01,
+              help="compression=topk: fraction of entries kept per tensor")
 @click.option("--rank", type=int, default=None,
               help="runtime=grpc: this process's rank (0 = server, 1..K = "
                    "clients; ref main_fedavg_rpc.py --fl_worker_index)")
@@ -176,6 +183,10 @@ def build_config(opt) -> RunConfig:
             server_lr=opt["server_lr"],
             server_momentum=opt["server_momentum"],
         ),
+        comm=CommConfig(
+            compression=opt.get("compression", "none"),
+            topk_frac=opt.get("topk_frac", 0.01),
+        ),
         mesh=MeshConfig(client_shards=opt["client_shards"]),
         model=opt["model"],
         seed=opt["seed"],
@@ -189,6 +200,12 @@ def run(**opt):
     from fedml_tpu.utils.profiling import trace
 
     config = build_config(opt)
+    if config.comm.compression != "none" and opt["runtime"] in ("vmap", "mesh"):
+        raise click.UsageError(
+            "--compression applies to the transport runtimes "
+            "(loopback/shm/grpc/mqtt); the vmap/mesh runtimes exchange no "
+            "messages, so the flag would be silently ignored"
+        )
     data = data_registry.load(config)
     task = data_registry.task_for_dataset(config.data.dataset)
     sample_shape = tuple(data.client_x[0].shape[1:])

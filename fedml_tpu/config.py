@@ -104,6 +104,22 @@ class ServerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CommConfig:
+    """Cross-silo transport options (core/). The reference ships raw
+    JSON-list tensors with no compression option anywhere; here the binary
+    wire can additionally carry compressed client UPLINK updates
+    (core/compression.py): the client sends encode(w_local − w_round) and
+    the server reconstructs w_round + decode(...) before aggregating.
+    Downlink (broadcast) stays exact, so the compression error enters only
+    through the weighted average — the standard FL-compression setup."""
+
+    # "none" | "int8" (per-tensor linear quantization) | "topk" (magnitude
+    # sparsification at topk_frac density).
+    compression: str = "none"
+    topk_frac: float = 0.01
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh spec replacing the reference's gpu_mapping.yaml
     (fedml_api/distributed/utils/gpu_mapping.py:8-39)."""
@@ -121,6 +137,7 @@ class RunConfig:
     fed: FedConfig = dataclasses.field(default_factory=FedConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    comm: CommConfig = dataclasses.field(default_factory=CommConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     model: str = "lr"
     seed: int = 0

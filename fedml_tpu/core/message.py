@@ -38,6 +38,13 @@ class MessageType:
 
     # param keys
     ARG_MODEL_PARAMS = "model_params"
+    # compressed uplink update payload (core/compression.py) — carried
+    # INSTEAD of ARG_MODEL_PARAMS when CommConfig.compression != "none",
+    # together with ARG_COMPRESSION naming the codec (the server decodes by
+    # this protocol tag, not by its own config, so a client/server
+    # --compression mismatch is handled instead of crashing the FSM)
+    ARG_MODEL_DELTA = "model_delta"
+    ARG_COMPRESSION = "compression"
     ARG_CLIENT_INDEX = "client_index"
     ARG_NUM_SAMPLES = "num_samples"
     ARG_ROUND_IDX = "round_idx"

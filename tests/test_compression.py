@@ -1,0 +1,123 @@
+"""Uplink update compression (core/compression.py) — codec properties and
+the compressed-federation end-to-end path. The reference has no
+communication compression anywhere (its wire INFLATES tensors ~4x via JSON
+lists, message.py:47-59); this is a beyond-parity transport feature."""
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.core import compression as CZ
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.normal(0, 0.02, size=(64, 32)).astype(np.float32),
+        "b": rng.normal(0, 0.01, size=(32,)).astype(np.float32),
+    }
+
+
+def test_int8_roundtrip_error_bound():
+    t = _tree()
+    payload = CZ.encode_int8(t)
+    back = CZ.decode_int8(payload, t)
+    for k in t:
+        scale = float(np.max(np.abs(t[k]))) / 127.0
+        assert np.max(np.abs(back[k] - t[k])) <= scale / 2 + 1e-9
+    # zero tensors stay exactly zero
+    z = {"w": np.zeros((4, 4), np.float32)}
+    assert np.all(CZ.decode_int8(CZ.encode_int8(z), z)["w"] == 0)
+
+
+def test_int8_payload_is_4x_smaller():
+    t = _tree()
+    raw = CZ.payload_bytes(t)
+    comp = CZ.payload_bytes(CZ.encode_int8(t))
+    assert comp < raw / 3.5  # int8 payload + fp32 scales
+
+
+def test_topk_keeps_largest_magnitudes():
+    t = {"w": np.arange(-50, 50, dtype=np.float32).reshape(10, 10)}
+    back = CZ.decode_topk(CZ.encode_topk(t, frac=0.1), t)["w"].reshape(-1)
+    flat = t["w"].reshape(-1)
+    kept = np.nonzero(back)[0]
+    assert len(kept) == 10
+    # the kept entries are exactly the 10 largest |values|
+    expect = np.sort(np.argsort(np.abs(flat))[-10:])
+    np.testing.assert_array_equal(np.sort(kept), expect)
+    np.testing.assert_array_equal(back[kept], flat[kept])
+
+
+def test_encode_update_symmetry():
+    w_round = _tree(1)
+    w_local = jax.tree_util.tree_map(
+        lambda a: a + np.float32(0.01) * np.sign(a), w_round
+    )
+    back = CZ.decode_update(
+        CZ.encode_update(w_local, w_round, "int8"), w_round, "int8"
+    )
+    for k in w_round:
+        np.testing.assert_allclose(back[k], w_local[k], atol=1e-4)
+    with pytest.raises(ValueError):
+        CZ.encode_update(w_local, w_round, "gzip")
+
+
+@pytest.mark.parametrize("method", ["int8", "topk"])
+def test_compressed_loopback_federation(method):
+    """Federation over the loopback transport with uplink compression:
+    int8 must track the uncompressed simulator closely; topk (50% density
+    on this tiny model) must still converge to a working model."""
+    from fedml_tpu.algorithms import FedAvgAPI
+    from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+    from fedml_tpu.config import (
+        CommConfig,
+        DataConfig,
+        FedConfig,
+        RunConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_classification(
+        num_clients=4, num_classes=3, feat_shape=(5,), samples_per_client=24,
+        partition_method="homo", seed=9,
+    )
+    model_def = lambda: ModelDef(
+        module=LogisticRegression(num_classes=3), input_shape=(5,),
+        num_classes=3, name="lr",
+    )
+    # full batch (the oracle's deterministic config) so sim vs transport
+    # differ ONLY by the codec's reconstruction error. int8 checks param
+    # closeness over a few rounds; topk needs enough rounds to show the
+    # sparsified run actually learns (4 rounds don't learn even
+    # uncompressed — Test/Acc 0.22 at round 3).
+    R = 4 if method == "int8" else 40
+    cfg = RunConfig(
+        data=DataConfig(batch_size=-1),
+        fed=FedConfig(
+            client_num_in_total=4, client_num_per_round=4, comm_round=R,
+            epochs=1, frequency_of_the_test=R,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.5),
+        comm=CommConfig(compression=method, topk_frac=0.5),
+        seed=0,
+    )
+    sim = FedAvgAPI(cfg.replace(comm=CommConfig()), data, model_def())
+    sim.train()
+    server = run_loopback_federation(cfg, data, model_def())
+    assert server.round_idx == R
+    sim_leaves = jax.tree_util.tree_leaves(sim.global_vars)
+    srv_leaves = jax.tree_util.tree_leaves(server.global_vars)
+    if method == "int8":
+        # per-round max error = scale/2 of small deltas — stays close
+        for a, b in zip(sim_leaves, srv_leaves):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3
+            )
+    else:
+        # sparsified updates drift more; the model must still beat chance
+        acc = server.history[-1]["Test/Acc"]
+        assert acc > 0.5, f"topk-compressed run degenerated: acc={acc}"
