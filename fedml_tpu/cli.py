@@ -128,6 +128,12 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--fused_rounds", type=int, default=1,
               help="Run up to N rounds as one on-device lax.scan chunk "
                    "(fedavg/fedprox + vmap runtime; needs the device cache)")
+@click.option("--deadline_s", type=float, default=0.0,
+              help="Transport runtimes: straggler deadline — after this many "
+                   "seconds the server closes the round on a quorum instead "
+                   "of waiting forever (0 = ref-parity wait-for-all)")
+@click.option("--min_clients", type=int, default=1,
+              help="Minimum uploads required to close a deadline round")
 @click.option("--compression", type=click.Choice(("none", "int8", "topk")), default="none",
               help="Transport runtimes: compress the client uplink update "
                    "(core/compression.py) — int8 quantization or top-k "
@@ -168,6 +174,8 @@ def build_config(opt) -> RunConfig:
             group_comm_round=opt["group_comm_round"],
             fused_rounds=opt.get("fused_rounds", 1),
             eval_on_clients=opt.get("eval_on_clients", False),
+            deadline_s=opt.get("deadline_s", 0.0),
+            min_clients=opt.get("min_clients", 1),
         ),
         train=TrainConfig(
             client_optimizer=opt["client_optimizer"],
@@ -200,11 +208,23 @@ def run(**opt):
     from fedml_tpu.utils.profiling import trace
 
     config = build_config(opt)
-    if config.comm.compression != "none" and opt["runtime"] in ("vmap", "mesh"):
+    if opt["runtime"] in ("vmap", "mesh"):
+        if config.comm.compression != "none":
+            raise click.UsageError(
+                "--compression applies to the transport runtimes "
+                "(loopback/shm/grpc/mqtt); the vmap/mesh runtimes exchange "
+                "no messages, so the flag would be silently ignored"
+            )
+        if config.fed.deadline_s or config.fed.min_clients != 1:
+            raise click.UsageError(
+                "--deadline_s/--min_clients apply to the transport runtimes "
+                "(loopback/shm/grpc/mqtt); vmap/mesh rounds are one SPMD "
+                "program with no uploads to time out on"
+            )
+    elif config.fed.min_clients != 1 and not config.fed.deadline_s:
         raise click.UsageError(
-            "--compression applies to the transport runtimes "
-            "(loopback/shm/grpc/mqtt); the vmap/mesh runtimes exchange no "
-            "messages, so the flag would be silently ignored"
+            "--min_clients only takes effect after a --deadline_s deadline "
+            "passes; without one the server still waits for every client"
         )
     data = data_registry.load(config)
     task = data_registry.task_for_dataset(config.data.dataset)

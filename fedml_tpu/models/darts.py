@@ -16,6 +16,8 @@ from __future__ import annotations
 from typing import List, Sequence, Tuple
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax
 import jax.numpy as jnp
 
@@ -40,10 +42,10 @@ class _SepConv(nn.Module):
         s = (self.stride, self.stride)
         h = nn.Conv(x.shape[-1], k, strides=s, padding="SAME", feature_group_count=x.shape[-1], use_bias=False)(nn.relu(x))
         h = nn.Conv(self.ch, (1, 1), use_bias=False)(h)
-        h = nn.BatchNorm(use_running_average=not train, momentum=0.9)(h)
+        h = fp32_batch_norm(train)(h)
         h = nn.Conv(self.ch, k, padding="SAME", feature_group_count=self.ch, use_bias=False)(nn.relu(h))
         h = nn.Conv(self.ch, (1, 1), use_bias=False)(h)
-        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(h)
+        return fp32_batch_norm(train)(h)
 
 
 class _DilConv(nn.Module):
@@ -59,7 +61,7 @@ class _DilConv(nn.Module):
             kernel_dilation=(2, 2), feature_group_count=x.shape[-1], use_bias=False,
         )(nn.relu(x))
         h = nn.Conv(self.ch, (1, 1), use_bias=False)(h)
-        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(h)
+        return fp32_batch_norm(train)(h)
 
 
 class MixedOp(nn.Module):
@@ -153,7 +155,7 @@ class DARTSNetwork(nn.Module):
         w_n = jax.nn.softmax(alpha_normal, axis=-1)
         w_r = jax.nn.softmax(alpha_reduce, axis=-1)
         h = nn.Conv(self.ch, (3, 3), padding="SAME", use_bias=False, name="stem")(x)
-        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, name="stem_bn")(h)
+        h = fp32_batch_norm(train, name="stem_bn")(h)
         s0 = s1 = h
         for ci in range(self.cells):
             reduction = ci == self.cells // 2 and self.cells > 1

@@ -12,6 +12,8 @@ import math
 from typing import Tuple
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax
 import jax.numpy as jnp
 
@@ -29,7 +31,7 @@ def _round_repeats(repeats: int, depth: float) -> int:
 
 
 def _bn(train, name):
-    return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+    return fp32_batch_norm(train, name=name)
 
 
 class MBConv(nn.Module):

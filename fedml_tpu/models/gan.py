@@ -9,6 +9,8 @@ BCELoss)."""
 from __future__ import annotations
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax.numpy as jnp
 
 
@@ -18,9 +20,7 @@ class Generator(nn.Module):
 
     @nn.compact
     def __call__(self, z, train: bool = False):
-        bn = lambda name: nn.BatchNorm(
-            use_running_average=not train, momentum=0.9, name=name
-        )
+        bn = lambda name: fp32_batch_norm(train, name=name)
         h = nn.leaky_relu(nn.Dense(128, name="fc1")(z), 0.2)
         h = nn.leaky_relu(bn("bn2")(nn.Dense(256, name="fc2")(h)), 0.2)
         h = nn.leaky_relu(bn("bn3")(nn.Dense(512, name="fc3")(h)), 0.2)

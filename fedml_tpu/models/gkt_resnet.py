@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax.numpy as jnp
 
 from fedml_tpu.models.resnet import Bottleneck
@@ -27,7 +29,7 @@ class GKTClientResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
-        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, name="bn1")(h)
+        h = fp32_batch_norm(train, name="bn1")(h)
         h = nn.relu(h)
         features = h  # ref resnet_client.py:193 extracted_features
         for bi in range(self.blocks):

@@ -13,11 +13,13 @@ from __future__ import annotations
 from typing import Sequence, Tuple
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax.numpy as jnp
 
 
 def _bn(train, name):
-    return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+    return fp32_batch_norm(train, name=name)
 
 
 class DepthSeparableConv(nn.Module):

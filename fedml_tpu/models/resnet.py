@@ -15,6 +15,8 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax.numpy as jnp
 
 
@@ -25,9 +27,7 @@ class Bottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = lambda name: nn.BatchNorm(
-            use_running_average=not train, momentum=0.9, name=name
-        )
+        norm = lambda name: fp32_batch_norm(train, name=name)
         out_ch = self.planes * self.expansion
         identity = x
         h = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
@@ -62,7 +62,7 @@ class CifarResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
-        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, name="bn1")(h)
+        h = fp32_batch_norm(train, name="bn1")(h)
         h = nn.relu(h)
         for si, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
             for bi in range(blocks):

@@ -16,13 +16,15 @@ from __future__ import annotations
 from typing import Sequence, Type
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax.numpy as jnp
 
 
 def _norm(channels_per_group: int, train: bool, name: str):
     if channels_per_group > 0:
         return nn.GroupNorm(num_groups=None, group_size=channels_per_group, name=name)
-    return nn.BatchNorm(use_running_average=not train, momentum=0.9, name=name)
+    return fp32_batch_norm(train, name=name)
 
 
 class BasicBlock(nn.Module):

@@ -10,6 +10,8 @@ decoder with skip connection, per-pixel class logits."""
 from __future__ import annotations
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax
 import jax.numpy as jnp
 
@@ -21,9 +23,7 @@ class EncoderDecoder(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         w = self.width
-        bn = lambda name: nn.BatchNorm(
-            use_running_average=not train, momentum=0.9, name=name
-        )
+        bn = lambda name: fp32_batch_norm(train, name=name)
         # encoder
         e1 = nn.relu(bn("bn1")(nn.Conv(w, (3, 3), padding="SAME", use_bias=False, name="enc1")(x)))
         e2 = nn.relu(

@@ -11,6 +11,8 @@ from __future__ import annotations
 from typing import Sequence, Union
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_batch_norm
 import jax.numpy as jnp
 
 _CFGS = {
@@ -51,9 +53,7 @@ class VGG(nn.Module):
             else:
                 h = nn.Conv(int(v), (3, 3), padding="SAME", name=f"conv{ci}")(h)
                 if self.batch_norm:
-                    h = nn.BatchNorm(
-                        use_running_average=not train, momentum=0.9, name=f"bn{ci}"
-                    )(h)
+                    h = fp32_batch_norm(train, name=f"bn{ci}")(h)
                 h = nn.relu(h)
                 ci += 1
         h = _adaptive_avg_pool(h, 7)

@@ -62,8 +62,12 @@ def cast_floats(tree, dtype):
 def make_mixed_forward(model: ModelDef, tc: TrainConfig):
     """The shared mixed-precision forward: fp32 master params are cast to
     ``tc.compute_dtype`` inside the differentiated function (the cast is
-    linear, so grads come back fp32); logits and mutable collections (BN
-    stats) are restored to fp32 so scan carries keep stable dtypes. When
+    linear, so grads come back fp32); logits are restored to fp32 so scan
+    carries keep stable dtypes. Mutable collections (BN running stats) are
+    NEVER cast down: batch statistics are fp32-only territory — the zoo's
+    BatchNorms normalize in fp32 and cast back (models/norms.py), and
+    quantizing the running-stat EMA to bf16 each step would re-inject the
+    error that helper exists to remove. When
     ``tc.augment`` names a policy (train/augment.py), per-sample
     augmentation runs here — inside jit, fused with the forward — so both
     the federated and centralized paths share one definition.
@@ -86,12 +90,11 @@ def make_mixed_forward(model: ModelDef, tc: TrainConfig):
             xb = augment_fn(jax.random.fold_in(step_rng, 7), xb)
         if mixed:
             params_c = cast_floats(params, cdt)
-            extra_c = cast_floats(extra, cdt)
             xb_c = cast_floats(xb, cdt)
         else:
-            params_c, extra_c, xb_c = params, extra, xb
+            params_c, xb_c = params, xb
         logits, new_vars = model.apply(
-            {"params": params_c, **extra_c}, xb_c, train=True, rng=step_rng
+            {"params": params_c, **extra}, xb_c, train=True, rng=step_rng
         )
         logits = logits.astype(jnp.float32)
         if mixed:
