@@ -35,3 +35,27 @@ def fp32_batch_norm(train: bool, momentum: float = 0.9, name: str | None = None)
         return bn(x.astype(jnp.float32)).astype(x.dtype)
 
     return apply
+
+
+def fp32_group_norm(group_size: int, name: str | None = None):
+    """GroupNorm with fp32 statistics, output cast back to x.dtype — the
+    same E[x²]−E[x]² cancellation argument as fp32_batch_norm (no running
+    stats, but the per-group variance itself is bf16-hostile)."""
+    gn = nn.GroupNorm(
+        num_groups=None, group_size=group_size, dtype=jnp.float32, name=name
+    )
+
+    def apply(x):
+        return gn(x.astype(jnp.float32)).astype(x.dtype)
+
+    return apply
+
+
+def fp32_layer_norm(name: str | None = None):
+    """LayerNorm with fp32 statistics, output cast back to x.dtype."""
+    ln = nn.LayerNorm(dtype=jnp.float32, name=name)
+
+    def apply(x):
+        return ln(x.astype(jnp.float32)).astype(x.dtype)
+
+    return apply

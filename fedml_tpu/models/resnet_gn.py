@@ -17,13 +17,13 @@ from typing import Sequence, Type
 
 import flax.linen as nn
 
-from fedml_tpu.models.norms import fp32_batch_norm
+from fedml_tpu.models.norms import fp32_batch_norm, fp32_group_norm
 import jax.numpy as jnp
 
 
 def _norm(channels_per_group: int, train: bool, name: str):
     if channels_per_group > 0:
-        return nn.GroupNorm(num_groups=None, group_size=channels_per_group, name=name)
+        return fp32_group_norm(channels_per_group, name=name)
     return fp32_batch_norm(train, name=name)
 
 

@@ -16,6 +16,8 @@ import functools
 from typing import Callable, Optional
 
 import flax.linen as nn
+
+from fedml_tpu.models.norms import fp32_layer_norm
 import jax
 import jax.numpy as jnp
 
@@ -82,7 +84,7 @@ class TransformerBlock(nn.Module):
         B, T, C = x.shape
         H = self.num_heads
         D = C // H
-        h = nn.LayerNorm(name="ln1")(x)
+        h = fp32_layer_norm(name="ln1")(x)
         qkv = nn.Dense(3 * C, use_bias=False, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D)
@@ -91,7 +93,7 @@ class TransformerBlock(nn.Module):
         attn = self.attn_fn(q, k, v)
         attn = attn.reshape(B, T, C)
         x = x + nn.Dense(C, use_bias=False, name="proj")(attn)
-        h = nn.LayerNorm(name="ln2")(x)
+        h = fp32_layer_norm(name="ln2")(x)
         if self.moe_experts:
             y, aux = MoEMLP(
                 self.moe_experts, self.mlp_ratio,
@@ -143,7 +145,7 @@ class TransformerLM(nn.Module):
                 aux_total = aux_total + aux
             else:
                 x = block(x, train=train)
-        x = nn.LayerNorm(name="ln_f")(x)
+        x = fp32_layer_norm(name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, name="head")(x)
         if self.moe_experts:
             return logits, aux_total / self.num_layers

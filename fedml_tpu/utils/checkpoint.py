@@ -62,7 +62,16 @@ def save_checkpoint(
     """Atomic write of (params, server opt state, round, rng): everything —
     including the metadata — lives in ONE npz installed via os.replace, so a
     crash can never leave a mismatched meta/array pair. A sidecar .json copy
-    of the metadata is written after the replace purely for humans."""
+    of the metadata is written after the replace purely for humans.
+
+    Multi-host safe: processes other than 0 no-op (params are replicated,
+    host 0 owns the save — N concurrent writers on a shared filesystem
+    would race), and the tmp name is per-PID so even misconfigured
+    same-path writers cannot interleave into one file."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     flat: Dict[str, np.ndarray] = {}
     _flatten("vars", _to_numpy(global_vars), flat)
@@ -75,7 +84,7 @@ def save_checkpoint(
     flat["__meta__"] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8
     )
-    tmp = path + ".tmp.npz"
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
     np.savez(tmp, **flat)
     os.replace(tmp, path + ".npz")
     with open(path + ".json", "w") as f:
