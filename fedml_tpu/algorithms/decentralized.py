@@ -50,6 +50,8 @@ def make_decentralized_run(
     x: [N, T, *feat] streaming samples (worker-major), y: [N, T] (binary
     targets, ref BCELoss on logistic regression). variant: "dsgd" | "pushsum".
     """
+    if variant not in ("dsgd", "pushsum"):
+        raise ValueError(f"variant must be 'dsgd' or 'pushsum', got {variant!r}")
     W = jnp.asarray(mixing_matrix, jnp.float32)
     if variant == "pushsum":
         # Row-stochastic W does not conserve Σx under mixing; Push-Sum's

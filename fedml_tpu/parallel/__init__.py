@@ -25,6 +25,9 @@ from fedml_tpu.parallel.multihost import (
     initialize_multihost,
     mesh_traffic_summary,
 )
+from fedml_tpu.parallel.decentralized_sharded import (
+    make_sharded_decentralized_run,
+)
 
 __all__ = [
     "make_mesh",
@@ -40,4 +43,5 @@ __all__ = [
     "hybrid_mesh",
     "initialize_multihost",
     "mesh_traffic_summary",
+    "make_sharded_decentralized_run",
 ]

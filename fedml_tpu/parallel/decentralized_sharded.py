@@ -1,0 +1,148 @@
+"""Mesh-sharded decentralized gossip — topology mixing as ppermutes.
+
+SURVEY §2g maps the reference's decentralized neighbor averaging
+(decentralized_worker_manager.py:41-46, standalone client_dsgd.py) to
+"sparse collective/permute patterns" on TPU; this module is that mapping.
+Workers live one-per-shard on a mesh axis. Any N×N mixing matrix W
+decomposes into cyclic-offset bands
+
+    W = Σ_d diag(w_d) · P_d ,   w_d[i] = W[i, (i+d) mod N]
+
+where P_d is the cyclic shift by d — so one gossip step is one
+``lax.ppermute`` per REALIZED band (ring+random-link topologies from
+partition/topology.py have only a handful), each a pure ICI
+neighbor-exchange with no gather and no host round-trip. The whole online
+run (T streaming iterations of local SGD + gossip, ref
+decentralized_fl_api.py:20-99) is a single jitted ``shard_map``-ed
+``lax.scan``.
+
+Math parity: identical to algorithms/decentralized.py's dense-einsum
+simulator (the equality test runs both); Push-Sum mixes with Wᵀ for the
+same column-stochasticity reason documented there.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.algorithms.decentralized import _binary_loss
+from fedml_tpu.models import ModelDef
+
+
+def cyclic_decompose(W: np.ndarray) -> Tuple[List[int], np.ndarray]:
+    """W → (offsets, weights [N, n_offsets]) with only realized bands kept.
+    offsets[0] is always 0 (self weight; may be the zero vector)."""
+    W = np.asarray(W, np.float32)
+    N = W.shape[0]
+    idx = np.arange(N)
+    offsets, cols = [0], [W[idx, idx]]
+    for d in range(1, N):
+        w_d = W[idx, (idx + d) % N]
+        if np.any(w_d != 0):
+            offsets.append(d)
+            cols.append(w_d)
+    return offsets, np.stack(cols, axis=1)
+
+
+def make_sharded_decentralized_run(
+    model: ModelDef,
+    mixing_matrix: np.ndarray,
+    mesh: Mesh,
+    lr: float,
+    wd: float = 0.0,
+    variant: str = "dsgd",
+    loss_fn: Optional[Callable] = None,
+):
+    """Build ``run(stacked_params, x, y) -> (final_params, per_iter_loss)``
+    with the worker axis sharded over ``mesh`` (one worker per shard).
+
+    Same signature/semantics as algorithms/decentralized.py's
+    make_decentralized_run: x [N, T, *feat], y [N, T].
+    """
+    if variant not in ("dsgd", "pushsum"):
+        raise ValueError(f"variant must be 'dsgd' or 'pushsum', got {variant!r}")
+    axis = mesh.axis_names[0]
+    N = int(np.asarray(mixing_matrix).shape[0])
+    if mesh.shape[axis] != N:
+        raise ValueError(
+            f"workers ({N}) must equal mesh shards ({mesh.shape[axis]}) — "
+            "one gossip worker per shard"
+        )
+    W = np.asarray(mixing_matrix, np.float32)
+    if variant == "pushsum":
+        W = W.T  # column-stochastic push (see algorithms/decentralized.py)
+    offsets, weights = cyclic_decompose(W)  # weights [N, n_offsets]
+    perms = {
+        d: [(s, (s - d) % N) for s in range(N)] for d in offsets if d != 0
+    }
+    loss_fn = loss_fn or _binary_loss(model)
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def mix(tree, w_local):
+        """one gossip step: self band + one ppermute per neighbor band."""
+        mixed = jax.tree_util.tree_map(lambda p: p * w_local[0], tree)
+        for k, d in enumerate(offsets[1:], start=1):
+            shifted = jax.tree_util.tree_map(
+                lambda p: jax.lax.ppermute(p, axis, perms[d]), tree
+            )
+            mixed = jax.tree_util.tree_map(
+                lambda m, s: m + w_local[k] * s, mixed, shifted
+            )
+        return mixed
+
+    def shard_body(stacked_params, w_cols, x, y):
+        # local shapes carry the worker axis at size 1 — drop it
+        sq = lambda a: a.reshape(a.shape[1:])
+        params = jax.tree_util.tree_map(sq, stacked_params)
+        w_local = w_cols.reshape(-1)  # [n_offsets]
+        x_l, y_l = sq(x), sq(y)
+        T = x_l.shape[0]
+
+        def step(carry, t):
+            params, omega = carry
+            loss, grads = grad_fn(params, x_l[t][None], y_l[t][None])
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * (g + wd * p), params, grads
+            )
+            params = mix(params, w_local)
+            if variant == "pushsum":
+                omega = mix(omega, w_local)
+            return (params, omega), jax.lax.pmean(loss, axis)
+
+        # per-worker scalar: mark varying so the scan carry type matches
+        # after the (worker-varying) mix updates it
+        omega0 = jax.lax.pcast(
+            jnp.ones((), jnp.float32), (axis,), to="varying"
+        )
+        (params, omega), losses = jax.lax.scan(
+            step, (params, omega0), jnp.arange(T)
+        )
+        if variant == "pushsum":
+            params = jax.tree_util.tree_map(lambda p: p / omega, params)
+        return jax.tree_util.tree_map(lambda p: p[None], params), losses
+
+    spec = P(axis)
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, P()),
+    )
+    sharded_jit = jax.jit(sharded)
+    w_dev = jnp.asarray(weights)
+
+    def run(stacked_params, x, y):
+        put = lambda a: jax.device_put(a, NamedSharding(mesh, spec))
+        return sharded_jit(
+            jax.tree_util.tree_map(put, stacked_params),
+            put(w_dev),
+            put(jnp.asarray(x)),
+            put(jnp.asarray(y, jnp.float32)),
+        )
+
+    return run
