@@ -22,18 +22,16 @@ from fedml_tpu.robustness import (
 )
 
 
-def make_robust_fedavg_round(
-    model,
-    config,
-    robust: RobustConfig,
-    task: str = "classification",
-    local_train_fn=None,
-    donate: bool = True,
-):
-    """The FedAvg round skeleton with the defense inserted via its
-    post_train/post_aggregate hooks (the skeleton itself lives once, in
-    make_fedavg_round)."""
-    from fedml_tpu.algorithms.fedavg import make_fedavg_round
+# fold_in tag deriving the weak-DP noise key from the round rng — ONE
+# definition, shared by the vmap and mesh APIs (their exact equality is a
+# test contract, tests/test_robust_sharded.py)
+NOISE_FOLD = 0x5EED
+
+
+def make_defense_hooks(robust: RobustConfig):
+    """defense config → (post_train, post_aggregate, aggregate_fn) — the
+    hook triple both round skeletons (vmap make_fedavg_round, mesh
+    make_sharded_fedavg_round) accept, so the defense math lives once."""
 
     def post_train(client_vars, global_vars, noise_rng):
         if robust.defense_type in ("norm_diff_clipping", "weak_dp"):
@@ -47,6 +45,23 @@ def make_robust_fedavg_round(
             return add_gaussian_noise(new_global, noise_rng, robust.stddev)
         return new_global
 
+    return post_train, post_aggregate, make_byzantine_aggregate(robust)
+
+
+def make_robust_fedavg_round(
+    model,
+    config,
+    robust: RobustConfig,
+    task: str = "classification",
+    local_train_fn=None,
+    donate: bool = True,
+):
+    """The FedAvg round skeleton with the defense inserted via its
+    post_train/post_aggregate hooks (the skeleton itself lives once, in
+    make_fedavg_round)."""
+    from fedml_tpu.algorithms.fedavg import make_fedavg_round
+
+    post_train, post_aggregate, aggregate_fn = make_defense_hooks(robust)
     return make_fedavg_round(
         model,
         config,
@@ -55,7 +70,7 @@ def make_robust_fedavg_round(
         donate=donate,
         post_train=post_train,
         post_aggregate=post_aggregate,
-        aggregate_fn=make_byzantine_aggregate(robust),
+        aggregate_fn=aggregate_fn,
     )
 
 
@@ -80,5 +95,5 @@ class RobustFedAvgAPI(FedAvgAPI):
 
     def _place_batch(self, batch, round_rng):
         base = super()._place_batch(batch, round_rng)
-        noise_rng = jax.random.fold_in(round_rng, 0x5EED)
+        noise_rng = jax.random.fold_in(round_rng, NOISE_FOLD)
         return base + (noise_rng,)

@@ -411,6 +411,17 @@ def _restore(api, opt):
 def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                defense="norm_diff_clipping", num_byzantine=1, multi_krum_m=3,
                norm_bound=5.0, noise_stddev=0.025, attack_cfg=None):
+    from fedml_tpu.robustness import RobustConfig
+
+    # one RobustConfig for whichever runtime's robust API is selected —
+    # vmap and mesh must see identical defense parameters
+    robust = RobustConfig(
+        defense_type=defense,
+        norm_bound=norm_bound,
+        stddev=noise_stddev,
+        num_byzantine=num_byzantine,
+        multi_krum_m=multi_krum_m,
+    )
     if runtime in ("loopback", "mqtt", "shm"):
         if algorithm != "fedavg":
             raise click.UsageError(
@@ -447,9 +458,16 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
             return DistributedFedOptAPI(
                 config, data, model, task=task, log_fn=log_fn
             )
+        if algorithm == "fedavg_robust":
+            from fedml_tpu.parallel import RobustDistributedFedAvgAPI
+
+            return RobustDistributedFedAvgAPI(
+                config, data, model, task=task, log_fn=log_fn, robust=robust
+            )
         if algorithm not in ("fedavg", "fedprox"):
             raise click.UsageError(
-                "runtime=mesh currently supports fedavg/fedprox/fedopt"
+                "runtime=mesh currently supports fedavg/fedprox/fedopt/"
+                "fedavg_robust"
             )
         return DistributedFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
 
@@ -472,13 +490,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         return HierarchicalFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
     if algorithm == "fedavg_robust":
         from fedml_tpu.algorithms.fedavg_robust import RobustFedAvgAPI
-        from fedml_tpu.robustness.robust_aggregation import RobustConfig
 
-        robust = RobustConfig(defense_type=defense,
-                              norm_bound=norm_bound,
-                              stddev=noise_stddev,
-                              num_byzantine=num_byzantine,
-                              multi_krum_m=multi_krum_m)
         if attack_cfg is not None:
             from fedml_tpu.robustness.backdoor import BackdoorFedAvgAPI
 

@@ -12,6 +12,7 @@ from fedml_tpu.parallel.fedavg_sharded import (
     make_sharded_fedavg_round,
     DistributedFedAvgAPI,
     DistributedFedOptAPI,
+    RobustDistributedFedAvgAPI,
 )
 from fedml_tpu.parallel.tensor_parallel import make_tp_train_step
 from fedml_tpu.parallel.expert_parallel import make_ep_train_step
@@ -35,6 +36,7 @@ __all__ = [
     "make_sharded_fedavg_round",
     "DistributedFedAvgAPI",
     "DistributedFedOptAPI",
+    "RobustDistributedFedAvgAPI",
     "make_tp_train_step",
     "make_ep_train_step",
     "make_pp_train_step",

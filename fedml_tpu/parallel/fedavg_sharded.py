@@ -41,22 +41,35 @@ def make_sharded_fedavg_round(
     task: str = "classification",
     local_train_fn: Optional[Callable] = None,
     donate: bool = True,
+    post_train: Optional[Callable] = None,
+    post_aggregate: Optional[Callable] = None,
+    aggregate_fn: Optional[Callable] = None,
+    n_extra: int = 0,
 ):
     """Build the jitted sharded round function.
 
-    Returned fn: ``(global_vars, x, y, mask, num_samples, client_rngs) ->
-    (global_vars', metrics)`` where the leading client axis of the data args
-    is sharded over the mesh and C % mesh_size == 0 (use
+    Returned fn: ``(global_vars, x, y, mask, num_samples, client_rngs,
+    *extra) -> (global_vars', metrics)`` where the leading client axis of
+    the data args is sharded over the mesh and C % mesh_size == 0 (use
     :func:`pad_client_batch`). ``client_rngs`` is [C, 2]-shaped PRNG key data,
     one key per client, so per-client randomness is identical regardless of
     mesh size (same-seed single-chip and 8-shard runs bit-match — the
-    mesh-invariance test relies on this)."""
+    mesh-invariance test relies on this).
+
+    The hook triple mirrors :func:`make_fedavg_round` exactly (same
+    signatures, same semantics), so one defense/variant definition serves
+    both runtimes. ``n_extra`` replicated trailing args (e.g. a noise rng)
+    are forwarded to both hooks. ``aggregate_fn`` replaces the weighted
+    psum; because the Byzantine aggregators are order statistics over the
+    FULL client axis, the skeleton ``all_gather``s the client updates over
+    ICI and hands the aggregate_fn the same stacked view the vmap runtime
+    gives it — equality by construction."""
     axis = mesh.axis_names[0]
     local_train = local_train_fn or make_local_train(
         model, config.train, config.fed.epochs, task=task
     )
 
-    def shard_body(global_vars, x, y, mask, num_samples, client_rngs):
+    def shard_body(global_vars, x, y, mask, num_samples, client_rngs, *extra):
         # Params enter replicated (spec P()); mark them device-varying so the
         # local-train scan carry (params mixed with sharded data) type-checks
         # under shard_map's varying-manual-axes rules.
@@ -66,15 +79,27 @@ def make_sharded_fedavg_round(
         client_vars, metrics = jax.vmap(
             local_train, in_axes=(None, 0, 0, 0, 0)
         )(global_vars, x, y, mask, client_rngs)
-        # Weighted partial sum on this shard, then one psum over ICI.
-        wsum = jax.lax.psum(jnp.sum(num_samples), axis)
-        new_global = jax.tree_util.tree_map(
-            lambda p: jax.lax.psum(
-                jnp.tensordot(num_samples, p.astype(jnp.float32), axes=1), axis
+        if post_train is not None:
+            client_vars = post_train(client_vars, global_vars, *extra)
+        if aggregate_fn is not None:
+            gathered = jax.tree_util.tree_map(
+                lambda p: jax.lax.all_gather(p, axis, tiled=True), client_vars
             )
-            / wsum,
-            client_vars,
-        )
+            ns_all = jax.lax.all_gather(num_samples, axis, tiled=True)
+            new_global = aggregate_fn(gathered, ns_all)
+        else:
+            # Weighted partial sum on this shard, then one psum over ICI.
+            wsum = jax.lax.psum(jnp.sum(num_samples), axis)
+            new_global = jax.tree_util.tree_map(
+                lambda p: jax.lax.psum(
+                    jnp.tensordot(num_samples, p.astype(jnp.float32), axes=1),
+                    axis,
+                )
+                / wsum,
+                client_vars,
+            )
+        if post_aggregate is not None:
+            new_global = post_aggregate(new_global, *extra)
         agg_metrics = jax.tree_util.tree_map(
             lambda m: jax.lax.psum(jnp.sum(m), axis), metrics
         )
@@ -84,8 +109,12 @@ def make_sharded_fedavg_round(
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(), data_spec, data_spec, data_spec, data_spec, data_spec),
+        in_specs=(P(),) + (data_spec,) * 5 + (P(),) * n_extra,
         out_specs=(P(), P()),
+        # the all_gather-ed aggregate is replicated by construction (every
+        # shard reduces the same gathered stack), which static VMA
+        # inference cannot see
+        check_vma=aggregate_fn is None,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
@@ -147,6 +176,51 @@ class DistributedFedAvgAPI(FedAvgAPI):
             put(batch.num_samples),
             put(client_rngs),
         )
+
+
+class RobustDistributedFedAvgAPI(DistributedFedAvgAPI):
+    """fedavg_robust on the multi-chip mesh runtime. Byzantine order
+    statistics cannot silently include the zero dummy clients that client-
+    axis padding would introduce, so the cohort must divide the mesh."""
+
+    def __init__(self, config, data, model, robust=None, mesh=None, **kw):
+        from fedml_tpu.robustness import BYZANTINE_AGGREGATORS, RobustConfig
+
+        self.robust = robust or RobustConfig()
+        super().__init__(config, data, model, mesh=mesh, **kw)
+        if (
+            self.robust.defense_type in BYZANTINE_AGGREGATORS
+            and config.fed.client_num_per_round % self.n_shards
+        ):
+            raise ValueError(
+                f"Byzantine aggregation on the mesh needs client_num_per_round "
+                f"({config.fed.client_num_per_round}) divisible by the mesh "
+                f"({self.n_shards}) — padded dummy clients would corrupt the "
+                "order statistics"
+            )
+
+    def _build_round_fn(self, local_train_fn):
+        from fedml_tpu.algorithms.fedavg_robust import make_defense_hooks
+
+        post_train, post_aggregate, aggregate_fn = make_defense_hooks(self.robust)
+        return make_sharded_fedavg_round(
+            self.model,
+            self.config,
+            self.mesh,
+            task=self.task,
+            local_train_fn=local_train_fn,
+            donate=self._donate,
+            post_train=post_train,
+            post_aggregate=post_aggregate,
+            aggregate_fn=aggregate_fn,
+            n_extra=1,  # the replicated noise rng
+        )
+
+    def _place_batch(self, batch, round_rng):
+        from fedml_tpu.algorithms.fedavg_robust import NOISE_FOLD
+
+        base = super()._place_batch(batch, round_rng)
+        return base + (jax.random.fold_in(round_rng, NOISE_FOLD),)
 
 
 class DistributedFedOptAPI(FedOptAPI, DistributedFedAvgAPI):
