@@ -38,11 +38,13 @@ def _accum_factor(tau, momentum: float):
     return tau
 
 
-def make_fednova_round(model, config, task="classification", local_train_fn=None, donate=True):
-    # The closed-form a_i below models plain/momentum SGD only. The
-    # reference's mu-aware accumulation (fednova.py etamu branch) and
-    # adaptive client optimizers are not modeled — reject rather than
-    # silently mis-normalize.
+def _validate_and_build(model, config, task, local_train_fn):
+    """Shared guard + local-train construction for BOTH FedNova round
+    factories (vmap and mesh), so the supported-optimizer surface can
+    never diverge between them. The closed-form a_i models plain/momentum
+    SGD only; the reference's mu-aware accumulation (fednova.py etamu
+    branch) and adaptive client optimizers are not modeled — reject rather
+    than silently mis-normalize."""
     if config.train.client_optimizer != "sgd":
         raise ValueError(
             "FedNova requires client_optimizer='sgd' "
@@ -53,7 +55,11 @@ def make_fednova_round(model, config, task="classification", local_train_fn=None
     local_train = local_train_fn or make_local_train(
         model, config.train, config.fed.epochs, task=task
     )
-    momentum = config.train.momentum
+    return local_train, config.train.momentum
+
+
+def make_fednova_round(model, config, task="classification", local_train_fn=None, donate=True):
+    local_train, momentum = _validate_and_build(model, config, task, local_train_fn)
 
     def round_fn(global_vars, x, y, mask, num_samples, client_rngs):
         client_vars, metrics = jax.vmap(
@@ -107,3 +113,72 @@ class FedNovaAPI(FedAvgAPI):
             local_train_fn=local_train_fn,
             donate=self._donate,
         )
+
+
+def make_sharded_fednova_round(model, config, mesh, task="classification", local_train_fn=None, donate=True):
+    """The FedNova round over a client-sharded mesh: p-normalization,
+    τ_eff, and the normalized-update tensordot become partial sums + one
+    psum each over ICI. Math identical to :func:`make_fednova_round`
+    (the mesh-vs-vmap parity test covers it)."""
+    from jax.sharding import PartitionSpec as P
+
+    local_train, momentum = _validate_and_build(model, config, task, local_train_fn)
+    axis = mesh.axis_names[0]
+
+    def shard_body(global_vars, x, y, mask, num_samples, client_rngs):
+        # keep the replicated (invariant) view for the aggregation: the
+        # final w' = g − psum(...) must be invariant for out_spec P(); the
+        # varying cast is only needed where params mix with sharded data
+        g_inv = global_vars
+        global_vars = jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(a, (axis,), to="varying"), global_vars
+        )
+        client_vars, metrics = jax.vmap(
+            local_train, in_axes=(None, 0, 0, 0, 0)
+        )(global_vars, x, y, mask, client_rngs)
+        p = num_samples / jax.lax.psum(jnp.sum(num_samples), axis)
+        tau = metrics["steps"]
+        a = _accum_factor(tau, momentum)
+        a_safe = jnp.where(a > 0, a, 1.0)
+        tau_eff = jax.lax.psum(jnp.sum(p * a), axis)
+        coeff = p * tau_eff / a_safe * (a > 0)
+
+        def nova_avg(stacked, g):
+            stacked = stacked.astype(jnp.float32)
+            return g - jax.lax.psum(
+                jnp.tensordot(coeff, g[None] - stacked, axes=1), axis
+            )
+
+        new_params = jax.tree_util.tree_map(
+            nova_avg, client_vars["params"], g_inv["params"]
+        )
+        new_global = {
+            k: (
+                new_params
+                if k == "params"
+                else jax.tree_util.tree_map(
+                    lambda s: jax.lax.psum(
+                        jnp.tensordot(p, s.astype(jnp.float32), axes=1), axis
+                    ),
+                    v,
+                )
+            )
+            for k, v in client_vars.items()
+        }
+        agg_metrics = jax.tree_util.tree_map(
+            lambda m: jax.lax.psum(jnp.sum(m), axis), metrics
+        )
+        return new_global, agg_metrics
+
+    spec = P(axis)
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(),) + (spec,) * 5,
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+# The mesh-runtime driver (DistributedFedNovaAPI) lives in
+# parallel/fedavg_sharded.py next to its FedAvg/FedOpt siblings.

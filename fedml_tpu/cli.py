@@ -464,10 +464,23 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
             return RobustDistributedFedAvgAPI(
                 config, data, model, task=task, log_fn=log_fn, robust=robust
             )
+        if algorithm == "fednova":
+            from fedml_tpu.parallel import DistributedFedNovaAPI
+
+            return DistributedFedNovaAPI(
+                config, data, model, task=task, log_fn=log_fn
+            )
+        if algorithm == "hierarchical":
+            from fedml_tpu.parallel import HierarchicalShardedAPI
+
+            # default mesh = hybrid groups×clients from config.fed.group_num
+            return HierarchicalShardedAPI(
+                config, data, model, task=task, log_fn=log_fn
+            )
         if algorithm not in ("fedavg", "fedprox"):
             raise click.UsageError(
                 "runtime=mesh currently supports fedavg/fedprox/fedopt/"
-                "fedavg_robust"
+                "fednova/hierarchical/fedavg_robust"
             )
         return DistributedFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
 

@@ -11,6 +11,7 @@ from fedml_tpu.parallel.mesh import make_mesh, pad_client_batch
 from fedml_tpu.parallel.fedavg_sharded import (
     make_sharded_fedavg_round,
     DistributedFedAvgAPI,
+    DistributedFedNovaAPI,
     DistributedFedOptAPI,
     RobustDistributedFedAvgAPI,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "pad_client_batch",
     "make_sharded_fedavg_round",
     "DistributedFedAvgAPI",
+    "DistributedFedNovaAPI",
     "DistributedFedOptAPI",
     "RobustDistributedFedAvgAPI",
     "make_tp_train_step",

@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, round_client_rngs
+from fedml_tpu.algorithms.fednova import FedNovaAPI
 from fedml_tpu.algorithms.fedopt import FedOptAPI
 from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import ClientBatch, FederatedDataset
@@ -221,6 +222,25 @@ class RobustDistributedFedAvgAPI(DistributedFedAvgAPI):
 
         base = super()._place_batch(batch, round_rng)
         return base + (jax.random.fold_in(round_rng, NOISE_FOLD),)
+
+
+class DistributedFedNovaAPI(FedNovaAPI, DistributedFedAvgAPI):
+    """FedNova (normalized averaging) on the multi-chip mesh runtime — the
+    reference's fednova is standalone-only. Cooperative MRO:
+    DistributedFedAvgAPI supplies the mesh bootstrap + sharded batch
+    placement; this class only swaps in the sharded FedNova round."""
+
+    def _build_round_fn(self, local_train_fn):
+        from fedml_tpu.algorithms.fednova import make_sharded_fednova_round
+
+        return make_sharded_fednova_round(
+            self.model,
+            self.config,
+            self.mesh,
+            task=self.task,
+            local_train_fn=local_train_fn,
+            donate=self._donate,
+        )
 
 
 class DistributedFedOptAPI(FedOptAPI, DistributedFedAvgAPI):
