@@ -133,6 +133,7 @@ class FedAvgServerManager(ServerManager):
         task: str = "classification",
         worker_num: Optional[int] = None,
         log_fn=None,
+        server_opt: bool = False,
     ):
         super().__init__(comm, rank=0)
         self.config = config
@@ -142,6 +143,19 @@ class FedAvgServerManager(ServerManager):
         self.log_fn = log_fn or (lambda m: None)
         self.worker_num = worker_num or config.fed.client_num_per_round
         self.aggregator = FedAvgAggregator(self.worker_num)
+        # FedOpt over the transport (the reference's fedopt IS a
+        # distributed MPI algorithm, FedOptAggregator.py:95-117): apply the
+        # server optimizer to the pseudo-gradient after each aggregate.
+        self._server_step = None
+        self._server_opt_state = None
+        if server_opt:
+            from fedml_tpu.algorithms.fedopt import (
+                make_server_optimizer,
+                make_server_step,
+            )
+
+            self._server_optimizer = make_server_optimizer(config.server)
+            self._server_step = jax.jit(make_server_step(self._server_optimizer))
         self.round_idx = 0
         # Straggler deadline state (FedConfig.deadline_s/min_clients). The
         # timer thread races the comm receive loop; _round_lock serializes
@@ -266,7 +280,17 @@ class FedAvgServerManager(ServerManager):
         """Aggregate whatever has arrived, eval, resample, broadcast.
         Caller holds _round_lock."""
         self._disarm_deadline()
-        self.global_vars = self.aggregator.aggregate()
+        avg = self.aggregator.aggregate()
+        if self._server_step is not None:
+            if self._server_opt_state is None:
+                self._server_opt_state = self._server_optimizer.init(
+                    self.global_vars["params"]
+                )
+            self.global_vars, self._server_opt_state = jax.device_get(
+                self._server_step(self.global_vars, avg, self._server_opt_state)
+            )
+        else:
+            self.global_vars = avg
         row = {"round": self.round_idx}
         eval_now = self.data is not None and (
             self.round_idx % self.config.fed.frequency_of_the_test == 0
@@ -351,6 +375,7 @@ def run_federation(
     task: str = "classification",
     log_fn=None,
     trainer_factory=None,
+    server_opt: bool = False,
 ):
     """One-process federation over any transport: 1 server + K client actors
     in threads, each on ``comm_factory(rank)`` (a BaseCommManager) — the
@@ -368,6 +393,7 @@ def run_federation(
         task=task,
         worker_num=K,
         log_fn=log_fn,
+        server_opt=server_opt,
     )
     shared_train = jax.jit(
         make_local_train(model, config.train, config.fed.epochs, task=task)
@@ -423,6 +449,7 @@ def run_loopback_federation(
     model: ModelDef,
     task: str = "classification",
     log_fn=None,
+    server_opt: bool = False,
 ):
     """Federation over the in-process loopback hub (see run_federation)."""
     hub = LoopbackHub()
@@ -433,6 +460,7 @@ def run_loopback_federation(
         lambda rank: LoopbackCommManager(hub, rank),
         task=task,
         log_fn=log_fn,
+        server_opt=server_opt,
     )
 
 
@@ -443,6 +471,7 @@ def run_shm_federation(
     task: str = "classification",
     log_fn=None,
     sock_dir: Optional[str] = None,
+    server_opt: bool = False,
 ):
     """Federation over the shared-memory local transport (TRPC-equivalent,
     ref trpc_comm_manager.py:25-114): bulk tensors ride POSIX shared memory,
@@ -459,6 +488,7 @@ def run_shm_federation(
             lambda rank: ShmCommManager(rank, sock_dir or d),
             task=task,
             log_fn=log_fn,
+            server_opt=server_opt,
         )
 
 
@@ -470,6 +500,7 @@ def run_mqtt_federation(
     log_fn=None,
     host: str = None,
     port: int = 1883,
+    server_opt: bool = False,
 ):
     """Federation over MQTT pub/sub (ref mqtt_comm_manager.py:14-123):
     embedded in-process broker by default, real broker when host given."""
@@ -480,4 +511,7 @@ def run_mqtt_federation(
         factory = lambda rank: MqttCommManager(rank, broker=broker)
     else:
         factory = lambda rank: MqttCommManager(rank, host=host, port=port)
-    return run_federation(config, data, model, factory, task=task, log_fn=log_fn)
+    return run_federation(
+        config, data, model, factory, task=task, log_fn=log_fn,
+        server_opt=server_opt,
+    )

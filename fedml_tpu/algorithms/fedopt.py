@@ -46,6 +46,27 @@ def make_server_optimizer(sc: ServerConfig) -> optax.GradientTransformation:
     raise ValueError(f"unknown server_optimizer {sc.server_optimizer!r}")
 
 
+def make_server_step(opt: optax.GradientTransformation) -> Callable:
+    """``(old_vars, avg_vars, opt_state) -> (new_vars, new_state)`` — the
+    FedOpt server move, shared by the vmap/mesh APIs and the transport
+    server manager so the pseudo-gradient math lives once."""
+
+    def server_step(old_vars, avg_vars, opt_state):
+        # pseudo-grad = w_old − w_avg (FedOptAggregator.py:109-117)
+        pseudo_grad = jax.tree_util.tree_map(
+            lambda o, a: o - a, old_vars["params"], avg_vars["params"]
+        )
+        updates, new_state = opt.update(
+            pseudo_grad, opt_state, old_vars["params"]
+        )
+        new_params = optax.apply_updates(old_vars["params"], updates)
+        new_vars = dict(avg_vars)  # non-param collections: plain average
+        new_vars["params"] = new_params
+        return new_vars, new_state
+
+    return server_step
+
+
 class FedOptAPI(FedAvgAPI):
     _supports_fused = False  # per-round host-side work forbids chunk fusion
     """FedOpt simulator: FedAvgAPI with a server-optimizer step appended to
@@ -60,22 +81,7 @@ class FedOptAPI(FedAvgAPI):
         self._server_step = jax.jit(self._make_server_step())
 
     def _make_server_step(self):
-        opt = self.server_opt
-
-        def server_step(old_vars, avg_vars, opt_state):
-            # pseudo-grad = w_old − w_avg (FedOptAggregator.py:109-117)
-            pseudo_grad = jax.tree_util.tree_map(
-                lambda o, a: o - a, old_vars["params"], avg_vars["params"]
-            )
-            updates, new_state = opt.update(
-                pseudo_grad, opt_state, old_vars["params"]
-            )
-            new_params = optax.apply_updates(old_vars["params"], updates)
-            new_vars = dict(avg_vars)  # non-param collections: plain average
-            new_vars["params"] = new_params
-            return new_vars, new_state
-
-        return server_step
+        return make_server_step(self.server_opt)
 
     def train_round(self, round_idx: int):
         old_vars = self.global_vars

@@ -284,8 +284,10 @@ def run(**opt):
     if opt["runtime"] == "grpc":
         # true multi-process federation: this process is ONE participant
         # (ref main_fedavg_rpc.py per-process drivers + run_*.sh launchers)
-        if opt["algorithm"] != "fedavg":
-            raise click.UsageError("runtime=grpc currently supports algorithm=fedavg")
+        if opt["algorithm"] not in ("fedavg", "fedprox", "fedopt"):
+            raise click.UsageError(
+                "runtime=grpc supports fedavg/fedprox/fedopt"
+            )
         final = _run_grpc_process(config, data, model, task, log_fn, opt)
         logger.close()
         click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
@@ -423,9 +425,9 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         multi_krum_m=multi_krum_m,
     )
     if runtime in ("loopback", "mqtt", "shm"):
-        if algorithm != "fedavg":
+        if algorithm not in ("fedavg", "fedprox", "fedopt"):
             raise click.UsageError(
-                f"runtime={runtime} currently supports algorithm=fedavg"
+                f"runtime={runtime} supports fedavg/fedprox/fedopt"
             )
         from fedml_tpu.algorithms.fedavg_transport import (
             run_loopback_federation,
@@ -441,12 +443,19 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
 
         class _Runner:
             global_vars = None
+            server_opt_state = None
             start_round = 0
 
             def train(self):
-                server = runner_fn(config, data, model, task=task, log_fn=log_fn)
+                server = runner_fn(
+                    config, data, model, task=task, log_fn=log_fn,
+                    server_opt=algorithm == "fedopt",
+                )
                 _Runner.global_vars = server.global_vars
                 self.global_vars = server.global_vars
+                # expose the FedOpt moments so --checkpoint_path persists
+                # them (the vmap --resume path restores from this slot)
+                self.server_opt_state = server._server_opt_state
                 return server.history[-1] if server.history else {}
 
         return _Runner()
@@ -754,7 +763,7 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
     if rank == 0:
         server = FedAvgServerManager(
             config, comm, model, data=data, task=task, worker_num=K,
-            log_fn=log_fn,
+            log_fn=log_fn, server_opt=opt["algorithm"] == "fedopt",
         )
         server.send_init_msg()
         server.run()
