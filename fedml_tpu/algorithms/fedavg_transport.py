@@ -329,10 +329,28 @@ class FedAvgServerManager(ServerManager):
 class FedAvgClientManager(ClientManager):
     """ref FedAvgClientManager.py:17-65."""
 
-    def __init__(self, config: RunConfig, comm: BaseCommManager, rank: int, trainer: LocalTrainer):
+    def __init__(
+        self,
+        config: RunConfig,
+        comm: BaseCommManager,
+        rank: int,
+        trainer: LocalTrainer,
+        ef=None,
+    ):
         super().__init__(comm, rank)
         self.config = config
         self.trainer = trainer
+        # TopKErrorFeedback store. The residual must follow the CLIENT, and
+        # sampling re-assigns clients to ranks every round — so in-process
+        # runtimes SHARE one store across all client actors (run_federation
+        # passes it in); a per-process store (grpc) is only sound under
+        # rank-stable assignment, which the CLI enforces (full
+        # participation).
+        self._ef = ef
+        if ef is None and config.comm.error_feedback and config.comm.compression == "topk":
+            from fedml_tpu.core.compression import TopKErrorFeedback
+
+            self._ef = TopKErrorFeedback(config.comm.topk_frac)
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MT.S2C_INIT_CONFIG, self._on_sync)
@@ -351,12 +369,15 @@ class FedAvgClientManager(ClientManager):
             # round delta; the server reconstructs against the same w_round
             from fedml_tpu.core import compression as CZ
 
-            out.add_params(
-                MT.ARG_MODEL_DELTA,
-                CZ.encode_update(
+            if self._ef is not None:
+                payload = self._ef.encode(
+                    self.trainer.client_index, weights, w_round
+                )
+            else:
+                payload = CZ.encode_update(
                     weights, w_round, comp, self.config.comm.topk_frac
-                ),
-            )
+                )
+            out.add_params(MT.ARG_MODEL_DELTA, payload)
             out.add_params(MT.ARG_COMPRESSION, comp)
         else:
             out.add_params(MT.ARG_MODEL_PARAMS, weights)
@@ -403,8 +424,17 @@ def run_federation(
             config, data, model, task, local_train_fn=shared_train
         )
     )
+    # one shared error-feedback store: residuals are keyed by client id and
+    # the sampler re-assigns clients to ranks each round
+    shared_ef = None
+    if config.comm.error_feedback and config.comm.compression == "topk":
+        from fedml_tpu.core.compression import TopKErrorFeedback
+
+        shared_ef = TopKErrorFeedback(config.comm.topk_frac)
     clients = [
-        FedAvgClientManager(config, comm_factory(rank), rank, make_trainer(rank))
+        FedAvgClientManager(
+            config, comm_factory(rank), rank, make_trainer(rank), ef=shared_ef
+        )
         for rank in range(1, K + 1)
     ]
     errors: List[BaseException] = []

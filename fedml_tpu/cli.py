@@ -140,6 +140,9 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
                    "sparsification of the round delta")
 @click.option("--topk_frac", type=float, default=0.01,
               help="compression=topk: fraction of entries kept per tensor")
+@click.option("--error_feedback", is_flag=True, default=False,
+              help="compression=topk: per-client residual memory (EF-SGD) "
+                   "so dropped coordinates ship in later rounds")
 @click.option("--rank", type=int, default=None,
               help="runtime=grpc: this process's rank (0 = server, 1..K = "
                    "clients; ref main_fedavg_rpc.py --fl_worker_index)")
@@ -194,6 +197,7 @@ def build_config(opt) -> RunConfig:
         comm=CommConfig(
             compression=opt.get("compression", "none"),
             topk_frac=opt.get("topk_frac", 0.01),
+            error_feedback=opt.get("error_feedback", False),
         ),
         mesh=MeshConfig(client_shards=opt["client_shards"]),
         model=opt["model"],
@@ -226,6 +230,28 @@ def run(**opt):
             "--min_clients only takes effect after a --deadline_s deadline "
             "passes; without one the server still waits for every client"
         )
+    if config.comm.error_feedback:
+        if config.comm.compression != "topk":
+            raise click.UsageError(
+                "--error_feedback is a top-k residual memory; it requires "
+                "--compression topk"
+            )
+        if config.fed.deadline_s:
+            raise click.UsageError(
+                "--error_feedback assumes every upload is aggregated, but "
+                "--deadline_s quorum rounds can discard late uploads — the "
+                "shipped (and residual-cleared) coordinates would be lost"
+            )
+        if (
+            opt["runtime"] == "grpc"
+            and config.fed.client_num_per_round != config.fed.client_num_in_total
+        ):
+            raise click.UsageError(
+                "--error_feedback under runtime=grpc requires full "
+                "participation (client_num_per_round == client_num_in_total): "
+                "residuals live per process and cannot follow a client that "
+                "the sampler re-assigns to another rank"
+            )
     data = data_registry.load(config)
     task = data_registry.task_for_dataset(config.data.dataset)
     sample_shape = tuple(data.client_x[0].shape[1:])

@@ -117,6 +117,10 @@ class CommConfig:
     # sparsification at topk_frac density).
     compression: str = "none"
     topk_frac: float = 0.01
+    # topk only: per-client residual memory (error feedback) — dropped
+    # coordinates accumulate and ship in later rounds instead of being
+    # lost. Off by default (stateless-client parity with the reference).
+    error_feedback: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,11 +20,11 @@ binary Message envelope unchanged (core/message.py to_wire_parts).
 - ``topk``: keep the top ``frac`` fraction of entries by magnitude per
   leaf — payload (int32 indices, fp32 values); ≈1/(2·frac)× reduction.
 
-Both are one-shot (no cross-round error feedback): each round's delta is
-re-encoded fresh against that round's broadcast model, so errors do not
-accumulate in the client state. (Error feedback is a client-side memory
-the reference's stateless-client model has no slot for; the round-fresh
-delta keeps parity with its stateless trainer contract.)
+Encoding is one-shot by default (each round's delta re-encoded fresh, no
+client state — parity with the reference's stateless trainer contract).
+Opt-in cross-round error feedback for top-k lives in
+:class:`TopKErrorFeedback` (CommConfig.error_feedback): dropped
+coordinates accumulate in a per-client residual and ship later.
 """
 
 from __future__ import annotations
@@ -146,3 +146,33 @@ def payload_bytes(tree) -> int:
     """Wire payload size of a tree of numpy arrays (buffer bytes only)."""
     leaves, _ = _leaves(tree)
     return int(sum(a.nbytes for a in leaves))
+
+
+class TopKErrorFeedback:
+    """Per-client residual memory for top-k uploads (error-feedback /
+    EF-SGD, Stich et al. 2018): what sparsification drops this round is
+    remembered and added to the next round's delta, so every coordinate's
+    contribution eventually reaches the server instead of being lost —
+    the standard fix for high-sparsity top-k stalling.
+
+    Memory is keyed by CLIENT id (the data owner), not transport rank: the
+    server re-points ranks at different sampled clients each round
+    (ref FedAVGTrainer.update_dataset), and a residual must follow its
+    client. Opt-in via CommConfig.error_feedback — the default one-shot
+    encoding keeps the reference's stateless-client contract."""
+
+    def __init__(self, frac: float):
+        self.frac = frac
+        self._residual: Dict[int, object] = {}
+
+    def encode(self, client_id: int, w_local, w_round) -> Dict[str, np.ndarray]:
+        d = delta_tree(w_local, w_round)
+        r = self._residual.get(int(client_id))
+        if r is not None:
+            d = jax.tree_util.tree_map(lambda a, b: a + b, d, r)
+        payload = encode_topk(d, self.frac)
+        sent = decode_topk(payload, d)
+        self._residual[int(client_id)] = jax.tree_util.tree_map(
+            lambda a, b: a - b, d, sent
+        )
+        return payload
