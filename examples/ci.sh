@@ -27,6 +27,27 @@ for algo in fedavg fedopt fedprox fednova hierarchical fedavg_robust; do
   echo "  $algo ok"
 done
 
+echo "== CLI smoke: mesh runtime (8-shard virtual farm) =="
+for algo in fedavg fedopt fednova fedavg_robust; do
+  python -m fedml_tpu --algorithm "$algo" --runtime mesh --model lr \
+    --dataset synthetic --client_num_in_total 8 --client_num_per_round 8 \
+    --comm_round 1 --epochs 1 --ci > /dev/null
+  echo "  mesh/$algo ok"
+done
+python -m fedml_tpu --algorithm hierarchical --runtime mesh --group_num 2 \
+  --group_comm_round 2 --model lr --dataset synthetic \
+  --client_num_in_total 8 --client_num_per_round 8 --comm_round 1 --ci > /dev/null
+echo "  mesh/hierarchical ok"
+
+echo "== CLI smoke: transport runtimes + compression + server opt =="
+python -m fedml_tpu --algorithm fedopt --runtime loopback --model lr \
+  --dataset synthetic --client_num_in_total 4 --client_num_per_round 4 \
+  --comm_round 1 --ci > /dev/null
+python -m fedml_tpu --algorithm fedavg --runtime loopback --compression topk \
+  --topk_frac 0.25 --error_feedback --model lr --dataset synthetic \
+  --client_num_in_total 4 --client_num_per_round 4 --comm_round 1 --ci > /dev/null
+echo "  transport ok"
+
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 

@@ -346,11 +346,11 @@ class FedAvgClientManager(ClientManager):
         # passes it in); a per-process store (grpc) is only sound under
         # rank-stable assignment, which the CLI enforces (full
         # participation).
-        self._ef = ef
-        if ef is None and config.comm.error_feedback and config.comm.compression == "topk":
+        if ef is None:
             from fedml_tpu.core.compression import TopKErrorFeedback
 
-            self._ef = TopKErrorFeedback(config.comm.topk_frac)
+            ef = TopKErrorFeedback.maybe_from_config(config.comm)
+        self._ef = ef
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MT.S2C_INIT_CONFIG, self._on_sync)
@@ -426,11 +426,17 @@ def run_federation(
     )
     # one shared error-feedback store: residuals are keyed by client id and
     # the sampler re-assigns clients to ranks each round
-    shared_ef = None
-    if config.comm.error_feedback and config.comm.compression == "topk":
-        from fedml_tpu.core.compression import TopKErrorFeedback
+    from fedml_tpu.core.compression import TopKErrorFeedback
 
-        shared_ef = TopKErrorFeedback(config.comm.topk_frac)
+    shared_ef = TopKErrorFeedback.maybe_from_config(config.comm)
+    if shared_ef is not None and config.fed.deadline_s:
+        # depth guard (not just a CLI nicety): a quorum round can discard a
+        # late upload AFTER the client cleared its residual — that mass
+        # would be permanently lost
+        raise ValueError(
+            "error_feedback cannot be combined with deadline_s quorum "
+            "rounds: a dropped late upload loses residual-cleared mass"
+        )
     clients = [
         FedAvgClientManager(
             config, comm_factory(rank), rank, make_trainer(rank), ef=shared_ef

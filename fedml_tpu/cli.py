@@ -205,7 +205,34 @@ def build_config(opt) -> RunConfig:
     )
 
 
+def _apply_platform_env():
+    """Honor JAX_PLATFORMS for CLI runs. This container's sitecustomize
+    pins a TPU backend at interpreter startup, so the env var alone never
+    wins (the exact pitfall tests/conftest.py and the dryrun bootstrap
+    document) — re-apply it through jax.config BEFORE any backend touch so
+    `JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8`
+    gives CLI mesh runs the virtual device farm, as examples/ci.sh relies
+    on."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception as e:  # backend already initialized
+            import logging
+
+            logging.warning(
+                "JAX_PLATFORMS=%s could not be applied (%s) — the backend "
+                "was already initialized; the run continues on platform %s",
+                plat, e, jax.default_backend(),
+            )
+
+
 def run(**opt):
+    _apply_platform_env()
     from fedml_tpu.data import registry as data_registry
     from fedml_tpu.models import create_model
     from fedml_tpu.utils import MetricsLogger, save_checkpoint

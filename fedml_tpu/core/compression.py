@@ -165,6 +165,15 @@ class TopKErrorFeedback:
         self.frac = frac
         self._residual: Dict[int, object] = {}
 
+    @classmethod
+    def maybe_from_config(cls, comm) -> "TopKErrorFeedback | None":
+        """The ONE activation rule (CommConfig → instance or None), shared
+        by the in-process shared-store path and the per-process (grpc)
+        path so they can never diverge in when EF engages."""
+        if comm.error_feedback and comm.compression == "topk":
+            return cls(comm.topk_frac)
+        return None
+
     def encode(self, client_id: int, w_local, w_round) -> Dict[str, np.ndarray]:
         d = delta_tree(w_local, w_round)
         r = self._residual.get(int(client_id))
