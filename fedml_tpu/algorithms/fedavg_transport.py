@@ -143,6 +143,18 @@ class FedAvgServerManager(ServerManager):
         self.log_fn = log_fn or (lambda m: None)
         self.worker_num = worker_num or config.fed.client_num_per_round
         self.aggregator = FedAvgAggregator(self.worker_num)
+        # secure-agg mode: masked field vectors keyed by party (rank-1).
+        # Clients size the mask registry from client_num_per_round (the
+        # only value they have), so a worker_num override would give the
+        # two wire ends non-cancelling masks — reject it up front.
+        if config.comm.secure_agg and self.worker_num != config.fed.client_num_per_round:
+            raise ValueError(
+                f"secure_agg requires worker_num ({self.worker_num}) == "
+                f"client_num_per_round ({config.fed.client_num_per_round}): "
+                "clients derive the mask registry from the latter"
+            )
+        self._masked_uploads: Dict[int, np.ndarray] = {}
+        self._masked_ns: Dict[int, float] = {}
         # FedOpt over the transport (the reference's fedopt IS a
         # distributed MPI algorithm, FedOptAggregator.py:95-117): apply the
         # server optimizer to the pseudo-gradient after each aggregate.
@@ -215,13 +227,18 @@ class FedAvgServerManager(ServerManager):
     def _quorum(self) -> int:
         return max(1, min(self.config.fed.min_clients, self.worker_num))
 
+    def _received_count(self) -> int:
+        if self.config.comm.secure_agg:
+            return len(self._masked_uploads)
+        return self.aggregator.received_count()
+
     def _on_deadline(self, armed_round: int):
         try:
             with self._round_lock:
                 if armed_round != self.round_idx:
                     return  # stale timer: its round already completed
                 self._deadline_passed = True
-                if self.aggregator.received_count() >= self._quorum():
+                if self._received_count() >= self._quorum():
                     self._complete_round()
         except BaseException as e:  # noqa: BLE001
             # the timer thread would otherwise swallow this and leave the
@@ -248,6 +265,25 @@ class FedAvgServerManager(ServerManager):
                 self.dropped_uploads += 1
                 return
             worker = msg.get_sender_id() - 1
+            if self.config.comm.secure_agg:
+                # store the masked vector; unmasking happens once at round
+                # completion (dropout masks recovered there if a quorum
+                # round closed without some parties)
+                masked = msg.get(MT.ARG_MASKED_UPDATE)
+                if masked is None:
+                    raise ValueError(
+                        f"secure-agg server received an unmasked upload "
+                        f"from sender {msg.get_sender_id()} — was that "
+                        "client launched without --secure_agg?"
+                    )
+                self._masked_uploads[worker] = masked
+                self._masked_ns[worker] = float(msg.get(MT.ARG_NUM_SAMPLES))
+                if len(self._masked_uploads) == self.worker_num or (
+                    self._deadline_passed
+                    and len(self._masked_uploads) >= self._quorum()
+                ):
+                    self._complete_round()
+                return
             params = msg.get(MT.ARG_MODEL_PARAMS)
             if params is None:
                 # compressed uplink: reconstruct against this round's
@@ -280,7 +316,25 @@ class FedAvgServerManager(ServerManager):
         """Aggregate whatever has arrived, eval, resample, broadcast.
         Caller holds _round_lock."""
         self._disarm_deadline()
-        avg = self.aggregator.aggregate()
+        if self.config.comm.secure_agg:
+            from fedml_tpu.secagg.secure_aggregation import (
+                round_aggregator,
+                tree_dim,
+                unmask_round_average,
+            )
+
+            agg = round_aggregator(
+                self.worker_num,
+                tree_dim(self.global_vars),
+                self.config.seed,
+                self.round_idx,
+            )
+            avg = unmask_round_average(
+                agg, self._masked_uploads, self._masked_ns, self.global_vars
+            )
+            self._masked_uploads, self._masked_ns = {}, {}
+        else:
+            avg = self.aggregator.aggregate()
         if self._server_step is not None:
             if self._server_opt_state is None:
                 self._server_opt_state = self._server_optimizer.init(
@@ -364,7 +418,26 @@ class FedAvgClientManager(ClientManager):
         weights, n = self.trainer.train(round_idx, w_round)
         out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
         comp = self.config.comm.compression
-        if comp != "none":
+        if self.config.comm.secure_agg:
+            # masked upload (ref distributed turboaggregate): the server
+            # only ever sees the pairwise-masked field vector
+            from fedml_tpu.secagg.secure_aggregation import (
+                mask_round_update,
+                round_aggregator,
+                tree_dim,
+            )
+
+            agg = round_aggregator(
+                self.config.fed.client_num_per_round,
+                tree_dim(weights),
+                self.config.seed,
+                round_idx,
+            )
+            out.add_params(
+                MT.ARG_MASKED_UPDATE,
+                mask_round_update(agg, self.rank - 1, weights, w_round, n),
+            )
+        elif comp != "none":
             # uplink compression (core/compression.py): send the encoded
             # round delta; the server reconstructs against the same w_round
             from fedml_tpu.core import compression as CZ

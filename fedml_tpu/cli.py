@@ -143,6 +143,10 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--error_feedback", is_flag=True, default=False,
               help="compression=topk: per-client residual memory (EF-SGD) "
                    "so dropped coordinates ship in later rounds")
+@click.option("--secure_agg", is_flag=True, default=False,
+              help="Transport runtimes: pairwise-masked uploads — the "
+                   "server only ever sums masked field vectors (ref "
+                   "turboaggregate); quorum rounds recover dropout masks")
 @click.option("--rank", type=int, default=None,
               help="runtime=grpc: this process's rank (0 = server, 1..K = "
                    "clients; ref main_fedavg_rpc.py --fl_worker_index)")
@@ -198,6 +202,7 @@ def build_config(opt) -> RunConfig:
             compression=opt.get("compression", "none"),
             topk_frac=opt.get("topk_frac", 0.01),
             error_feedback=opt.get("error_feedback", False),
+            secure_agg=opt.get("secure_agg", False),
         ),
         mesh=MeshConfig(client_shards=opt["client_shards"]),
         model=opt["model"],
@@ -257,6 +262,17 @@ def run(**opt):
             "--min_clients only takes effect after a --deadline_s deadline "
             "passes; without one the server still waits for every client"
         )
+    if config.comm.secure_agg:
+        if opt["runtime"] in ("vmap", "mesh"):
+            raise click.UsageError(
+                "--secure_agg applies to the transport runtimes "
+                "(loopback/shm/grpc/mqtt)"
+            )
+        if config.comm.compression != "none":
+            raise click.UsageError(
+                "--secure_agg and --compression are mutually exclusive: "
+                "masked field vectors cannot be sparsified/quantized"
+            )
     if config.comm.error_feedback:
         if config.comm.compression != "topk":
             raise click.UsageError(

@@ -121,6 +121,14 @@ class CommConfig:
     # coordinates accumulate and ship in later rounds instead of being
     # lost. Off by default (stateless-client parity with the reference).
     error_feedback: bool = False
+    # Secure aggregation in the round loop (ref distributed turboaggregate):
+    # clients upload pairwise-masked field vectors of their weighted
+    # deltas; the server only ever sums masked uploads, and a quorum round
+    # (deadline_s) triggers dropout mask recovery. Protocol SIMULATION —
+    # the DH registry is derived deterministically from the run seed (see
+    # secagg/secure_aggregation.py SECURITY NOTE); mutually exclusive with
+    # compression.
+    secure_agg: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

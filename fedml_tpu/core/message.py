@@ -45,6 +45,9 @@ class MessageType:
     # --compression mismatch is handled instead of crashing the FSM)
     ARG_MODEL_DELTA = "model_delta"
     ARG_COMPRESSION = "compression"
+    # pairwise-masked field vector (secagg/secure_aggregation.py) — carried
+    # instead of ARG_MODEL_PARAMS when CommConfig.secure_agg is on
+    ARG_MASKED_UPDATE = "masked_update"
     ARG_CLIENT_INDEX = "client_index"
     ARG_NUM_SAMPLES = "num_samples"
     ARG_ROUND_IDX = "round_idx"

@@ -106,3 +106,93 @@ class SecureAggregator:
                 m = self.mask_of_pair(i, d)
                 total = np.mod(total - (m if i < d else -m), self.p)
         return decode_fixed(total, len(received), self.p)
+
+
+# ---- round-loop integration (transport FedAvg, CommConfig.secure_agg) ----
+# The reference's turboaggregate is a DISTRIBUTED algorithm (MPI workers,
+# TA_decentralized_worker.py); these helpers put the masked-sum protocol on
+# this framework's transport round: each sampled client is a party for ONE
+# round, uploads encode(n_i · Δ_i) masked pairwise, and the server
+# reconstructs only the weighted SUM. Party registries are re-derived per
+# round from (seed, round_idx) so pair masks are never reused across rounds
+# (mask reuse would leak update differences).
+
+
+def flatten_tree(tree):
+    """tree of arrays -> (flat float64 [D], shapes/treedef for unflatten).
+    (Hand-rolled rather than jax.flatten_util.ravel_pytree so unflatten
+    restores each leaf's ORIGINAL dtype after the float64 field math.)"""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    leaves = [np.asarray(l) for l in leaves]
+    flat = np.concatenate([l.reshape(-1).astype(np.float64) for l in leaves])
+    return flat, (treedef, [(l.shape, l.dtype) for l in leaves])
+
+
+def tree_dim(tree) -> int:
+    """Total flattened element count — the ONE definition both wire ends
+    use to size the per-round mask registry."""
+    import jax
+
+    return int(sum(np.asarray(l).size for l in jax.tree_util.tree_leaves(tree)))
+
+
+def unflatten_like(spec, flat: np.ndarray):
+    import jax
+
+    treedef, meta = spec
+    out, off = [], 0
+    for shape, dtype in meta:
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        out.append(flat[off : off + n].reshape(shape).astype(dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def round_aggregator(num_parties: int, dim: int, seed: int, round_idx: int) -> SecureAggregator:
+    """The per-round party registry every participant derives identically
+    from (seed, round_idx) — fresh pair keys per round."""
+    return SecureAggregator(
+        num_parties, dim, seed=seed * 1_000_003 + round_idx * 7919 + 17
+    )
+
+
+def mask_round_update(
+    agg: SecureAggregator, party: int, w_local, w_round, n_samples: float
+) -> np.ndarray:
+    """Client side: masked field vector of n_i · (w_i − w_round).
+
+    The fixed-point field has finite range: |value| must stay below
+    (p/2)/2^16/N ≈ 16383/N so even the SUM over N parties cannot wrap.
+    Exceeding it would silently corrupt the aggregate (mod-p wraparound),
+    so it raises instead — rescale (smaller lr, fewer samples per upload)
+    or use the plain path for such magnitudes."""
+    flat_local, _ = flatten_tree(w_local)
+    flat_round, _ = flatten_tree(w_round)
+    update = float(n_samples) * (flat_local - flat_round)
+    bound = (agg.p // 2) / _SCALE / max(agg.N, 1)
+    worst = float(np.max(np.abs(update))) if update.size else 0.0
+    if worst >= bound:
+        raise ValueError(
+            f"secure-agg update magnitude {worst:.1f} exceeds the fixed-"
+            f"point field bound {bound:.1f} (p=2^31, 2^16 fraction bits, "
+            f"{agg.N} parties) — the masked sum would wrap mod p"
+        )
+    return agg.client_upload(party, update, active=list(range(agg.N)))
+
+
+def unmask_round_average(
+    agg: SecureAggregator,
+    uploads,
+    ns,
+    w_round,
+):
+    """Server side: Σ_received n_i·Δ_i (masked sum, dropout masks
+    recovered) / Σ_received n_i, applied to w_round. ``uploads``/``ns`` are
+    {party: masked_vec}/{party: n}; parties absent from uploads are the
+    dropouts whose masks get unwound."""
+    decoded = agg.aggregate(uploads, intended=list(range(agg.N)))
+    total_n = float(sum(ns[i] for i in uploads))
+    flat_round, spec = flatten_tree(w_round)
+    return unflatten_like(spec, flat_round + decoded / max(total_n, 1e-9))
