@@ -46,6 +46,9 @@ python -m fedml_tpu --algorithm fedopt --runtime loopback --model lr \
 python -m fedml_tpu --algorithm fedavg --runtime loopback --compression topk \
   --topk_frac 0.25 --error_feedback --model lr --dataset synthetic \
   --client_num_in_total 4 --client_num_per_round 4 --comm_round 1 --ci > /dev/null
+python -m fedml_tpu --algorithm fedavg --runtime loopback --secure_agg \
+  --model lr --dataset synthetic --client_num_in_total 4 \
+  --client_num_per_round 4 --comm_round 1 --ci > /dev/null
 echo "  transport ok"
 
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
