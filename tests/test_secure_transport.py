@@ -113,6 +113,20 @@ def test_masked_upload_hides_update():
     assert np.mean(masked == raw) < 0.2
 
 
+def test_mask_round_update_rejects_field_overflow():
+    """Magnitudes that would wrap the fixed-point field raise at encode
+    instead of silently corrupting the aggregate."""
+    import pytest
+
+    w_round = {"w": np.zeros((4,), np.float32)}
+    w_local = {"w": np.full((4,), 10.0, np.float32)}
+    agg = round_aggregator(4, 4, seed=0, round_idx=0)
+    with pytest.raises(ValueError, match="field bound"):
+        mask_round_update(agg, 0, w_local, w_round, 10_000.0)
+    # in-range magnitudes pass
+    mask_round_update(agg, 0, w_local, w_round, 12.0)
+
+
 def test_secure_quorum_deadline_recovers_dropout():
     """End-to-end: a deadline quorum round with a straggler exercises the
     recovery path inside the server FSM (finite, reasonable model out)."""
