@@ -1,0 +1,179 @@
+"""Microbenchmarks locating the bf16 ResNet-56 round's device time.
+
+Each probe times ONE SGD training step (fwd+bwd+update) via the scan-slope
+method (K reps inside one jit; slope = device time), at the cross-silo
+shapes: per-client batch 64, 10 clients (where vmapped), 32x32x3 inputs.
+Prints TFLOP/s and MFU vs bf16 peak for each variant.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from fedml_tpu.utils import profiling
+from fedml_tpu.utils.flops import fn_flops
+from fedml_tpu.models.norms import fp32_batch_norm
+
+
+def slope_time(jfn, args, k1=1, k2=5, reps=3):
+    for k in (k1, k2):
+        jax.block_until_ready(jfn(*args, jnp.arange(k)))
+        float(np.asarray(jfn(*args, jnp.arange(k))[1]).sum())
+    def t(k):
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = jfn(*args, jnp.arange(k))
+            float(np.asarray(out[1]).sum())
+            best = min(best, time.perf_counter() - t0)
+        return best
+    return (t(k2) - t(k1)) / (k2 - k1)
+
+
+class ResNetVariant(nn.Module):
+    """CifarResNet body with a switchable norm: 'fp32bn' (the zoo's), 'bf16bn'
+    (flax BN, fp32 stats internally, bf16 in/out), 'none' (identity)."""
+    norm: str = "fp32bn"
+    layers: tuple = (6, 6, 6)
+    num_classes: int = 10
+
+    def _norm(self, train, name):
+        if self.norm == "fp32bn":
+            return fp32_batch_norm(train, name=name)
+        if self.norm == "bf16bn":
+            bn = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9,
+                dtype=jnp.bfloat16, name=name,
+            )
+            return bn
+        return lambda h: h
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
+        h = nn.relu(self._norm(train, "bn1")(h))
+        for si, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for bi in range(blocks):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                out_ch = planes * 4
+                identity = h
+                z = nn.Conv(planes, (1, 1), use_bias=False,
+                            name=f"s{si}b{bi}c1")(h)
+                z = nn.relu(self._norm(train, f"s{si}b{bi}n1")(z))
+                z = nn.Conv(planes, (3, 3), strides=(stride, stride),
+                            padding="SAME", use_bias=False,
+                            name=f"s{si}b{bi}c2")(z)
+                z = nn.relu(self._norm(train, f"s{si}b{bi}n2")(z))
+                z = nn.Conv(out_ch, (1, 1), use_bias=False,
+                            name=f"s{si}b{bi}c3")(z)
+                z = self._norm(train, f"s{si}b{bi}n3")(z)
+                if stride != 1 or h.shape[-1] != out_ch:
+                    identity = nn.Conv(out_ch, (1, 1),
+                                       strides=(stride, stride),
+                                       use_bias=False,
+                                       name=f"s{si}b{bi}cd")(h)
+                    identity = self._norm(train, f"s{si}b{bi}nd")(identity)
+                h = nn.relu(z + identity)
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="fc")(h)
+
+
+def make_step(model, variables, lr=0.1):
+    def loss_fn(params, extra, xb, yb):
+        out = model.apply(
+            {"params": params, **extra}, xb, train=True,
+            mutable=list(extra.keys()),
+        )
+        logits, new_vars = out
+        logits = logits.astype(jnp.float32)
+        loss = jnp.mean(
+            -jax.nn.log_softmax(logits)[jnp.arange(yb.shape[0]), yb]
+        )
+        return loss, new_vars
+
+    def step(params, extra, xb, yb):
+        (loss, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, extra, xb, yb
+        )
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, dict(new_vars), loss
+
+    return step
+
+
+def probe(name, norm, bf16_params, vmapped, B=64, C=10):
+    model = ResNetVariant(norm=norm)
+    rng = jax.random.PRNGKey(0)
+    x1 = jnp.zeros((B, 32, 32, 3), jnp.bfloat16 if bf16_params else jnp.float32)
+    variables = model.init(rng, x1, train=True)
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    if bf16_params:
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    step = make_step(model, variables)
+
+    if vmapped:
+        params = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (C,) + a.shape), params)
+        extra = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (C,) + a.shape), extra)
+        x = jnp.zeros((C, B, 32, 32, 3), x1.dtype)
+        y = jnp.zeros((C, B), jnp.int32)
+        inner = jax.vmap(step, in_axes=(0, 0, 0, 0))
+    else:
+        x = jnp.zeros((B * C, 32, 32, 3), x1.dtype)
+        y = jnp.zeros((B * C,), jnp.int32)
+        inner = step
+
+    def rep(params, extra, x, y, k_arr):
+        def body(carry, i):
+            p, e = carry
+            p2, e2, loss = inner(p, e, x, y)
+            return (p2, e2), loss
+        (p, e), losses = jax.lax.scan(body, (params, extra), k_arr)
+        return p, losses
+
+    jrep = jax.jit(rep)
+    sec = slope_time(jrep, (params, extra, x, y))
+    flops = fn_flops(inner, params, extra, x, y)
+    dt = "bfloat16" if bf16_params else "float32"
+    print(json.dumps({
+        "probe": name,
+        "device_ms_per_step": round(sec * 1e3, 2),
+        "analytic_gflops": round(flops / 1e9, 1),
+        "tflops_per_sec": round(flops / sec / 1e12, 2),
+        "mfu": round(profiling.mfu(flops, 1.0 / sec, dt) or 0, 4),
+    }))
+
+
+def probe_b64():
+    # flat single-client batch: is conv efficiency retained at B=64?
+    probe("flat_nonorm_bf16_B64", "none", True, False, B=64, C=1)
+    probe("flat_fp32bn_bf16_B64", "fp32bn", True, False, B=64, C=1)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    # merged batch 640, no clients axis — XLA's ceiling for these conv shapes
+    if which in ("all", "flat"):
+        probe("flat_nonorm_bf16", "none", True, False)
+        probe("flat_bf16bn_bf16", "bf16bn", True, False)
+        probe("flat_fp32bn_bf16", "fp32bn", True, False)
+    if which in ("all", "vmap"):
+        # per-client params: what the federated round actually runs
+        probe("vmap_nonorm_bf16", "none", True, True)
+        probe("vmap_fp32bn_bf16", "fp32bn", True, True)
+    if which == "b64":
+        probe_b64()
+    if which in ("all", "fp32"):
+        probe("flat_fp32bn_fp32", "fp32bn", False, False)

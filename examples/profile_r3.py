@@ -1,0 +1,197 @@
+"""Round-3 perf diagnosis: separate device compute from dispatch/host
+overhead per round, and cross-check XLA-costed FLOPs against the analytic
+jaxpr count (utils/flops.py).
+
+Method for device time (no trace parsing needed, tunnel-proof): jit ONE
+program that runs the round body K times as a lax.scan over the same
+device-resident batch; wall time of that program at K=K1 vs K=K2 gives
+    device_ms_per_round = (t(K2) - t(K1)) / (K2 - K1)
+— dispatch/transfer cost appears once per program and cancels in the slope.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgAPI,
+    client_axis_map,
+    client_sampling,
+    resolve_client_parallelism,
+    round_client_rngs,
+    weighted_average,
+)
+from fedml_tpu.train.client import make_local_train
+from fedml_tpu.utils import profiling
+from fedml_tpu.utils.flops import fn_flops
+
+
+def make_repeat_fn(model, config, task="classification"):
+    local_train = make_local_train(model, config.train, config.fed.epochs, task=task)
+    mode = resolve_client_parallelism(config.fed.client_parallelism, model)
+    lifted = client_axis_map(local_train, mode)
+
+    def round_body(gv, x, y, mask, ns, rngs):
+        cv, met = lifted(gv, x, y, mask, rngs)
+        return weighted_average(cv, ns), met
+
+    def rep(gv, x, y, mask, ns, rngs, k_arr):
+        def body(g, i):
+            g2, met = round_body(
+                g, x, y, mask, ns,
+                jax.vmap(lambda r: jax.random.fold_in(r, i))(rngs),
+            )
+            return g2, met["loss_sum"]
+        return jax.lax.scan(body, gv, k_arr)
+
+    return round_body, rep
+
+
+def timed(fn, *args, fetch):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    fetch(out)
+    return time.perf_counter() - t0, out
+
+
+def measure(api, name, k1=2, k2=8):
+    cfg = api.config
+    model, data = api.model, api.data
+    sampled = client_sampling(0, data.num_clients, cfg.fed.client_num_per_round)
+    batch = api._round_batch(sampled, 0)
+    rng = jax.random.fold_in(api.rng, 1)
+    placed = api._place_batch(batch, rng)
+    placed = tuple(jnp.asarray(p) for p in placed)
+    x, y, mask, ns, rngs = placed
+
+    round_body, rep = make_repeat_fn(model, cfg, api.task)
+    jrep = jax.jit(rep)
+
+    def fetch(out):
+        float(out[1][-1].sum())
+
+    # compile both K shapes
+    for k in (k1, k2):
+        jrep(api.global_vars, x, y, mask, ns, rngs, jnp.arange(k))
+    gv0 = api.global_vars
+    t_k1 = min(
+        timed(jrep, gv0, x, y, mask, ns, rngs, jnp.arange(k1), fetch=fetch)[0]
+        for _ in range(3)
+    )
+    t_k2 = min(
+        timed(jrep, gv0, x, y, mask, ns, rngs, jnp.arange(k2), fetch=fetch)[0]
+        for _ in range(3)
+    )
+    device_per_round = (t_k2 - t_k1) / (k2 - k1)
+
+    # eager wall/round: the bench's method (device-resident args, N calls,
+    # one host fetch at the end)
+    jround = jax.jit(round_body)
+    g, m = jround(gv0, x, y, mask, ns, rngs)
+    float(m["loss_sum"].sum())
+    t0 = time.perf_counter()
+    for _ in range(10):
+        g, m = jround(g, x, y, mask, ns, rngs)
+    float(m["loss_sum"].sum())
+    eager_wall = (time.perf_counter() - t0) / 10
+
+    # host-side per-round batch build cost (sampling + indices/stacking)
+    t0 = time.perf_counter()
+    for r in range(10):
+        s = client_sampling(r, data.num_clients, cfg.fed.client_num_per_round)
+        api._round_batch(s, r)
+    host_batch = (time.perf_counter() - t0) / 10
+
+    # FLOPs: XLA cost model vs analytic jaxpr count
+    xla_flops = api.round_flops(0)
+    analytic = fn_flops(round_body, gv0, x, y, mask, ns, rngs)
+
+    dt = cfg.train.compute_dtype
+    row = {
+        "workload": name,
+        "client_parallelism": resolve_client_parallelism(cfg.fed.client_parallelism, model),
+        "compute_dtype": dt,
+        "device_ms_per_round": round(device_per_round * 1e3, 2),
+        "eager_wall_ms_per_round": round(eager_wall * 1e3, 2),
+        "dispatch_overhead_ms": round((eager_wall - device_per_round) * 1e3, 2),
+        "host_batch_ms": round(host_batch * 1e3, 2),
+        "xla_flops_per_round": xla_flops,
+        "analytic_flops_per_round": analytic,
+        "xla_vs_analytic": round(xla_flops / analytic, 3) if xla_flops else None,
+        "mfu_device_analytic": round(
+            profiling.mfu(analytic, 1.0 / device_per_round, dt) or 0, 5
+        ),
+        "mfu_wall_analytic": round(
+            profiling.mfu(analytic, 1.0 / eager_wall, dt) or 0, 5
+        ),
+        "mfu_device_xla": (
+            round(profiling.mfu(xla_flops, 1.0 / device_per_round, dt) or 0, 5)
+            if xla_flops
+            else None
+        ),
+    }
+    print(json.dumps(row))
+    return row
+
+
+def resnet_api(dtype, mode="auto"):
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    data = synthetic_classification(
+        num_clients=10, num_classes=10, feat_shape=(32, 32, 3),
+        samples_per_client=512, partition_method="homo", ragged=False, seed=0,
+    )
+    model = create_model("resnet56", "cifar10", (32, 32, 3), 10)
+    cfg = RunConfig(
+        data=DataConfig(batch_size=64),
+        fed=FedConfig(
+            client_num_in_total=10, client_num_per_round=10, comm_round=1,
+            epochs=1, frequency_of_the_test=10_000, client_parallelism=mode,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1, compute_dtype=dtype),
+        model="resnet56",
+    )
+    return FedAvgAPI(cfg, data, model)
+
+
+def north_api(dtype, mode="auto"):
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.femnist_synth import femnist_synthetic
+    from fedml_tpu.models import create_model
+
+    cfg = RunConfig(
+        data=DataConfig(dataset="femnist", batch_size=20, pad_bucket=4),
+        fed=FedConfig(
+            client_num_in_total=128, client_num_per_round=10, comm_round=1,
+            epochs=1, frequency_of_the_test=10_000, client_parallelism=mode,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1, compute_dtype=dtype),
+        model="cnn", seed=0,
+    )
+    data = femnist_synthetic(num_clients=128, seed=0)
+    model = create_model("cnn", "femnist", (28, 28, 1), 62)
+    return FedAvgAPI(cfg, data, model)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "north"):
+        measure(north_api("float32"), "north_star_cnn")
+        measure(north_api("bfloat16"), "north_star_cnn")
+    if which in ("all", "resnet"):
+        measure(resnet_api("bfloat16"), "cross_silo_resnet56", k1=1, k2=4)
+        measure(resnet_api("float32"), "cross_silo_resnet56", k1=1, k2=4)
+    if which == "modes-north":
+        for mode in ("vmap", "scan"):
+            measure(north_api("bfloat16", mode), f"north_star_cnn_{mode}")
+    if which == "modes-resnet":
+        for mode in ("vmap", "scan"):
+            measure(resnet_api("bfloat16", mode), f"resnet56_{mode}", k1=1, k2=4)

@@ -118,6 +118,18 @@ class DecentralizedWorkerManager(ClientManager):
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_GOSSIP, self._on_gossip)
 
+    def run(self):
+        # Round 0 is initiated from THIS thread, before the receive loop
+        # starts: handlers also run on this thread, so every mutation of
+        # (round_idx, value, inbox) is single-threaded. Starting gossip
+        # from the launcher thread instead is a deadlock: a worker whose
+        # in-neighbors all delivered can advance to round 1 in its receive
+        # thread before the launcher sends its round-0 value, after which
+        # the launcher's send carries a round-1 tag and the round-0 value
+        # is never published — its neighbors wait on (0, rank) forever.
+        self.start_gossip()
+        super().run()
+
     def start_gossip(self):
         for j in self.topology.get_out_neighbor_idx_list(self.rank):
             m = Message(MSG_GOSSIP, self.rank, j)

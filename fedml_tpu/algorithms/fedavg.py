@@ -66,6 +66,57 @@ def round_client_rngs(round_rng, num_sampled: int):
     return jax.random.split(round_rng, num_sampled)
 
 
+def resolve_client_parallelism(mode: str, model: ModelDef) -> str:
+    """Resolve FedConfig.client_parallelism="auto" for a model.
+
+    "scan" wins when per-client weights make vmap's convs grouped convs
+    whose small channel dims tile the 128-lane MXU badly (measured on v5e,
+    examples/probe_resnet_bf16.py / examples/profile_r3.py: cross-silo
+    ResNet-56 bf16 round 350 -> 190 ms under scan; the flagship femnist
+    CNN is a wash, 34.0 -> 33.1 ms, because its dense head runs at the
+    same tiny per-client M either way). Models without under-tiled convs
+    or with sub-MB param copies keep "vmap": their per-step time is
+    overhead-dominated and one big program wins. The heuristic: any 4-D
+    conv kernel with <= 64 output channels (under-tiled on the MXU) and a
+    per-client param copy >= 1 MB."""
+    if mode == "auto":
+        shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        leaves = jax.tree_util.tree_leaves(shapes)
+        param_bytes = sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves
+        )
+        small_conv = any(
+            len(l.shape) == 4 and l.shape[-1] <= 64 and l.shape[0] <= 7
+            for l in leaves
+        )
+        mode = "scan" if (small_conv and param_bytes >= 1_000_000) else "vmap"
+    if mode not in ("vmap", "scan"):
+        raise ValueError(
+            f"client_parallelism must be 'vmap', 'scan' or 'auto', got {mode!r}"
+        )
+    return mode
+
+
+def client_axis_map(local_train: Callable, mode: str) -> Callable:
+    """Lift ``local_train`` over the leading client axis of (x, y, mask,
+    rngs) with global_vars broadcast — either batched (vmap) or sequential
+    (lax.scan). Both return identically stacked (client_vars, metrics);
+    the math is the same, only the schedule differs (see
+    resolve_client_parallelism)."""
+    if mode == "vmap":
+        return jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))
+
+    def scanned(global_vars, x, y, mask, rngs):
+        def body(_, per_client):
+            xc, yc, mc, rc = per_client
+            return None, local_train(global_vars, xc, yc, mc, rc)
+
+        _, out = jax.lax.scan(body, None, (x, y, mask, rngs))
+        return out
+
+    return scanned
+
+
 def make_fedavg_round(
     model: ModelDef,
     config: RunConfig,
@@ -88,11 +139,11 @@ def make_fedavg_round(
     local_train = local_train_fn or make_local_train(
         model, config.train, config.fed.epochs, task=task
     )
+    mode = resolve_client_parallelism(config.fed.client_parallelism, model)
+    lifted = client_axis_map(local_train, mode)
 
     def round_fn(global_vars, x, y, mask, num_samples, client_rngs, *extra):
-        client_vars, metrics = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0)
-        )(global_vars, x, y, mask, client_rngs)
+        client_vars, metrics = lifted(global_vars, x, y, mask, client_rngs)
         if post_train is not None:
             client_vars = post_train(client_vars, global_vars, *extra)
         # aggregate_fn replaces the weighted average outright (Byzantine-
@@ -139,6 +190,8 @@ def make_fedavg_multiround(
     local_train = local_train_fn or make_local_train(
         model, config.train, config.fed.epochs, task=task
     )
+    mode = resolve_client_parallelism(config.fed.client_parallelism, model)
+    lifted = client_axis_map(local_train, mode)
 
     def multi_fn(global_vars, flat_x, flat_y, idx, mask, num_samples, round_ids, base_rng):
         feat = flat_x.shape[1:]
@@ -154,9 +207,7 @@ def make_fedavg_multiround(
             m = mask_r.reshape((C, steps, bs))
             rng = jax.random.fold_in(base_rng, rid + 1)
             keys = round_client_rngs(rng, C)
-            client_vars, metrics = jax.vmap(
-                local_train, in_axes=(None, 0, 0, 0, 0)
-            )(gv, x, y, m, keys)
+            client_vars, metrics = lifted(gv, x, y, m, keys)
             new_global = weighted_average(client_vars, ns_r)
             return new_global, jax.tree_util.tree_map(jnp.sum, metrics)
 

@@ -30,11 +30,11 @@ def test_decentralized_gossip_converges_to_mean():
         )
         for r in range(N)
     ]
+    # run() publishes each worker's round-0 value from its own receive
+    # thread (single-threaded state mutation — see DecentralizedWorkerManager)
     threads = [threading.Thread(target=w.run, daemon=True) for w in workers]
     for t in threads:
         t.start()
-    for w in workers:
-        w.start_gossip()
     for t in threads:
         t.join(timeout=30)
         assert not t.is_alive()
