@@ -8,7 +8,6 @@ Prints TFLOP/s and MFU vs bf16 peak for each variant.
 
 from __future__ import annotations
 
-import functools
 import json
 import sys
 import time

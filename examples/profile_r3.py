@@ -24,7 +24,6 @@ from fedml_tpu.algorithms.fedavg import (
     client_axis_map,
     client_sampling,
     resolve_client_parallelism,
-    round_client_rngs,
     weighted_average,
 )
 from fedml_tpu.train.client import make_local_train
@@ -33,8 +32,10 @@ from fedml_tpu.utils.flops import fn_flops
 
 
 def make_repeat_fn(model, config, task="classification"):
-    local_train = make_local_train(model, config.train, config.fed.epochs, task=task)
     mode = resolve_client_parallelism(config.fed.client_parallelism, model)
+    # mirror make_fedavg_round exactly: scan mode skips padded steps
+    local_train = make_local_train(model, config.train, config.fed.epochs,
+                                   task=task, skip_empty_steps=(mode == "scan"))
     lifted = client_axis_map(local_train, mode)
 
     def round_body(gv, x, y, mask, ns, rngs):

@@ -155,6 +155,13 @@ class FedAvgServerManager(ServerManager):
             )
         self._masked_uploads: Dict[int, np.ndarray] = {}
         self._masked_ns: Dict[int, float] = {}
+        # client-held-key exchange state (secagg/secure_aggregation.py
+        # ClientParty/ServerAggregator): the server holds PUBLIC keys only
+        self._round_pks: Dict[int, int] = {}  # party -> pk, this round
+        self._recovery_pending = False
+        self._recovery_vecs: Dict[int, np.ndarray] = {}  # survivor party -> vec
+        self._recovery_requested_for = None  # dropped-set of the last request
+        self._registry_sent = False
         # FedOpt over the transport (the reference's fedopt IS a
         # distributed MPI algorithm, FedOptAggregator.py:95-117): apply the
         # server optimizer to the pseudo-gradient after each aggregate.
@@ -176,6 +183,7 @@ class FedAvgServerManager(ServerManager):
         self._deadline_timer: Optional[threading.Timer] = None
         self._deadline_passed = False
         self.dropped_uploads = 0  # late round-tagged uploads discarded
+        self._dead_workers: set = set()  # peers whose broadcasts failed
         self.deadline_error: Optional[BaseException] = None
         self.global_vars = jax.device_get(
             model.init(jax.random.fold_in(jax.random.PRNGKey(config.seed), 0))
@@ -184,6 +192,35 @@ class FedAvgServerManager(ServerManager):
         from fedml_tpu.train.evaluate import make_eval_fn
 
         self._eval_fn = make_eval_fn(model, task) if data is not None else None
+
+    def _broadcast(self, msg: Message) -> bool:
+        """Send a server->client message, tolerating a dead peer: a client
+        process that crashed mid-federation must not take the server FSM
+        down with it — the deadline/quorum machinery (FedConfig.deadline_s/
+        min_clients) absorbs the missing upload instead (VERDICT r2 Next
+        #7, chaos tolerance; the reference's aggregator barrier would hang
+        forever, FedAVGAggregator.py:43-49).
+
+        A worker whose send failed is remembered as dead and skipped (each
+        skipped round logs once) — without this, every round would re-pay
+        the transport's failure timeout inside the round lock. Any message
+        later RECEIVED from that worker clears the flag (elastic re-entry,
+        commit c8cb247's documented stance)."""
+        worker = msg.get_receiver_id()
+        if worker in self._dead_workers:
+            logging.info("skipping broadcast to dead worker %d", worker)
+            return False
+        try:
+            self.send_message(msg)
+            return True
+        except Exception as e:  # noqa: BLE001 — transport errors vary by backend
+            self._dead_workers.add(worker)
+            logging.warning(
+                "broadcast to worker %d failed (%s) — continuing on quorum",
+                worker,
+                e,
+            )
+            return False
 
     def send_init_msg(self):
         """Sample round-0 clients, broadcast w0 (ref send_init_msg :20-28)."""
@@ -195,13 +232,89 @@ class FedAvgServerManager(ServerManager):
             msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
             msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
             msg.add_params(MT.ARG_ROUND_IDX, 0)
-            self.send_message(msg)
+            self._broadcast(msg)
         self._arm_deadline()
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
             MT.C2S_SEND_MODEL, self._on_model_from_client
         )
+        self.register_message_receive_handler(MT.C2S_PUBKEY, self._on_pubkey)
+        self.register_message_receive_handler(
+            MT.C2S_RECOVERY, self._on_recovery
+        )
+
+    # -- secure-agg key exchange (round structure of Bonawitz et al.:
+    #    advertise keys -> masked input -> unmask; the server relays public
+    #    keys and never holds a party secret) --
+    def _send_registry(self):
+        """Broadcast the pk registry of the parties heard so far. Caller
+        holds _round_lock. Parties that never advertised a key are simply
+        not in the round's mask algebra (Bonawitz proceeds with surviving
+        parties), so a client dead before its pubkey cannot deadlock the
+        key phase."""
+        self._registry_sent = True
+        parties = sorted(self._round_pks)
+        for p in parties:
+            out = Message(MT.S2C_PUBKEYS, 0, p + 1)
+            out.add_params(MT.ARG_ROUND_IDX, self.round_idx)
+            out.add_params(
+                MT.ARG_PUBKEY_REGISTRY,
+                {
+                    "parties": parties,
+                    "pks": [self._round_pks[q] for q in parties],
+                },
+            )
+            self._broadcast(out)
+
+    def _on_pubkey(self, msg: Message):
+        self._dead_workers.discard(msg.get_sender_id())
+        with self._round_lock:
+            if msg.get(MT.ARG_ROUND_IDX, -1) != self.round_idx:
+                return
+            party = msg.get_sender_id() - 1
+            self._round_pks[party] = int(msg.get(MT.ARG_PUBKEY))
+            if not self._registry_sent and (
+                len(self._round_pks) == self.worker_num
+                or (
+                    self._deadline_passed
+                    and len(self._round_pks) >= self._quorum()
+                )
+            ):
+                self._send_registry()
+
+    def _on_recovery(self, msg: Message):
+        self._dead_workers.discard(msg.get_sender_id())
+        with self._round_lock:
+            if msg.get(MT.ARG_ROUND_IDX, -1) != self.round_idx:
+                return
+            party = msg.get_sender_id() - 1
+            self._recovery_vecs[party] = np.asarray(
+                msg.get(MT.ARG_RECOVERY_VEC), np.int64
+            )
+            if self._recovery_pending and set(self._recovery_vecs) >= set(
+                self._masked_uploads
+            ):
+                self._complete_round()
+
+    def _on_recovery_deadline(self, armed_round: int):
+        """A survivor that never answered its S2C_RECOVER (it died after
+        uploading) becomes a dropped party itself: discard its upload and
+        restart the recovery exchange with the remaining survivors. The
+        survivor set strictly shrinks each iteration, so this terminates."""
+        try:
+            with self._round_lock:
+                if armed_round != self.round_idx or not self._recovery_pending:
+                    return
+                silent = set(self._masked_uploads) - set(self._recovery_vecs)
+                for p in silent:
+                    self._masked_uploads.pop(p, None)
+                    self._masked_ns.pop(p, None)
+                self._recovery_requested_for = None  # force a re-request
+                self._complete_round()
+        except BaseException as e:  # noqa: BLE001 — see _on_deadline
+            self.deadline_error = e
+            self.finish()
 
     # -- straggler deadline (FedConfig.deadline_s) --
     def _arm_deadline(self):
@@ -238,6 +351,17 @@ class FedAvgServerManager(ServerManager):
                 if armed_round != self.round_idx:
                     return  # stale timer: its round already completed
                 self._deadline_passed = True
+                if (
+                    self.config.comm.secure_agg
+                    and not self._registry_sent
+                    and len(self._round_pks) >= self._quorum()
+                ):
+                    # key phase stalled on a client that died before its
+                    # pubkey: proceed with the parties heard so far (their
+                    # uploads can still reach quorum before this same
+                    # deadline flag completes the round)
+                    self._send_registry()
+                    return
                 if self._received_count() >= self._quorum():
                     self._complete_round()
         except BaseException as e:  # noqa: BLE001
@@ -249,6 +373,7 @@ class FedAvgServerManager(ServerManager):
             # upload arrives (_on_model_from_client checks the flag)
 
     def _on_model_from_client(self, msg: Message):
+        self._dead_workers.discard(msg.get_sender_id())
         with self._round_lock:
             # missing tag (pre-tag client version) fails SAFE: -1 never
             # matches, so an unattributable upload is dropped, not averaged
@@ -276,6 +401,12 @@ class FedAvgServerManager(ServerManager):
                         f"from sender {msg.get_sender_id()} — was that "
                         "client launched without --secure_agg?"
                     )
+                if self._recovery_pending:
+                    # a "dropped" party's upload racing the recovery
+                    # exchange: its masks are being unwound — including it
+                    # now would corrupt the sum
+                    self.dropped_uploads += 1
+                    return
                 self._masked_uploads[worker] = masked
                 self._masked_ns[worker] = float(msg.get(MT.ARG_NUM_SAMPLES))
                 if len(self._masked_uploads) == self.worker_num or (
@@ -318,21 +449,68 @@ class FedAvgServerManager(ServerManager):
         self._disarm_deadline()
         if self.config.comm.secure_agg:
             from fedml_tpu.secagg.secure_aggregation import (
-                round_aggregator,
+                ServerAggregator,
                 tree_dim,
-                unmask_round_average,
             )
 
-            agg = round_aggregator(
-                self.worker_num,
-                tree_dim(self.global_vars),
-                self.config.seed,
-                self.round_idx,
-            )
-            avg = unmask_round_average(
-                agg, self._masked_uploads, self._masked_ns, self.global_vars
-            )
+            dropped = sorted(set(self._round_pks) - set(self._masked_uploads))
+            if dropped and self._recovery_requested_for != set(dropped):
+                # Bonawitz unmask round: registry parties that never
+                # uploaded left uncancelled pair masks inside the
+                # survivors' uploads — ask each survivor for its recovery
+                # contribution; the round completes in _on_recovery. A
+                # survivor whose request cannot even be SENT is dead too:
+                # drop its upload and re-enter with the larger dropped set
+                # (strictly shrinking survivors ⇒ terminates). A recovery
+                # timer catches survivors that died without closing their
+                # socket (_on_recovery_deadline).
+                self._recovery_pending = True
+                self._recovery_requested_for = set(dropped)
+                self._recovery_vecs = {}
+                unreachable = []
+                for p in sorted(self._masked_uploads):
+                    out = Message(MT.S2C_RECOVER, 0, p + 1)
+                    out.add_params(MT.ARG_ROUND_IDX, self.round_idx)
+                    out.add_params(MT.ARG_DROPPED, list(map(int, dropped)))
+                    if not self._broadcast(out):
+                        unreachable.append(p)
+                if unreachable:
+                    for p in unreachable:
+                        self._masked_uploads.pop(p, None)
+                        self._masked_ns.pop(p, None)
+                    self._recovery_requested_for = None
+                    self._complete_round()
+                    return
+                if self._masked_uploads:
+                    t = threading.Timer(
+                        max(self.config.fed.deadline_s, 5.0),
+                        self._on_recovery_deadline,
+                        args=(self.round_idx,),
+                    )
+                    t.daemon = True
+                    t.start()
+                    return
+            if dropped and set(self._recovery_vecs) < set(self._masked_uploads):
+                return  # waiting on recovery vecs (timer bounds the wait)
+            srv = ServerAggregator(tree_dim(self.global_vars))
+            if self._masked_uploads:
+                total = srv.masked_sum(self._masked_uploads)
+                if dropped:
+                    total = srv.remove_dropout_masks(total, self._recovery_vecs)
+                ns = {p: self._masked_ns[p] for p in self._masked_uploads}
+                avg = srv.decode_average(total, ns, self.global_vars)
+            else:
+                # every party died mid-protocol: keep the current model
+                logging.warning(
+                    "secure-agg round %d lost every upload — model unchanged",
+                    self.round_idx,
+                )
+                avg = self.global_vars
             self._masked_uploads, self._masked_ns = {}, {}
+            self._round_pks, self._recovery_vecs = {}, {}
+            self._recovery_pending = False
+            self._recovery_requested_for = None
+            self._registry_sent = False
         else:
             avg = self.aggregator.aggregate()
         if self._server_step is not None:
@@ -365,7 +543,7 @@ class FedAvgServerManager(ServerManager):
         self.round_idx += 1
         if self.round_idx >= self.config.fed.comm_round:
             for worker in range(1, self.worker_num + 1):
-                self.send_message(Message(MT.FINISH, 0, worker))
+                self._broadcast(Message(MT.FINISH, 0, worker))
             self.finish()
             return
         sampled = client_sampling(
@@ -376,7 +554,7 @@ class FedAvgServerManager(ServerManager):
             msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
             msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
             msg.add_params(MT.ARG_ROUND_IDX, self.round_idx)
-            self.send_message(msg)
+            self._broadcast(msg)
         self._arm_deadline()
 
 
@@ -405,39 +583,75 @@ class FedAvgClientManager(ClientManager):
 
             ef = TopKErrorFeedback.maybe_from_config(config.comm)
         self._ef = ef
+        # secure-agg per-round state: the ClientParty holding THIS client's
+        # secret key (never serialized, never sent)
+        self._secagg_party = None
+        self._secagg_round = -1
+        self._secagg_pending = None
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MT.S2C_INIT_CONFIG, self._on_sync)
         self.register_message_receive_handler(MT.S2C_SYNC_MODEL, self._on_sync)
+        self.register_message_receive_handler(MT.S2C_PUBKEYS, self._on_pubkeys)
+        self.register_message_receive_handler(MT.S2C_RECOVER, self._on_recover)
         self.register_message_receive_handler(MT.FINISH, lambda m: self.finish())
+
+    # -- secure-agg client phases (client-held keys): train + advertise a
+    #    FRESH locally-generated DH public key, upload the masked update
+    #    once the server relays the round's registry, answer a recovery
+    #    request if some registry party dropped before uploading --
+    def _on_pubkeys(self, msg: Message):
+        if self._secagg_party is None or msg.get(MT.ARG_ROUND_IDX) != self._secagg_round:
+            return
+        reg = msg.get(MT.ARG_PUBKEY_REGISTRY)
+        pks = {
+            int(p): int(pk) for p, pk in zip(reg["parties"], reg["pks"])
+        }
+        self._secagg_party.set_registry(pks)
+        weights, w_round, n = self._secagg_pending
+        out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
+        out.add_params(
+            MT.ARG_MASKED_UPDATE,
+            self._secagg_party.masked_update(weights, w_round, n),
+        )
+        out.add_params(MT.ARG_NUM_SAMPLES, n)
+        out.add_params(MT.ARG_ROUND_IDX, self._secagg_round)
+        self.send_message(out)
+
+    def _on_recover(self, msg: Message):
+        if self._secagg_party is None or msg.get(MT.ARG_ROUND_IDX) != self._secagg_round:
+            return
+        vec = self._secagg_party.recovery_mask(msg.get(MT.ARG_DROPPED))
+        out = Message(MT.C2S_RECOVERY, self.rank, 0)
+        out.add_params(MT.ARG_ROUND_IDX, self._secagg_round)
+        out.add_params(MT.ARG_RECOVERY_VEC, vec)
+        self.send_message(out)
 
     def _on_sync(self, msg: Message):
         self.trainer.update_dataset(msg.get(MT.ARG_CLIENT_INDEX))
         round_idx = msg.get(MT.ARG_ROUND_IDX)
         w_round = msg.get(MT.ARG_MODEL_PARAMS)
         weights, n = self.trainer.train(round_idx, w_round)
-        out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
         comp = self.config.comm.compression
         if self.config.comm.secure_agg:
-            # masked upload (ref distributed turboaggregate): the server
-            # only ever sees the pairwise-masked field vector
+            # advertise a fresh per-round keypair; the masked upload waits
+            # for the registry (_on_pubkeys). The secret key lives only in
+            # this process's ClientParty.
             from fedml_tpu.secagg.secure_aggregation import (
-                mask_round_update,
-                round_aggregator,
+                ClientParty,
                 tree_dim,
             )
 
-            agg = round_aggregator(
-                self.config.fed.client_num_per_round,
-                tree_dim(weights),
-                self.config.seed,
-                round_idx,
-            )
-            out.add_params(
-                MT.ARG_MASKED_UPDATE,
-                mask_round_update(agg, self.rank - 1, weights, w_round, n),
-            )
-        elif comp != "none":
+            self._secagg_party = ClientParty(self.rank - 1, tree_dim(weights))
+            self._secagg_round = round_idx
+            self._secagg_pending = (weights, w_round, n)
+            adv = Message(MT.C2S_PUBKEY, self.rank, 0)
+            adv.add_params(MT.ARG_ROUND_IDX, round_idx)
+            adv.add_params(MT.ARG_PUBKEY, self._secagg_party.pk)
+            self.send_message(adv)
+            return
+        out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
+        if comp != "none":
             # uplink compression (core/compression.py): send the encoded
             # round delta; the server reconstructs against the same w_round
             from fedml_tpu.core import compression as CZ

@@ -113,12 +113,18 @@ class GrpcCommManager(BaseCommManager):
         # 10-minute stall there would freeze every live client too.
         receiver = msg.get_receiver_id()
         first = receiver not in self._handshaken
-        self._stub(receiver)(
-            msg.to_bytes(),
-            wait_for_ready=first,
-            timeout=120.0 if first else timeout,
-        )
-        self._handshaken.add(receiver)
+        try:
+            self._stub(receiver)(
+                msg.to_bytes(),
+                wait_for_ready=first,
+                timeout=120.0 if first else timeout,
+            )
+        finally:
+            # handshake is attempted-once, not succeeded-once: a peer that
+            # died before its server came up must fail FAST on later sends
+            # (retrying the 120 s wait_for_ready every round would stall
+            # the whole federation on one dead process)
+            self._handshaken.add(receiver)
 
     def handle_receive_message(self) -> None:
         while True:

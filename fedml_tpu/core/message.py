@@ -35,6 +35,15 @@ class MessageType:
     C2S_SEND_MODEL = "c2s_model"
     C2S_SEND_STATS = "c2s_stats"
     FINISH = "finish"
+    # secure-aggregation key exchange + dropout recovery (client-held keys,
+    # secagg/secure_aggregation.py ClientParty/ServerAggregator): clients
+    # advertise fresh per-round DH public keys, the server relays the
+    # registry, masked uploads follow; if a registry party drops before
+    # uploading, survivors return recovery masks
+    C2S_PUBKEY = "c2s_pubkey"
+    S2C_PUBKEYS = "s2c_pubkeys"
+    S2C_RECOVER = "s2c_recover"
+    C2S_RECOVERY = "c2s_recovery"
 
     # param keys
     ARG_MODEL_PARAMS = "model_params"
@@ -51,6 +60,10 @@ class MessageType:
     ARG_CLIENT_INDEX = "client_index"
     ARG_NUM_SAMPLES = "num_samples"
     ARG_ROUND_IDX = "round_idx"
+    ARG_PUBKEY = "pubkey"
+    ARG_PUBKEY_REGISTRY = "pubkey_registry"  # {party: pk}, public material
+    ARG_DROPPED = "dropped_parties"
+    ARG_RECOVERY_VEC = "recovery_vec"
 
 
 class Message:

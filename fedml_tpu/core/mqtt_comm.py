@@ -17,9 +17,10 @@ Two broker paths behind one manager:
   a __main__ block against a public internet broker
   (mqtt_comm_manager.py:131-150) — not runnable in CI; the embedded broker
   makes the backend testable hermetically.
-- **paho-mqtt client** to a real broker (host/port), import-gated: this
-  environment does not vendor paho, so the path raises a clear error if
-  paho is missing but keeps full wire compatibility when present.
+- **real broker over TCP** (host/port): paho-mqtt when installed;
+  otherwise the built-in MQTT 3.1.1 QoS-0 client (core/mqtt_broker.py,
+  which also ships a mini broker) — either way the wire is standard MQTT,
+  so the backend always has a socket-level path.
 """
 
 from __future__ import annotations
@@ -87,12 +88,20 @@ class MqttCommManager(BaseCommManager):
     def _connect_paho(self, host: str, port: int):
         try:
             import paho.mqtt.client as mqtt
-        except ImportError as e:
-            raise RuntimeError(
-                "paho-mqtt is not installed; use MqttCommManager(broker="
-                "EmbeddedBroker()) for in-process federation, or install "
-                "paho-mqtt for a real broker"
-            ) from e
+        except ImportError:
+            # paho isn't vendored in this image — fall back to the built-in
+            # MQTT 3.1.1 QoS-0 client (core/mqtt_broker.py), which speaks
+            # the same wire protocol over a real TCP socket
+            from fedml_tpu.core.mqtt_broker import MiniMqttClient
+
+            client = MiniMqttClient(
+                host,
+                port,
+                client_id=f"{self.prefix}_{self.rank}",
+                on_message=lambda topic, payload: self._q.put(payload),
+            )
+            client.subscribe(self._topic(self.rank), qos=0)
+            return client
 
         client = mqtt.Client(client_id=f"{self.prefix}_{self.rank}")
         client.on_message = lambda c, u, m: self._q.put(m.payload)
@@ -128,5 +137,8 @@ class MqttCommManager(BaseCommManager):
         if self._broker is not None:
             self._broker.unsubscribe(self._topic(self.rank), self._q)
         if self._client is not None:
-            self._client.loop_stop()
-            self._client.disconnect()
+            if hasattr(self._client, "loop_stop"):  # paho
+                self._client.loop_stop()
+                self._client.disconnect()
+            else:  # MiniMqttClient
+                self._client.close()

@@ -36,9 +36,13 @@ def fits_on_device(data: FederatedDataset) -> bool:
     cap = int(
         os.environ.get("FEDML_TPU_DEVICE_CACHE_MAX_BYTES", _DEFAULT_MAX_BYTES)
     )
-    total = sum(cx.nbytes for cx in data.client_x) + sum(
-        cy.nbytes for cy in data.client_y
-    )
+    # mmap-backed datasets report their size in O(1); summing nbytes over
+    # 100k lazy per-client views would walk the whole store
+    total = getattr(data, "total_train_bytes", None)
+    if total is None:
+        total = sum(cx.nbytes for cx in data.client_x) + sum(
+            cy.nbytes for cy in data.client_y
+        )
     return total <= cap
 
 

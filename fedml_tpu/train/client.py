@@ -144,6 +144,7 @@ def make_local_train(
     epochs: int,
     task: str = "classification",
     reshuffle_each_epoch: bool = True,
+    skip_empty_steps: bool = False,
 ):
     """Build the per-client training function.
 
@@ -199,31 +200,55 @@ def make_local_train(
             me = m_flat[perm].reshape(mask.shape)
 
             def step_body(carry, inp):
-                params, extra, opt_state = carry
                 xb, yb, mb, sidx = inp
-                step_rng = jax.random.fold_in(ep_rng, sidx)
-                (_, (new_extra, task_l, correct, total)), grads = grad_fn(
-                    params, extra, xb, yb, mb, step_rng
-                )
-                updates, new_opt_state = opt.update(grads, opt_state, params)
-                new_params = optax.apply_updates(params, updates)
                 # An all-padding step (mask sum 0) must be a complete no-op:
                 # masked-mean grads are already 0, but momentum/Adam state and
-                # the prox term would still move params — gate everything.
+                # the prox term would still move params — and the compute
+                # itself is pure padding waste.
                 has_data = jnp.sum(mb) > 0
+
+                def real_step(carry):
+                    params, extra, opt_state = carry
+                    step_rng = jax.random.fold_in(ep_rng, sidx)
+                    (_, (new_extra, task_l, correct, total)), grads = grad_fn(
+                        params, extra, xb, yb, mb, step_rng
+                    )
+                    updates, new_opt_state = opt.update(
+                        grads, opt_state, params
+                    )
+                    new_params = optax.apply_updates(params, updates)
+                    mets = jnp.stack(
+                        [task_l * total, correct, total, jnp.float32(1)]
+                    )
+                    return (new_params, new_extra, new_opt_state), mets
+
+                if skip_empty_steps:
+                    # Real skipped branch: the predicate is a scalar in the
+                    # sequential ("scan") client schedule, so lax.cond
+                    # genuinely skips the fwd/bwd — padded steps cost
+                    # ~nothing, which is what lets fused round chunks pad
+                    # every round to a shared step count for free.
+                    def skip_step(carry):
+                        return carry, jnp.zeros((4,), jnp.float32)
+
+                    return jax.lax.cond(has_data, real_step, skip_step, carry)
+
+                # Batched schedules (vmap clients, shard_map mesh): the
+                # predicate is per-client, a branch is impossible — compute
+                # and where-gate every carry leaf instead.
+                (new_params, new_extra, new_opt_state), mets = real_step(carry)
+                params, extra, opt_state = carry
 
                 def keep(new, old):
                     return jax.tree_util.tree_map(
                         lambda n, o: jnp.where(has_data, n, o), new, old
                     )
 
-                params = keep(new_params, params)
-                opt_state = keep(new_opt_state, opt_state)
-                extra = keep(new_extra, extra)
-                mets = jnp.stack(
-                    [task_l * total, correct, total, has_data.astype(jnp.float32)]
-                )
-                return (params, extra, opt_state), mets
+                return (
+                    keep(new_params, params),
+                    keep(new_extra, extra),
+                    keep(new_opt_state, opt_state),
+                ), mets * has_data.astype(jnp.float32)
 
             (params, extra, opt_state), mets = jax.lax.scan(
                 step_body,

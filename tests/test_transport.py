@@ -3,6 +3,8 @@
 echo. The reference has none of these (SURVEY §4: its comm 'tests' are
 __main__ benchmark blocks, mqtt_comm_manager.py:131-150)."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -97,17 +99,37 @@ def test_mqtt_embedded_broker_pubsub():
     assert q2.empty()
 
 
-def test_mqtt_paho_path_raises_without_paho():
+def test_mqtt_host_path_uses_builtin_client_without_paho():
+    """Without paho, MqttCommManager(host=...) falls back to the built-in
+    MQTT 3.1.1 client over a real TCP socket (core/mqtt_broker.py)."""
+    from fedml_tpu.core.mqtt_broker import MiniMqttBroker
     from fedml_tpu.core.mqtt_comm import MqttCommManager
+    from fedml_tpu.core.message import Message
 
+    broker = MiniMqttBroker()
     try:
-        import paho  # noqa: F401
+        a = MqttCommManager(1, host=broker.host, port=broker.port)
+        b = MqttCommManager(2, host=broker.host, port=broker.port)
+        import time
 
-        pytest.skip("paho installed; error path not applicable")
-    except ImportError:
-        pass
-    with pytest.raises(RuntimeError, match="paho-mqtt is not installed"):
-        MqttCommManager(0, host="localhost")
+        time.sleep(0.1)  # let SUBSCRIBEs land before publishing (QoS 0)
+        got = []
+        b.add_observer(type("O", (), {"receive_message": lambda self, t, m: got.append(m)})())
+        t = threading.Thread(target=b.handle_receive_message, daemon=True)
+        t.start()
+        m = Message("ping", 1, 2)
+        m.add_params("x", np.arange(5).astype(np.int32))
+        a.send_message(m)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got and got[0].get_type() == "ping"
+        np.testing.assert_array_equal(got[0].get("x"), np.arange(5))
+        b.stop_receive_message()
+        t.join(timeout=5)
+        a.stop_receive_message()
+    finally:
+        broker.close()
 
 
 def test_loopback_federation_matches_simulator():
@@ -179,3 +201,55 @@ def test_grpc_roundtrip():
     assert msg_type == "ping"
     np.testing.assert_array_equal(msg.get("payload"), np.arange(5, dtype=np.float32))
     a.stop_receive_message()
+
+
+def test_mqtt_socket_federation():
+    """Federation over REAL TCP MQTT (VERDICT r2 Next #6): mini broker +
+    built-in 3.1.1 client, full-participation LR run matches the vmap
+    simulator to float tolerance."""
+    import jax
+
+    from fedml_tpu.algorithms import FedAvgAPI
+    from fedml_tpu.algorithms.fedavg_transport import run_federation
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.core.mqtt_broker import MiniMqttBroker
+    from fedml_tpu.core.mqtt_comm import MqttCommManager
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_classification(
+        num_clients=4, num_classes=3, feat_shape=(5,), samples_per_client=12,
+        partition_method="homo", seed=3,
+    )
+    mk_model = lambda: ModelDef(
+        module=LogisticRegression(num_classes=3), input_shape=(5,),
+        num_classes=3, name="lr",
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=-1),
+        fed=FedConfig(
+            client_num_in_total=4, client_num_per_round=4, comm_round=3,
+            epochs=1, frequency_of_the_test=3,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+    broker = MiniMqttBroker()
+    try:
+        server = run_federation(
+            cfg, data, mk_model(),
+            comm_factory=lambda rank: MqttCommManager(
+                rank, host=broker.host, port=broker.port
+            ),
+        )
+    finally:
+        broker.close()
+    assert server.round_idx == 3
+    sim = FedAvgAPI(cfg, data, mk_model())
+    sim.train()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sim.global_vars),
+        jax.tree_util.tree_leaves(server.global_vars),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
