@@ -81,30 +81,10 @@ def _reset(api):
 def _device_row(api, round_idx: int = 0):
     """Device seconds per round (scan-slope) + analytic/XLA FLOPs for the
     round at ``round_idx``'s shapes."""
-    import jax
-    import jax.numpy as jnp
-
-    from fedml_tpu.algorithms.fedavg import (
-        client_sampling,
-        make_fedavg_round_body,
-    )
     from fedml_tpu.utils import profiling
     from fedml_tpu.utils.flops import fn_flops
 
-    cfg = api.config
-    sampled = client_sampling(
-        round_idx, api.data.num_clients, cfg.fed.client_num_per_round
-    )
-    batch = api._round_batch(sampled, round_idx)
-    rng = jax.random.fold_in(api.rng, round_idx + 1)
-    placed = tuple(jnp.asarray(p) for p in api._place_batch(batch, rng))
-    body = make_fedavg_round_body(
-        api.model, cfg, task=api.task, client_mode=api._client_mode
-    )
-
-    def step(gv):
-        return body(gv, *placed)[0]
-
+    step = _round_step_closure(api, round_idx)
     dev_s = profiling.scan_slope_seconds(step, api.global_vars, k1=1, k2=5)
     analytic = fn_flops(step, api.global_vars)
     xla = api.round_flops(round_idx)
@@ -138,8 +118,10 @@ def _window_mean_analytic_flops(api, warmup: int, timed: int, rep_flops):
     return sum(per_class[k] * n for k, n in classes.items()) / timed
 
 
-def _device_row_flops_only(api, round_idx: int):
-    """Analytic FLOPs of the round at ``round_idx``'s shapes (no timing)."""
+def _round_step_closure(api, round_idx: int):
+    """``gv -> gv'`` closure of one round at ``round_idx``'s shapes —
+    shared by device timing and analytic FLOPs counting so the two can
+    never diverge."""
     import jax
     import jax.numpy as jnp
 
@@ -147,7 +129,6 @@ def _device_row_flops_only(api, round_idx: int):
         client_sampling,
         make_fedavg_round_body,
     )
-    from fedml_tpu.utils.flops import fn_flops
 
     cfg = api.config
     sampled = client_sampling(
@@ -159,7 +140,14 @@ def _device_row_flops_only(api, round_idx: int):
     body = make_fedavg_round_body(
         api.model, cfg, task=api.task, client_mode=api._client_mode
     )
-    return fn_flops(lambda gv: body(gv, *placed)[0], api.global_vars)
+    return lambda gv: body(gv, *placed)[0]
+
+
+def _device_row_flops_only(api, round_idx: int):
+    """Analytic FLOPs of the round at ``round_idx``'s shapes (no timing)."""
+    from fedml_tpu.utils.flops import fn_flops
+
+    return fn_flops(_round_step_closure(api, round_idx), api.global_vars)
 
 
 def _throughput_row(api, warmup: int, timed: int, label: str):

@@ -272,6 +272,13 @@ class FedAvgServerManager(ServerManager):
         with self._round_lock:
             if msg.get(MT.ARG_ROUND_IDX, -1) != self.round_idx:
                 return
+            if self._registry_sent:
+                # the round's registry is sealed: a late advertiser was
+                # never part of the mask algebra, and recording it would
+                # later misclassify it as a dropped party (whose "masks"
+                # no survivor ever applied or could recover)
+                self.dropped_uploads += 1
+                return
             party = msg.get_sender_id() - 1
             self._round_pks[party] = int(msg.get(MT.ARG_PUBKEY))
             if not self._registry_sent and (
@@ -287,6 +294,14 @@ class FedAvgServerManager(ServerManager):
         self._dead_workers.discard(msg.get_sender_id())
         with self._round_lock:
             if msg.get(MT.ARG_ROUND_IDX, -1) != self.round_idx:
+                return
+            answered = set(map(int, msg.get(MT.ARG_DROPPED) or ()))
+            if self._recovery_requested_for is None or answered != set(
+                self._recovery_requested_for
+            ):
+                # stale response for an earlier, smaller dropped set —
+                # accepting it would bake uncancelled pair masks of the
+                # newly-dropped survivors into the aggregate
                 return
             party = msg.get_sender_id() - 1
             self._recovery_vecs[party] = np.asarray(
@@ -621,9 +636,11 @@ class FedAvgClientManager(ClientManager):
     def _on_recover(self, msg: Message):
         if self._secagg_party is None or msg.get(MT.ARG_ROUND_IDX) != self._secagg_round:
             return
-        vec = self._secagg_party.recovery_mask(msg.get(MT.ARG_DROPPED))
+        dropped = msg.get(MT.ARG_DROPPED)
+        vec = self._secagg_party.recovery_mask(dropped)
         out = Message(MT.C2S_RECOVERY, self.rank, 0)
         out.add_params(MT.ARG_ROUND_IDX, self._secagg_round)
+        out.add_params(MT.ARG_DROPPED, list(map(int, dropped)))
         out.add_params(MT.ARG_RECOVERY_VEC, vec)
         self.send_message(out)
 

@@ -128,6 +128,14 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--fused_rounds", type=int, default=1,
               help="Run up to N rounds as one on-device lax.scan chunk "
                    "(fedavg/fedprox + vmap runtime; needs the device cache)")
+@click.option("--client_parallelism", type=click.Choice(("auto", "vmap", "scan")),
+              default="auto",
+              help="How one chip runs the sampled clients: vmap (batched) "
+                   "or scan (sequential — faster for conv models whose "
+                   "small channels under-tile the MXU); auto picks per model")
+@click.option("--enable_wandb", is_flag=True, default=False,
+              help="Start a wandb run and mirror metric rows to it (ref "
+                   "main_fedavg.py:93-108); no-op if wandb is not installed")
 @click.option("--deadline_s", type=float, default=0.0,
               help="Transport runtimes: straggler deadline — after this many "
                    "seconds the server closes the round on a quorum instead "
@@ -183,6 +191,7 @@ def build_config(opt) -> RunConfig:
             eval_on_clients=opt.get("eval_on_clients", False),
             deadline_s=opt.get("deadline_s", 0.0),
             min_clients=opt.get("min_clients", 1),
+            client_parallelism=opt.get("client_parallelism", "auto"),
         ),
         train=TrainConfig(
             client_optimizer=opt["client_optimizer"],
@@ -330,7 +339,18 @@ def run(**opt):
             seed=config.seed,
         )
 
-    logger = MetricsLogger(str(opt["log_dir"]) if opt["log_dir"] else None)
+    if opt.get("enable_wandb"):
+        from fedml_tpu.utils.metrics import wandb_init
+
+        wandb_init(
+            name=f"{opt['algorithm']}-r{opt['comm_round']}"
+            f"-e{opt['epochs']}-lr{opt['lr']}",
+            config={k: str(v) for k, v in opt.items()},
+        )
+    logger = MetricsLogger(
+        str(opt["log_dir"]) if opt["log_dir"] else None,
+        use_wandb=opt.get("enable_wandb", False),
+    )
     api_cell = []
 
     def log_fn(row):

@@ -102,3 +102,102 @@ def load_imagenet(
         test_y=test_y,
         num_classes=len(classes),
     )
+
+
+def _scan_split_paths(split_dir: str, max_per_class: Optional[int]):
+    """Metadata-only scan: (file paths, labels, class names) — no decode."""
+    classes = sorted(
+        d for d in os.listdir(split_dir)
+        if os.path.isdir(os.path.join(split_dir, d))
+    )
+    paths: List[str] = []
+    ys: List[int] = []
+    for ci, cname in enumerate(classes):
+        cdir = os.path.join(split_dir, cname)
+        files = sorted(
+            f for f in os.listdir(cdir) if f.lower().endswith(_IMG_EXTS)
+        )[: max_per_class or None]
+        paths.extend(os.path.join(cdir, f) for f in files)
+        ys.extend([ci] * len(files))
+    return paths, np.asarray(ys, np.int32), classes
+
+
+def load_imagenet_streaming(
+    data_dir: str,
+    store_dir: str,
+    num_clients: int = 100,
+    image_size: int = 224,
+    partition_method: str = "homo",
+    partition_alpha: float = 0.5,
+    max_per_class: Optional[int] = None,
+    seed: int = 0,
+    chunk_rows: int = 2048,
+    test_cap: int = 8192,
+):
+    """ImageNet at real scale: decode ONCE into a disk-backed mmap store
+    (data/mmap_store.py), stream cohort rows per round thereafter.
+
+    Closes the r2 'partial': `load_imagenet` materialises every decoded
+    image in host RAM (224^2*3 fp32 = 600 KB/image — the real 1.28M-image
+    train set is ~770 GB decoded, far beyond RAM), where the reference
+    streams via torchvision ImageFolder (ImageNet/datasets.py). Here the
+    metadata scan partitions FILES across clients, then the streaming
+    writer decodes at most ``chunk_rows`` images at a time into
+    flat_x.npy; training reads only each round's sampled cohort from the
+    mmap. Idempotent per (store_dir): reuses an existing store."""
+    import json
+
+    from fedml_tpu.data.mmap_store import load_mmap_dataset, write_mmap_dataset
+
+    # every partition-shaping parameter is baked into the store name: a
+    # store built for different parameters must NOT be silently reused
+    name = (
+        f"imagenet_stream_c{num_clients}_s{image_size}_{partition_method}"
+        f"_a{partition_alpha}_m{max_per_class}_seed{seed}"
+    )
+    meta = os.path.join(store_dir, "meta.json")
+    if os.path.exists(meta):
+        with open(meta) as f:
+            existing = json.load(f).get("name")
+        if existing == name:
+            return load_mmap_dataset(store_dir)
+        raise ValueError(
+            f"store_dir {store_dir} holds a store built with different "
+            f"parameters ({existing!r} != {name!r}) — pass a fresh "
+            "store_dir or delete the old store"
+        )
+    paths, train_y, classes = _scan_split_paths(
+        os.path.join(data_dir, "train"), max_per_class
+    )
+    if partition_method == "homo":
+        idx_map = homo_partition(
+            len(train_y), num_clients, np.random.default_rng(seed)
+        )
+    else:
+        idx_map = lda_partition(
+            train_y, num_clients, partition_alpha, seed=seed
+        )
+    order = np.concatenate([idx_map[i] for i in range(num_clients)])
+    sizes = [len(idx_map[i]) for i in range(num_clients)]
+
+    def gen_chunk(start, n):
+        rows = order[start:start + n]
+        x = np.stack([_load_image(paths[i], image_size) for i in rows])
+        x = (x - IMAGENET_MEAN) / IMAGENET_STD
+        return x.astype(np.float32), train_y[rows]
+
+    val_dir = os.path.join(data_dir, "val")
+    if os.path.isdir(val_dir):
+        vp, vy, _ = _scan_split_paths(val_dir, max_per_class)
+        vp, vy = vp[:test_cap], vy[:test_cap]
+        tx = np.stack([_load_image(p, image_size) for p in vp])
+        tx = ((tx - IMAGENET_MEAN) / IMAGENET_STD).astype(np.float32)
+    else:  # no val split vendored: reuse a small slice of train
+        k = min(max(1, len(order) // 100), test_cap)
+        tx, vy = gen_chunk(0, k)
+    write_mmap_dataset(
+        store_dir, sizes, gen_chunk, (tx, np.asarray(vy, np.int32)),
+        num_classes=len(classes), name=name,
+        chunk_rows=chunk_rows,
+    )
+    return load_mmap_dataset(store_dir)

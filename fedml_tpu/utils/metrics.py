@@ -18,6 +18,23 @@ import time
 from typing import Dict, Optional
 
 
+def wandb_init(
+    project: str = "fedml_tpu",
+    name: Optional[str] = None,
+    config: Optional[dict] = None,
+):
+    """Optional wandb adapter (ref main_fedavg.py:93-108: rank-0 wandb.init
+    with run name {fl_algorithm}-r{comm_round}-e{epochs}-lr{lr}): starts a
+    run if wandb is importable, returns the run or None. Import-gated — the
+    framework never *requires* wandb; MetricsLogger's JSONL/summary.json
+    mirror is always written."""
+    try:
+        import wandb
+    except ImportError:
+        return None
+    return wandb.init(project=project, name=name, config=config or {})
+
+
 class MetricsLogger:
     def __init__(self, log_dir: Optional[str] = None, use_wandb: bool = False):
         self.log_dir = log_dir

@@ -135,3 +135,37 @@ def test_10k_clients_reduced_shape(tmp_path, num_clients):
     for r in range(2):
         _, m = api.train_round(r)
     assert np.isfinite(float(np.asarray(m["loss_sum"]).sum()))
+
+
+def test_imagenet_streaming_store(tmp_path):
+    """ImageNet streaming loader: metadata scan -> chunked decode into the
+    mmap store; round batches match the in-RAM loader's math."""
+    from fedml_tpu.data.imagenet import load_imagenet, load_imagenet_streaming
+
+    rng = np.random.default_rng(0)
+    root = tmp_path / "imgnet"
+    for split, n in (("train", 6), ("val", 2)):
+        for cname in ("n01440764", "n01443537"):
+            d = root / split / cname
+            d.mkdir(parents=True)
+            for i in range(n):
+                np.save(d / f"img_{i}.npy", rng.random((8, 8, 3)).astype(np.float32))
+    stream = load_imagenet_streaming(
+        str(root), str(tmp_path / "store"), num_clients=3, image_size=8,
+        chunk_rows=5, seed=0,
+    )
+    ram = load_imagenet(str(root), num_clients=3, image_size=8, seed=0)
+    assert stream.num_clients == 3
+    # identical partition (same seed/partitioner): shards must match
+    for i in range(3):
+        np.testing.assert_allclose(
+            np.asarray(stream.client_x[i]), ram.client_x[i], atol=1e-6
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stream.client_y[i]), ram.client_y[i]
+        )
+    # idempotent reload
+    again = load_imagenet_streaming(
+        str(root), str(tmp_path / "store"), num_clients=3, image_size=8,
+    )
+    assert again.total_train_samples() == stream.total_train_samples()
