@@ -217,43 +217,40 @@ def _north_star_api(compute_dtype="float32", comm_round=1, fused_rounds=1):
     return FedAvgAPI(config, data, model)
 
 
-def _north_star_fused(compute_dtype, total=64, chunk=16):
-    """The fused path through the PRODUCTION train() loop: class-aware
-    pow2 chunks, pad-free scan schedule, deferred metric flushes."""
-    api = _north_star_api(compute_dtype, comm_round=total, fused_rounds=chunk)
-    if api._store is None:
+def _trainloop_row(label, compute_dtype, fused_rounds, total=64, repeats=3):
+    """Production train() loop timing (incl. logging), best of ``repeats``
+    passes — single passes through the remote tunnel carry ±5% jitter,
+    which is larger than the eager-vs-fused difference being measured."""
+    api = _north_star_api(
+        compute_dtype, comm_round=total, fused_rounds=fused_rounds
+    )
+    if fused_rounds > 1 and api._store is None:
         return None
-    api.train()  # warm: compiles every chunk shape in the horizon
-    _reset(api)
-    t0 = time.perf_counter()
-    api.train()
-    sec_per_round = (time.perf_counter() - t0) / total
+    api.train()  # warm: compiles every chunk/class shape in the horizon
+    best = float("inf")
+    for _ in range(repeats):
+        _reset(api)
+        t0 = time.perf_counter()
+        api.train()
+        best = min(best, (time.perf_counter() - t0) / total)
     return {
-        "label": "north_star_fused",
+        "label": label,
         "compute_dtype": compute_dtype,
-        "rounds_per_sec": round(1.0 / sec_per_round, 4),
-        "round_ms_wall": round(sec_per_round * 1e3, 2),
-        "fused_rounds": chunk,
-        "timed_via": "production train() loop incl. logging",
+        "rounds_per_sec": round(1.0 / best, 4),
+        "round_ms_wall": round(best * 1e3, 2),
+        "fused_rounds": fused_rounds,
+        "timed_via": f"production train() loop incl. logging, best of {repeats}",
     }
+
+
+def _north_star_fused(compute_dtype, total=64, chunk=16):
+    return _trainloop_row("north_star_fused", compute_dtype, chunk, total)
 
 
 def _north_star_eager_trainloop(compute_dtype, total=64):
-    """Eager through the same production train() loop — the
-    apples-to-apples partner row for _north_star_fused."""
-    api = _north_star_api(compute_dtype, comm_round=total, fused_rounds=1)
-    api.train()
-    _reset(api)
-    t0 = time.perf_counter()
-    api.train()
-    sec_per_round = (time.perf_counter() - t0) / total
-    return {
-        "label": "north_star_eager_trainloop",
-        "compute_dtype": compute_dtype,
-        "rounds_per_sec": round(1.0 / sec_per_round, 4),
-        "round_ms_wall": round(sec_per_round * 1e3, 2),
-        "timed_via": "production train() loop incl. logging",
-    }
+    return _trainloop_row(
+        "north_star_eager_trainloop", compute_dtype, 1, total
+    )
 
 
 def _bf16_cross_silo():

@@ -174,5 +174,6 @@ if __name__ == "__main__":
         probe("vmap_fp32bn_bf16", "fp32bn", True, True)
     if which == "b64":
         probe_b64()
+
     if which in ("all", "fp32"):
         probe("flat_fp32bn_fp32", "fp32bn", False, False)

@@ -292,6 +292,7 @@ class FedAvgAPI:
         self.global_vars = model.init(jax.random.fold_in(self.rng, 0))
         self._local_train_fn = local_train_fn
         self._fused_fns: dict = {}  # (steps, bs) -> jitted multi-round fn
+        self._round_plans: dict = {}  # round_idx -> (sampled, steps, bs)
         self._client_mode = resolve_client_parallelism(
             config.fed.client_parallelism, model
         )
@@ -461,20 +462,34 @@ class FedAvgAPI:
             round_client_rngs(round_rng, batch.num_clients),
         )
 
+    def _round_plan(self, round_idx: int):
+        """(sampled, steps, bs) of one round, memoized: the chunk planner
+        walks rounds ahead of execution and train_rounds_fused then visits
+        the same rounds — recomputing the round-seeded sampling and the
+        bucket math twice per round was the fused path's last measurable
+        overhead vs eager."""
+        plan = self._round_plans.get(round_idx)
+        if plan is None:
+            from fedml_tpu.data.base import bucket_steps
+
+            cfg = self.config
+            sampled = client_sampling(
+                round_idx, self.data.num_clients, cfg.fed.client_num_per_round
+            )
+            steps, bs, _ = bucket_steps(
+                [int(self._store.counts[i]) for i in sampled],
+                cfg.data.batch_size,
+                cfg.data.pad_bucket,
+            )
+            plan = (sampled, steps, bs)
+            self._round_plans[round_idx] = plan
+        return plan
+
     def _round_steps_class(self, round_idx: int):
         """(steps, bs) bucket of one round's sampled cohort — the jit-shape
         class of that round."""
-        from fedml_tpu.data.base import bucket_steps
-
-        cfg = self.config
-        sampled = client_sampling(
-            round_idx, self.data.num_clients, cfg.fed.client_num_per_round
-        )
-        return bucket_steps(
-            [int(self._store.counts[i]) for i in sampled],
-            cfg.data.batch_size,
-            cfg.data.pad_bucket,
-        )[:2]
+        sampled, steps, bs = self._round_plan(round_idx)
+        return steps, bs
 
     def _fused_chunk_len(self, round_idx: int) -> int:
         """Rounds [round_idx, round_idx+L) that can run as one fused chunk:
@@ -526,8 +541,6 @@ class FedAvgAPI:
         """Run rounds [start_round, start_round+n_rounds) as one on-device
         scan (see :func:`make_fedavg_multiround`). Returns stacked per-round
         metrics {loss_sum, correct, count, steps: [T]}."""
-        from fedml_tpu.data.base import bucket_steps
-
         cfg = self.config
         store = self._store
         if cfg.data.batch_size == -1:
@@ -539,15 +552,8 @@ class FedAvgAPI:
         max_steps = bs = 0
         for off in range(n_rounds):
             r = start_round + off
-            sampled = client_sampling(
-                r, self.data.num_clients, cfg.fed.client_num_per_round
-            )
+            sampled, steps_r, bs = self._round_plan(r)
             per_round.append((r, sampled))
-            steps_r, bs, _ = bucket_steps(
-                [int(store.counts[i]) for i in sampled],
-                cfg.data.batch_size,
-                cfg.data.pad_bucket,
-            )
             if (
                 self._client_mode == "vmap"
                 and max_steps

@@ -281,12 +281,9 @@ class FedAvgServerManager(ServerManager):
                 return
             party = msg.get_sender_id() - 1
             self._round_pks[party] = int(msg.get(MT.ARG_PUBKEY))
-            if not self._registry_sent and (
-                len(self._round_pks) == self.worker_num
-                or (
-                    self._deadline_passed
-                    and len(self._round_pks) >= self._quorum()
-                )
+            if len(self._round_pks) == self.worker_num or (
+                self._deadline_passed
+                and len(self._round_pks) >= self._quorum()
             ):
                 self._send_registry()
 

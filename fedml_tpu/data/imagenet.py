@@ -153,7 +153,7 @@ def load_imagenet_streaming(
     # store built for different parameters must NOT be silently reused
     name = (
         f"imagenet_stream_c{num_clients}_s{image_size}_{partition_method}"
-        f"_a{partition_alpha}_m{max_per_class}_seed{seed}"
+        f"_a{partition_alpha}_m{max_per_class}_t{test_cap}_seed{seed}"
     )
     meta = os.path.join(store_dir, "meta.json")
     if os.path.exists(meta):
@@ -169,6 +169,20 @@ def load_imagenet_streaming(
     paths, train_y, classes = _scan_split_paths(
         os.path.join(data_dir, "train"), max_per_class
     )
+    val_dir = os.path.join(data_dir, "val")
+    holdout_paths, holdout_y = None, None
+    if not os.path.isdir(val_dir):
+        # no val split vendored: HOLD OUT a train slice (removed from the
+        # client partition — same discipline as load_imagenet; evaluating
+        # on trained-on rows would inflate Test/Acc)
+        k = min(max(1, len(train_y) // 10), test_cap)
+        rng_h = np.random.default_rng(seed + 1)
+        hold = rng_h.choice(len(train_y), k, replace=False)
+        keep = np.setdiff1d(np.arange(len(train_y)), hold)
+        holdout_paths = [paths[i] for i in hold]
+        holdout_y = train_y[hold]
+        paths = [paths[i] for i in keep]
+        train_y = train_y[keep]
     if partition_method == "homo":
         idx_map = homo_partition(
             len(train_y), num_clients, np.random.default_rng(seed)
@@ -186,15 +200,20 @@ def load_imagenet_streaming(
         x = (x - IMAGENET_MEAN) / IMAGENET_STD
         return x.astype(np.float32), train_y[rows]
 
-    val_dir = os.path.join(data_dir, "val")
-    if os.path.isdir(val_dir):
+    if holdout_paths is None:
         vp, vy, _ = _scan_split_paths(val_dir, max_per_class)
-        vp, vy = vp[:test_cap], vy[:test_cap]
-        tx = np.stack([_load_image(p, image_size) for p in vp])
-        tx = ((tx - IMAGENET_MEAN) / IMAGENET_STD).astype(np.float32)
-    else:  # no val split vendored: reuse a small slice of train
-        k = min(max(1, len(order) // 100), test_cap)
-        tx, vy = gen_chunk(0, k)
+        if len(vp) > test_cap:
+            # val lists are class-sorted: a front-truncation would keep
+            # only the first classes — subsample uniformly instead
+            pick = np.random.default_rng(seed + 2).choice(
+                len(vp), test_cap, replace=False
+            )
+            vp = [vp[i] for i in pick]
+            vy = np.asarray(vy)[pick]
+    else:
+        vp, vy = holdout_paths, holdout_y
+    tx = np.stack([_load_image(p, image_size) for p in vp])
+    tx = ((tx - IMAGENET_MEAN) / IMAGENET_STD).astype(np.float32)
     write_mmap_dataset(
         store_dir, sizes, gen_chunk, (tx, np.asarray(vy, np.int32)),
         num_classes=len(classes), name=name,

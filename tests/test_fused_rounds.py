@@ -97,3 +97,37 @@ def test_chunks_respect_eval_rounds():
             np.testing.assert_allclose(
                 re["Test/Loss"], rf["Test/Loss"], atol=1e-5
             )
+
+
+def test_fused_vmap_mode_cuts_chunks_at_class_changes():
+    """Under client_parallelism='vmap', padded steps execute real compute,
+    so fused chunks must never span a steps-class change (the round-2
+    regression); the chunked run still matches eager exactly."""
+    import dataclasses
+
+    data, model = _data(True), _model()
+    cfg = _cfg(4)
+    cfg = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, client_parallelism="vmap")
+    )
+    eager_cfg = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, fused_rounds=1)
+    )
+    eager = FedAvgAPI(eager_cfg, data, model)
+    eager.train()
+    fused = FedAvgAPI(cfg, data, model)
+    # every planned chunk stays within one steps class
+    r = 0
+    while r < cfg.fed.comm_round:
+        L = fused._fused_chunk_len(r)
+        classes = {fused._round_steps_class(r + off) for off in range(L)}
+        assert len(classes) == 1, (r, L, classes)
+        r += L
+    fused.train()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(eager.global_vars),
+        jax.tree_util.tree_leaves(fused.global_vars),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
+        )
