@@ -232,24 +232,47 @@ def make_fedavg_multiround(
     def multi_fn(global_vars, flat_x, flat_y, idx, mask, num_samples, round_ids, base_rng):
         feat = flat_x.shape[1:]
         lab = flat_y.shape[1:]
-        C = idx.shape[1]
+        T, C = idx.shape[0], idx.shape[1]
 
-        def body(gv, per_round):
-            idx_r, mask_r, ns_r, rid = per_round
+        def gathered(idx_r, mask_r):
             # shared gather-and-zero-padding contract with the eager path
             x, y = _gather(flat_x, flat_y, idx_r, mask_r)
-            x = x.reshape((C, steps, bs) + feat)
-            y = y.reshape((C, steps, bs) + lab)
-            m = mask_r.reshape((C, steps, bs))
+            return (
+                x.reshape((C, steps, bs) + feat),
+                y.reshape((C, steps, bs) + lab),
+                mask_r.reshape((C, steps, bs)),
+            )
+
+        # Double-buffered: each iteration trains on the PRE-GATHERED batch
+        # in the carry while gathering the next round's — the gather has no
+        # data dependency on this round's result, so XLA is free to overlap
+        # it with the round's compute (the eager loop gets the same overlap
+        # from async dispatch; without this the fused scan serializes
+        # prepare-then-train every round).
+        def body(carry, per_round):
+            gv, cur = carry
+            idx_n, mask_n, ns_r, rid = per_round
+            x, y, m = cur
             rng = jax.random.fold_in(base_rng, rid + 1)
             keys = round_client_rngs(rng, C)
             client_vars, metrics = lifted(gv, x, y, m, keys)
             new_global = weighted_average(client_vars, ns_r)
-            return new_global, jax.tree_util.tree_map(jnp.sum, metrics)
+            nxt = gathered(idx_n, mask_n)
+            return (new_global, nxt), jax.tree_util.tree_map(
+                jnp.sum, metrics
+            )
 
-        return jax.lax.scan(
-            body, global_vars, (idx, mask, num_samples, round_ids)
+        first = gathered(idx[0], mask[0])
+        # iteration t consumes batch t (carry) and prefetches batch t+1;
+        # the last iteration's prefetch wraps to batch 0 (discarded)
+        idx_next = jnp.roll(idx, -1, axis=0)
+        mask_next = jnp.roll(mask, -1, axis=0)
+        (gv, _), mets = jax.lax.scan(
+            body,
+            (global_vars, first),
+            (idx_next, mask_next, num_samples, round_ids),
         )
+        return gv, mets
 
     return jax.jit(multi_fn, donate_argnums=(0,))
 
