@@ -583,7 +583,7 @@ def main():
                     if fused_loop
                     else None
                 ),
-                "fused_note": (
+                "fused_note": None if not fused_loop else (
                     "statistical tie (+-0.5% across interleaved draws; "
                     "tunnel jitter bounds resolution): both paths are "
                     "device-compute-bound at identical shapes after the "

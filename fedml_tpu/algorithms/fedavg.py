@@ -232,7 +232,7 @@ def make_fedavg_multiround(
     def multi_fn(global_vars, flat_x, flat_y, idx, mask, num_samples, round_ids, base_rng):
         feat = flat_x.shape[1:]
         lab = flat_y.shape[1:]
-        T, C = idx.shape[0], idx.shape[1]
+        C = idx.shape[1]
 
         def gathered(idx_r, mask_r):
             # shared gather-and-zero-padding contract with the eager path
