@@ -25,7 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI, round_client_rngs
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgAPI,
+    client_axis_map,
+    resolve_client_parallelism,
+    round_client_rngs,
+)
 from fedml_tpu.algorithms.fednova import FedNovaAPI
 from fedml_tpu.algorithms.fedopt import FedOptAPI
 from fedml_tpu.config import RunConfig
@@ -66,9 +71,18 @@ def make_sharded_fedavg_round(
     ICI and hands the aggregate_fn the same stacked view the vmap runtime
     gives it — equality by construction."""
     axis = mesh.axis_names[0]
+    # The client schedule matters on the mesh too: each shard runs its
+    # C/n_shards clients, and under vmap their per-client weights turn the
+    # convs into grouped convs (the single-chip 1.8x ResNet finding,
+    # docs/PERF_R3.md §2). "scan" runs the shard's clients sequentially
+    # with full MXU tiling. skip_empty_steps stays off here: lax.cond
+    # branch types under shard_map's varying-axes rules don't admit the
+    # constant-zero skip branch (padded steps remain where-gated no-ops).
+    mode = resolve_client_parallelism(config.fed.client_parallelism, model)
     local_train = local_train_fn or make_local_train(
         model, config.train, config.fed.epochs, task=task
     )
+    lifted = client_axis_map(local_train, mode)
 
     def shard_body(global_vars, x, y, mask, num_samples, client_rngs, *extra):
         # Params enter replicated (spec P()); mark them device-varying so the
@@ -77,9 +91,7 @@ def make_sharded_fedavg_round(
         global_vars = jax.tree_util.tree_map(
             lambda a: jax.lax.pcast(a, (axis,), to="varying"), global_vars
         )
-        client_vars, metrics = jax.vmap(
-            local_train, in_axes=(None, 0, 0, 0, 0)
-        )(global_vars, x, y, mask, client_rngs)
+        client_vars, metrics = lifted(global_vars, x, y, mask, client_rngs)
         if post_train is not None:
             client_vars = post_train(client_vars, global_vars, *extra)
         if aggregate_fn is not None:
