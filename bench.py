@@ -138,7 +138,8 @@ def _round_step_closure(api, round_idx: int):
     rng = jax.random.fold_in(api.rng, round_idx + 1)
     placed = tuple(jnp.asarray(p) for p in api._place_batch(batch, rng))
     body = make_fedavg_round_body(
-        api.model, cfg, task=api.task, client_mode=api._client_mode
+        api.model, cfg, task=api.task, client_mode=api._client_mode,
+        may_pad=api._cohort_may_pad(sampled),
     )
     return lambda gv: body(gv, *placed)[0]
 

@@ -24,6 +24,7 @@ from fedml_tpu.algorithms.fedavg import (
     client_axis_map,
     client_sampling,
     resolve_client_parallelism,
+    resolve_skip_empty_steps,
     weighted_average,
 )
 from fedml_tpu.train.client import make_local_train
@@ -31,11 +32,15 @@ from fedml_tpu.utils import profiling
 from fedml_tpu.utils.flops import fn_flops
 
 
-def make_repeat_fn(model, config, task="classification"):
+def make_repeat_fn(model, config, task="classification", may_pad=None):
     mode = resolve_client_parallelism(config.fed.client_parallelism, model)
-    # mirror make_fedavg_round exactly: scan mode skips padded steps
-    local_train = make_local_train(model, config.train, config.fed.epochs,
-                                   task=task, skip_empty_steps=(mode == "scan"))
+    # mirror make_fedavg_round exactly: the cond-skip is emitted per the
+    # same static cohort decision production uses (pass the cohort's
+    # _cohort_may_pad result, else the safe default)
+    local_train = make_local_train(
+        model, config.train, config.fed.epochs, task=task,
+        skip_empty_steps=resolve_skip_empty_steps(mode, may_pad),
+    )
     lifted = client_axis_map(local_train, mode)
 
     def round_body(gv, x, y, mask, ns, rngs):
@@ -71,7 +76,9 @@ def measure(api, name, k1=2, k2=8):
     placed = tuple(jnp.asarray(p) for p in placed)
     x, y, mask, ns, rngs = placed
 
-    round_body, rep = make_repeat_fn(model, cfg, api.task)
+    round_body, rep = make_repeat_fn(
+        model, cfg, api.task, may_pad=api._cohort_may_pad(sampled)
+    )
     jrep = jax.jit(rep)
 
     def fetch(out):

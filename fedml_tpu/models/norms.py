@@ -18,12 +18,86 @@ checkpoints and the torch pretrained importer are unaffected.
 
 from __future__ import annotations
 
+import os
+
 import flax.linen as nn
 import jax.numpy as jnp
 
 
-def fp32_batch_norm(train: bool, momentum: float = 0.9, name: str | None = None):
-    """Returns ``apply(x)``: BatchNorm in fp32, output cast back to x.dtype."""
+class BatchNorm(nn.Module):
+    """BatchNorm with the memory-lean custom-VJP training path
+    (ops/fused_batchnorm.py) and an optional folded ReLU.
+
+    Variable structure is IDENTICAL to ``nn.BatchNorm`` (params
+    ``scale``/``bias``, batch_stats ``mean``/``var``, all fp32), so
+    checkpoints, the torch pretrained importer, and federated averaging
+    of BN stats are unaffected by which implementation runs. The class is
+    deliberately NAMED ``BatchNorm``: flax auto-names unnamed modules
+    from the class name, so call sites that pass no ``name`` (e.g. the
+    DARTS ops) produce the same ``BatchNorm_N`` keys either way — naming
+    it anything else would silently fork the param tree between the fused
+    and plain paths."""
+
+    use_running_average: bool
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    relu: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from fedml_tpu.ops.fused_batchnorm import bn_act, bn_inference
+
+        feat = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (feat,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feat,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((feat,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((feat,), jnp.float32)
+        )
+        if self.use_running_average or self.is_initializing():
+            return bn_inference(
+                x, ra_mean.value, ra_var.value, scale, bias,
+                self.epsilon, self.relu,
+            )
+        y, mean, var = bn_act(x, scale, bias, self.epsilon, self.relu)
+        m = self.momentum
+        ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+        ra_var.value = m * ra_var.value + (1.0 - m) * var
+        return y
+
+
+# import-site alias: distinguishes this module's BatchNorm from flax's at
+# call sites that want to be explicit about which implementation they get
+FusedBatchNorm = BatchNorm
+
+
+def _fused_bn_enabled() -> bool:
+    """The fused path is pure JAX (CPU-safe, vmap-safe) — on by default;
+    FEDML_TPU_FUSED_BN=0 falls back to plain nn.BatchNorm for A/B and
+    triage."""
+    return os.environ.get("FEDML_TPU_FUSED_BN", "1") != "0"
+
+
+def fp32_batch_norm(
+    train: bool,
+    momentum: float = 0.9,
+    name: str | None = None,
+    relu: bool = False,
+):
+    """Returns ``apply(x)``: BatchNorm in fp32, output cast back to x.dtype.
+    ``relu=True`` folds the activation into the op (call sites replace
+    ``nn.relu(norm(h))``) so the backward reconstructs the mask instead of
+    saving it."""
+    if _fused_bn_enabled():
+        return BatchNorm(
+            use_running_average=not train,
+            momentum=momentum,
+            relu=relu,
+            name=name,
+        )
+
     bn = nn.BatchNorm(
         use_running_average=not train,
         momentum=momentum,
@@ -32,7 +106,8 @@ def fp32_batch_norm(train: bool, momentum: float = 0.9, name: str | None = None)
     )
 
     def apply(x):
-        return bn(x.astype(jnp.float32)).astype(x.dtype)
+        y = bn(x.astype(jnp.float32)).astype(x.dtype)
+        return nn.relu(y) if relu else y
 
     return apply
 

@@ -27,11 +27,13 @@ class Bottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = lambda name: fp32_batch_norm(train, name=name)
+        norm = lambda name, relu=False: fp32_batch_norm(
+            train, name=name, relu=relu
+        )
         out_ch = self.planes * self.expansion
         identity = x
         h = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
-        h = nn.relu(norm("bn1")(h))
+        h = norm("bn1", relu=True)(h)
         h = nn.Conv(
             self.planes,
             (3, 3),
@@ -40,7 +42,7 @@ class Bottleneck(nn.Module):
             use_bias=False,
             name="conv2",
         )(h)
-        h = nn.relu(norm("bn2")(h))
+        h = norm("bn2", relu=True)(h)
         h = nn.Conv(out_ch, (1, 1), use_bias=False, name="conv3")(h)
         h = norm("bn3")(h)
         if self.stride != 1 or x.shape[-1] != out_ch:
@@ -62,8 +64,7 @@ class CifarResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
-        h = fp32_batch_norm(train, name="bn1")(h)
-        h = nn.relu(h)
+        h = fp32_batch_norm(train, name="bn1", relu=True)(h)
         for si, (planes, blocks) in enumerate(zip((16, 32, 64), self.layers)):
             for bi in range(blocks):
                 stride = 2 if (si > 0 and bi == 0) else 1

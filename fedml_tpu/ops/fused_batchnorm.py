@@ -1,0 +1,111 @@
+"""Memory-lean training BatchNorm: custom-VJP stats+normalize+ReLU.
+
+Why this exists: the zoo's BatchNorms normalize in fp32 for numerical
+safety (models/norms.py). Under reverse-mode AD, XLA saves the fp32
+intermediates of that normalize chain — the upcast ``x32`` / ``x̂`` values,
+2× the activation bytes of the surrounding bf16 convs — as residuals in
+HBM for the backward pass. BatchNorm is bandwidth-bound, so those fp32
+residual writes+reads are most of its training cost: measured on the
+cross-silo ResNet-56 bf16 round (B=64/client, 10 clients, scan schedule),
+BatchNorm accounts for 88 ms of the 183 ms device round (48%) with plain
+``nn.BatchNorm``.
+
+This op makes the residual set explicit instead: save ONLY the compute-
+dtype ``x`` (already in HBM — the conv wrote it), the per-channel batch
+stats (C-sized fp32 vectors), and gamma/beta; the backward recomputes
+``x̂`` from them in registers. The optional folded ReLU removes one more
+elementwise round-trip and its saved mask — the backward reconstructs the
+mask from ``x̂·γ+β > 0``.
+
+Math parity: statistics are biased batch moments computed in fp32 exactly
+as flax's ``nn.BatchNorm`` (``E[x²]−E[x]²`` on the fp32-upcast input),
+normalization in fp32, output cast back to ``x.dtype``. The backward is
+the standard full BN gradient (including the terms through μ and σ²).
+The ``mean``/``var`` outputs feed running-stat EMAs only; like flax's
+mutable ``batch_stats`` they are gradient-stop buffers (their cotangents
+are ignored by the VJP).
+
+Pure JAX (no Pallas): every op here fuses into 2 HBM passes per
+direction, works on CPU test meshes, and is vmap/shard_map-safe. Ref
+counterpart: the reference special-cases BN precision/sync in a 457-line
+batchnorm_utils.py (model/cv/batchnorm_utils.py); here the whole policy
+is one differentiable op.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce_axes(x):
+    return tuple(range(x.ndim - 1))
+
+
+def _stats_f32(x):
+    """Biased per-channel batch moments in fp32 (flax _compute_stats
+    parity: mean and E[x²]−mean² on the upcast input)."""
+    x32 = x.astype(jnp.float32)
+    axes = _reduce_axes(x)
+    mean = jnp.mean(x32, axis=axes)
+    mean2 = jnp.mean(x32 * x32, axis=axes)
+    var = mean2 - mean * mean
+    return mean, var
+
+
+def _normalize(x, mean, var, gamma, beta, eps, relu):
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x32 - mean) * (inv * gamma) + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def bn_act(x, gamma, beta, eps: float, relu: bool):
+    """Training-mode BatchNorm(+ReLU) with batch statistics.
+
+    Returns ``(y, mean, var)``; y in ``x.dtype``, stats fp32. ``mean`` and
+    ``var`` are EMA feed-only (no gradient flows back through them — flax
+    buffer semantics)."""
+    mean, var = _stats_f32(x)
+    y = _normalize(x, mean, var, gamma, beta, eps, relu)
+    return y, mean, var
+
+
+def _bn_act_fwd(x, gamma, beta, eps, relu):
+    mean, var = _stats_f32(x)
+    y = _normalize(x, mean, var, gamma, beta, eps, relu)
+    # Residuals: compute-dtype x + C-sized fp32 vectors. No fp32 copy of
+    # the activation survives the forward — that is the point.
+    return (y, mean, var), (x, gamma, beta, mean, var)
+
+
+def _bn_act_bwd(eps, relu, res, cots):
+    x, gamma, beta, mean, var = res
+    dy, _dmean, _dvar = cots  # stats are EMA buffers: cotangents ignored
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x32 - mean) * inv
+    if relu:
+        # reconstruct the folded ReLU's mask instead of saving it
+        dy32 = dy32 * (xhat * gamma + beta > 0.0)
+    axes = _reduce_axes(x)
+    n = x.size // x.shape[-1]
+    dbeta = jnp.sum(dy32, axis=axes)
+    dgamma = jnp.sum(dy32 * xhat, axis=axes)
+    # full BN gradient incl. the μ/σ² terms
+    dx = (gamma * inv / n) * (n * dy32 - dbeta - xhat * dgamma)
+    return dx.astype(x.dtype), dgamma, dbeta
+
+
+bn_act.defvjp(_bn_act_fwd, _bn_act_bwd)
+
+
+def bn_inference(x, ra_mean, ra_var, gamma, beta, eps: float, relu: bool):
+    """Eval-mode normalize with running stats (fp32 math, dtype-preserving)."""
+    return _normalize(x, ra_mean, ra_var, gamma, beta, eps, relu)
