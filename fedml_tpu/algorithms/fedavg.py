@@ -121,9 +121,9 @@ def resolve_skip_empty_steps(mode: str, may_pad: Optional[bool]) -> bool:
     """Whether the per-step ``lax.cond`` skip branch should be emitted.
 
     The cond genuinely skips all-padding steps under the sequential
-    ("scan") client schedule — but it is not free: measured on the
-    cross-silo ResNet-56 step it costs ~0.6 ms/step (1.86 vs 1.24 ms,
-    +50%) even when every step is real, presumably because the branch
+    ("scan") client schedule — but it is not free: interleaved-min on the
+    cross-silo ResNet-56 round, the cond-ful body costs ~3% (188.0 vs
+    182.5 ms) when every step is real, presumably because the branch
     boundary blocks XLA from fusing the batch slice into the step. Whether
     a cohort HAS any all-padding step is host-side static knowledge (the
     sampled clients' sample counts vs the bucketed step count), so the
@@ -553,15 +553,12 @@ class FedAvgAPI:
         jit cache, so this is cheap after the first round has compiled."""
         from fedml_tpu.utils.profiling import compiled_flops
 
-        cfg = self.config
-        sampled = client_sampling(
-            round_idx, self.data.num_clients, cfg.fed.client_num_per_round
-        )
+        sampled, _steps, _bs = self._round_plan(round_idx)
         batch = self._round_batch(sampled, round_idx)
         rng = jax.random.fold_in(self.rng, round_idx + 1)
         fn = self.round_fn
         if hasattr(fn, "variant_for"):
-            fn = fn.variant_for(self._cohort_may_pad(sampled))
+            fn = fn.variant_for(self._round_may_pad(round_idx))
         return compiled_flops(
             fn, self.global_vars, *self._place_batch(batch, rng)
         )

@@ -29,8 +29,7 @@ class GKTClientResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
-        h = fp32_batch_norm(train, name="bn1")(h)
-        h = nn.relu(h)
+        h = fp32_batch_norm(train, name="bn1", relu=True)(h)
         features = h  # ref resnet_client.py:193 extracted_features
         for bi in range(self.blocks):
             h = Bottleneck(4, name=f"layer1_block{bi}")(h, train=train)

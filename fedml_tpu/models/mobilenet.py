@@ -18,8 +18,8 @@ from fedml_tpu.models.norms import fp32_batch_norm
 import jax.numpy as jnp
 
 
-def _bn(train, name):
-    return fp32_batch_norm(train, name=name)
+def _bn(train, name, relu=False):
+    return fp32_batch_norm(train, name=name, relu=relu)
 
 
 class DepthSeparableConv(nn.Module):
@@ -38,9 +38,9 @@ class DepthSeparableConv(nn.Module):
             use_bias=False,
             name="depthwise",
         )(x)
-        h = nn.relu(_bn(train, "bn_dw")(h))
+        h = _bn(train, "bn_dw", relu=True)(h)
         h = nn.Conv(self.out_ch, (1, 1), use_bias=False, name="pointwise")(h)
-        return nn.relu(_bn(train, "bn_pw")(h))
+        return _bn(train, "bn_pw", relu=True)(h)
 
 
 class MobileNet(nn.Module):
@@ -52,7 +52,7 @@ class MobileNet(nn.Module):
         a = self.width_multiplier
         ch = lambda c: int(c * a)
         h = nn.Conv(ch(32), (3, 3), padding="SAME", use_bias=False, name="stem")(x)
-        h = nn.relu(_bn(train, "stem_bn")(h))
+        h = _bn(train, "stem_bn", relu=True)(h)
         plan: Sequence[Tuple[int, int]] = [
             (64, 1),
             (128, 2), (128, 1),

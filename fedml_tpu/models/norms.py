@@ -112,25 +112,75 @@ def fp32_batch_norm(
     return apply
 
 
-def fp32_group_norm(group_size: int, name: str | None = None):
+class GroupNorm(nn.Module):
+    """GroupNorm via the custom-VJP op (ops/fused_groupnorm.gn_act):
+    fp32 statistics, compute-dtype residuals, optional folded ReLU.
+    Param structure and class NAME match ``nn.GroupNorm`` (see the
+    BatchNorm docstring for why the name matters)."""
+
+    group_size: int
+    epsilon: float = 1e-6
+    relu: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from fedml_tpu.ops.fused_groupnorm import gn_act
+
+        feat = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (feat,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feat,), jnp.float32)
+        return gn_act(x, scale, bias, self.group_size, self.epsilon, self.relu)
+
+
+class LayerNorm(nn.Module):
+    """LayerNorm via the custom-VJP op (ops/fused_groupnorm.ln_act):
+    fp32 statistics, compute-dtype residuals. Param structure and class
+    NAME match ``nn.LayerNorm``."""
+
+    epsilon: float = 1e-6
+    relu: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from fedml_tpu.ops.fused_groupnorm import ln_act
+
+        feat = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (feat,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feat,), jnp.float32)
+        return ln_act(x, scale, bias, self.epsilon, self.relu)
+
+
+def _fused_norms_enabled() -> bool:
+    """GN/LN fused path switch — FEDML_TPU_FUSED_NORMS=0 restores the
+    flax modules (same A/B role as FEDML_TPU_FUSED_BN for BatchNorm)."""
+    return os.environ.get("FEDML_TPU_FUSED_NORMS", "1") != "0"
+
+
+def fp32_group_norm(group_size: int, name: str | None = None, relu: bool = False):
     """GroupNorm with fp32 statistics, output cast back to x.dtype — the
     same E[x²]−E[x]² cancellation argument as fp32_batch_norm (no running
     stats, but the per-group variance itself is bf16-hostile)."""
+    if _fused_norms_enabled():
+        return GroupNorm(group_size=group_size, relu=relu, name=name)
     gn = nn.GroupNorm(
         num_groups=None, group_size=group_size, dtype=jnp.float32, name=name
     )
 
     def apply(x):
-        return gn(x.astype(jnp.float32)).astype(x.dtype)
+        y = gn(x.astype(jnp.float32)).astype(x.dtype)
+        return nn.relu(y) if relu else y
 
     return apply
 
 
-def fp32_layer_norm(name: str | None = None):
+def fp32_layer_norm(name: str | None = None, relu: bool = False):
     """LayerNorm with fp32 statistics, output cast back to x.dtype."""
+    if _fused_norms_enabled():
+        return LayerNorm(relu=relu, name=name)
     ln = nn.LayerNorm(dtype=jnp.float32, name=name)
 
     def apply(x):
-        return ln(x.astype(jnp.float32)).astype(x.dtype)
+        y = ln(x.astype(jnp.float32)).astype(x.dtype)
+        return nn.relu(y) if relu else y
 
     return apply

@@ -21,10 +21,10 @@ from fedml_tpu.models.norms import fp32_batch_norm, fp32_group_norm
 import jax.numpy as jnp
 
 
-def _norm(channels_per_group: int, train: bool, name: str):
+def _norm(channels_per_group: int, train: bool, name: str, relu: bool = False):
     if channels_per_group > 0:
-        return fp32_group_norm(channels_per_group, name=name)
-    return fp32_batch_norm(train, name=name)
+        return fp32_group_norm(channels_per_group, name=name, relu=relu)
+    return fp32_batch_norm(train, name=name, relu=relu)
 
 
 class BasicBlock(nn.Module):
@@ -45,7 +45,7 @@ class BasicBlock(nn.Module):
             use_bias=False,
             name="conv1",
         )(x)
-        h = nn.relu(_norm(cpg, train, "bn1")(h))
+        h = _norm(cpg, train, "bn1", relu=True)(h)
         h = nn.Conv(self.planes, (3, 3), padding="SAME", use_bias=False, name="conv2")(h)
         h = _norm(cpg, train, "bn2")(h)
         out_ch = self.planes * self.expansion
@@ -72,7 +72,7 @@ class BottleneckGN(nn.Module):
         cpg = self.channels_per_group
         identity = x
         h = nn.Conv(self.planes, (1, 1), use_bias=False, name="conv1")(x)
-        h = nn.relu(_norm(cpg, train, "bn1")(h))
+        h = _norm(cpg, train, "bn1", relu=True)(h)
         h = nn.Conv(
             self.planes,
             (3, 3),
@@ -81,7 +81,7 @@ class BottleneckGN(nn.Module):
             use_bias=False,
             name="conv2",
         )(h)
-        h = nn.relu(_norm(cpg, train, "bn2")(h))
+        h = _norm(cpg, train, "bn2", relu=True)(h)
         out_ch = self.planes * self.expansion
         h = nn.Conv(out_ch, (1, 1), use_bias=False, name="conv3")(h)
         h = _norm(cpg, train, "bn3")(h)
@@ -112,13 +112,13 @@ class ResNetGN(nn.Module):
         cpg = self.channels_per_group
         if self.small_input:
             h = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, name="conv1")(x)
-            h = nn.relu(_norm(cpg, train, "bn1")(h))
+            h = _norm(cpg, train, "bn1", relu=True)(h)
         else:
             h = nn.Conv(
                 64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                 use_bias=False, name="conv1",
             )(x)
-            h = nn.relu(_norm(cpg, train, "bn1")(h))
+            h = _norm(cpg, train, "bn1", relu=True)(h)
             h = nn.max_pool(h, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for si, (planes, blocks) in enumerate(
             zip((64, 128, 256, 512), self.layers)

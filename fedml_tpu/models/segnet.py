@@ -23,26 +23,22 @@ class EncoderDecoder(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         w = self.width
-        bn = lambda name: fp32_batch_norm(train, name=name)
+        bn = lambda name: fp32_batch_norm(train, name=name, relu=True)
         # encoder
-        e1 = nn.relu(bn("bn1")(nn.Conv(w, (3, 3), padding="SAME", use_bias=False, name="enc1")(x)))
-        e2 = nn.relu(
-            bn("bn2")(
-                nn.Conv(w * 2, (3, 3), strides=(2, 2), padding="SAME", use_bias=False, name="enc2")(e1)
-            )
+        e1 = bn("bn1")(nn.Conv(w, (3, 3), padding="SAME", use_bias=False, name="enc1")(x))
+        e2 = bn("bn2")(
+            nn.Conv(w * 2, (3, 3), strides=(2, 2), padding="SAME", use_bias=False, name="enc2")(e1)
         )
-        e3 = nn.relu(
-            bn("bn3")(
-                nn.Conv(w * 4, (3, 3), strides=(2, 2), padding="SAME", use_bias=False, name="enc3")(e2)
-            )
+        e3 = bn("bn3")(
+            nn.Conv(w * 4, (3, 3), strides=(2, 2), padding="SAME", use_bias=False, name="enc3")(e2)
         )
         # decoder: upsample + skip
         B, H, W_, C = e3.shape
         d2 = jax.image.resize(e3, (B, H * 2, W_ * 2, C), method="bilinear")
         d2 = jnp.concatenate([d2, e2], axis=-1)
-        d2 = nn.relu(bn("bn4")(nn.Conv(w * 2, (3, 3), padding="SAME", use_bias=False, name="dec2")(d2)))
+        d2 = bn("bn4")(nn.Conv(w * 2, (3, 3), padding="SAME", use_bias=False, name="dec2")(d2))
         B, H, W_, C = d2.shape
         d1 = jax.image.resize(d2, (B, H * 2, W_ * 2, C), method="bilinear")
         d1 = jnp.concatenate([d1, e1], axis=-1)
-        d1 = nn.relu(bn("bn5")(nn.Conv(w, (3, 3), padding="SAME", use_bias=False, name="dec1")(d1)))
+        d1 = bn("bn5")(nn.Conv(w, (3, 3), padding="SAME", use_bias=False, name="dec1")(d1))
         return nn.Conv(self.num_classes, (1, 1), name="head")(d1)

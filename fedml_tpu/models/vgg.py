@@ -53,8 +53,9 @@ class VGG(nn.Module):
             else:
                 h = nn.Conv(int(v), (3, 3), padding="SAME", name=f"conv{ci}")(h)
                 if self.batch_norm:
-                    h = fp32_batch_norm(train, name=f"bn{ci}")(h)
-                h = nn.relu(h)
+                    h = fp32_batch_norm(train, name=f"bn{ci}", relu=True)(h)
+                else:
+                    h = nn.relu(h)
                 ci += 1
         h = _adaptive_avg_pool(h, 7)
         h = h.reshape((h.shape[0], -1))

@@ -1,0 +1,151 @@
+"""Memory-lean GroupNorm / LayerNorm: custom-VJP stats+normalize(+ReLU).
+
+Same argument as ops/fused_batchnorm.py: the zoo normalizes in fp32 for
+numerical safety, and reverse-mode AD then saves fp32 intermediates of
+the normalize chain (the upcast x / x̂) to HBM as backward residuals —
+2× the activation bytes of the surrounding bf16 compute, on ops that are
+purely bandwidth-bound. These custom VJPs save only the compute-dtype
+``x`` plus the per-(sample,group) or per-position statistics and
+recompute x̂ in registers.
+
+Math parity targets (pinned in tests/test_fused_gn_ln.py):
+- ``gn_act``  ≡ flax ``nn.GroupNorm(group_size=gs, epsilon=eps)``:
+  biased moments per (sample, group) over all non-batch axes, fp32.
+- ``ln_act``  ≡ flax ``nn.LayerNorm(epsilon=eps)``: biased moments per
+  position over the feature axis, fp32.
+
+Both backwards are the standard full gradients including the μ/σ² terms;
+the optional folded ReLU reconstructs its mask from ``x̂·γ+β > 0``.
+Pure JAX — CPU-safe, vmap/shard_map-safe.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# GroupNorm
+
+
+def _gn_shapes(x, group_size: int):
+    C = x.shape[-1]
+    if C % group_size:
+        raise ValueError(f"channels {C} not divisible by group_size {group_size}")
+    G = C // group_size
+    N = x.shape[0]
+    return N, G, group_size
+
+
+def _gn_grouped(x32, N, G, gs):
+    # (N, spatial..., C) -> (N, S, G, gs); stats reduce over (S, gs)
+    return x32.reshape(N, -1, G, gs)
+
+
+def _gn_stats(x, group_size: int, eps: float):
+    N, G, gs = _gn_shapes(x, group_size)
+    xg = _gn_grouped(x.astype(jnp.float32), N, G, gs)
+    mean = jnp.mean(xg, axis=(1, 3), keepdims=True)
+    var = jnp.mean(xg * xg, axis=(1, 3), keepdims=True) - mean * mean
+    inv = jax.lax.rsqrt(var + eps)
+    return xg, mean, inv
+
+
+def _gn_normalize(x, gamma, beta, group_size, eps, relu):
+    N, G, gs = _gn_shapes(x, group_size)
+    xg, mean, inv = _gn_stats(x, group_size, eps)
+    xhat = ((xg - mean) * inv).reshape(x.shape)
+    y = xhat * gamma + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def gn_act(x, gamma, beta, group_size: int, eps: float, relu: bool):
+    """GroupNorm(+ReLU): y in x.dtype; gamma/beta per channel (fp32)."""
+    return _gn_normalize(x, gamma, beta, group_size, eps, relu)
+
+
+def _gn_fwd(x, gamma, beta, group_size, eps, relu):
+    return _gn_normalize(x, gamma, beta, group_size, eps, relu), (x, gamma, beta)
+
+
+def _gn_bwd(group_size, eps, relu, res, dy):
+    x, gamma, beta = res
+    N, G, gs = _gn_shapes(x, group_size)
+    xg, mean, inv = _gn_stats(x, group_size, eps)
+    xhat = (xg - mean) * inv  # (N, S, G, gs)
+    dy32 = dy.astype(jnp.float32)
+    if relu:
+        y_lin = xhat.reshape(x.shape) * gamma + beta
+        dy32 = dy32 * (y_lin > 0.0)
+    dyg = _gn_grouped(dy32, N, G, gs)
+    # per-channel affine grads (sum over batch and spatial)
+    dgamma = jnp.sum(dyg * xhat, axis=(0, 1)).reshape(-1)
+    dbeta = jnp.sum(dyg, axis=(0, 1)).reshape(-1)
+    # per-(sample, group) normalize grads
+    gg = gamma.reshape(1, 1, G, gs)
+    dxhat = dyg * gg
+    n = xg.shape[1] * gs
+    s1 = jnp.sum(dxhat, axis=(1, 3), keepdims=True)
+    s2 = jnp.sum(dxhat * xhat, axis=(1, 3), keepdims=True)
+    dx = (inv / n) * (n * dxhat - s1 - xhat * s2)
+    return dx.reshape(x.shape).astype(x.dtype), dgamma, dbeta
+
+
+gn_act.defvjp(_gn_fwd, _gn_bwd)
+
+
+# --------------------------------------------------------------------------
+# LayerNorm
+
+
+def _ln_stats(x, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True) - mean * mean
+    inv = jax.lax.rsqrt(var + eps)
+    return x32, mean, inv
+
+
+def _ln_normalize(x, gamma, beta, eps, relu):
+    x32, mean, inv = _ln_stats(x, eps)
+    y = (x32 - mean) * inv * gamma + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ln_act(x, gamma, beta, eps: float, relu: bool):
+    """LayerNorm(+ReLU) over the last axis: y in x.dtype; fp32 affine."""
+    return _ln_normalize(x, gamma, beta, eps, relu)
+
+
+def _ln_fwd(x, gamma, beta, eps, relu):
+    return _ln_normalize(x, gamma, beta, eps, relu), (x, gamma, beta)
+
+
+def _ln_bwd(eps, relu, res, dy):
+    x, gamma, beta = res
+    x32, mean, inv = _ln_stats(x, eps)
+    xhat = (x32 - mean) * inv
+    dy32 = dy.astype(jnp.float32)
+    if relu:
+        dy32 = dy32 * (xhat * gamma + beta > 0.0)
+    lead = tuple(range(x.ndim - 1))
+    dgamma = jnp.sum(dy32 * xhat, axis=lead)
+    dbeta = jnp.sum(dy32, axis=lead)
+    dxhat = dy32 * gamma
+    D = x.shape[-1]
+    s1 = jnp.sum(dxhat, axis=-1, keepdims=True)
+    s2 = jnp.sum(dxhat * xhat, axis=-1, keepdims=True)
+    dx = (inv / D) * (D * dxhat - s1 - xhat * s2)
+    return dx.astype(x.dtype), dgamma, dbeta
+
+
+ln_act.defvjp(_ln_fwd, _ln_bwd)

@@ -81,7 +81,7 @@ def mfu(
     return (flops_per_call * calls_per_sec) / (peak * n_devices)
 
 
-def scan_slope_seconds(step_fn, init_carry, k1: int = 1, k2: int = 5, reps: int = 3):
+def scan_slope_seconds(step_fn, init_carry, k1: int = 1, k2: int = 5, reps: int = 5):
     """Device seconds for ONE ``step_fn(carry) -> carry`` call, measured
     tunnel-proof: jit a program that runs the step K times inside a
     lax.scan, wall-time it at K=k1 and K=k2, and take the slope
@@ -90,7 +90,18 @@ def scan_slope_seconds(step_fn, init_carry, k1: int = 1, k2: int = 5, reps: int 
     appear once per program and cancel in the slope, so the result is pure
     device execution time. Motivated by VERDICT r2 Weak #6: through the
     remote TPU tunnel, per-round wall clock conflates tunnel latency into
-    every round."""
+    every round.
+
+    Noise discipline: the shared chip/tunnel shows BIMODAL throughput
+    windows (~2× swings lasting seconds — PERF_R3.md §3b), so each rep
+    measures its (k1, k2) PAIR back-to-back and contributes one slope;
+    the result is the MEDIAN positive per-pair slope. Pooling best-of
+    times across reps (the original scheme) can pair a fast-mode t(k1)
+    with a slow-mode t(k2) and report a 2×-off slope; taking the min
+    positive slope instead selects exactly the pairs where the mode
+    flipped mid-pair (slow t(k1), fast t(k2) → spuriously tiny slope —
+    observed as a 7.6 ms/182%-MFU north-star round). The median discards
+    both tails."""
 
     def rep(c, k_arr):
         def body(c, _):
@@ -105,16 +116,27 @@ def scan_slope_seconds(step_fn, init_carry, k1: int = 1, k2: int = 5, reps: int 
         np.asarray(jax.tree_util.tree_leaves(c)[0])
 
     def timed(k):
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            fetch(jrep(init_carry, jnp.arange(k)))
-            best = min(best, time.perf_counter() - t0)
-        return best
+        t0 = time.perf_counter()
+        fetch(jrep(init_carry, jnp.arange(k)))
+        return time.perf_counter() - t0
 
     for k in (k1, k2):  # compile both shapes outside the timing
         fetch(jrep(init_carry, jnp.arange(k)))
-    return (timed(k2) - timed(k1)) / (k2 - k1)
+    slopes = []
+    for _ in range(3 * reps):  # allow retries when pairs straddle a switch
+        slope = (timed(k2) - timed(k1)) / (k2 - k1)
+        if slope > 0:
+            slopes.append(slope)
+        if len(slopes) >= reps:
+            break
+    if not slopes:
+        # pathological: no pair produced a positive slope. Fall back to
+        # whole-program time at k2 — an OVERestimate (includes the
+        # per-program dispatch/fetch overhead the slope would cancel) but
+        # always positive, never a negative-MFU artifact.
+        return timed(k2) / k2
+    slopes.sort()
+    return slopes[len(slopes) // 2]
 
 
 @contextlib.contextmanager
