@@ -15,13 +15,23 @@ it is the per-shard compute core under sequence-parallel ring attention
 
 Interpret mode (CPU tests) is selected automatically off-TPU.
 
-Measured (v5e through the remote tunnel, bf16, D=128, causal; noisy ±
-environment): at block 512 the kernel is at parity with XLA's fused
-attention lowering (S=4096: ~11 ms both; S=16384: ~70 ms both) — XLA on TPU
-already avoids materialising the S×S scores, so the win here is control
-(explicit blocking under ring attention, a place to fuse more later), not a
-speedup today. Small blocks (≤256) are pathological (revisit overhead);
-keep ≥512 on hardware."""
+Measured (v5e through the remote tunnel, bf16, causal, block 512; the
+shared chip shows ~2× bimodal throughput windows so only interleaved
+A/B differences are trustworthy — see docs/PERF_R3.md §3b):
+
+- FORWARD-only, the kernel is at parity with XLA's attention lowering —
+  XLA on TPU already avoids materialising the S×S scores (S=4096:
+  ~11 ms both in the round-3 measurement).
+- The TRAINING step (fwd+bwd, H=8 D=64) is where the kernel wins:
+  reverse-mode AD of plain jnp attention saves the S×S probabilities as
+  a residual (H·S²·2 bytes — 2.1 GB at S=8192), while this kernel's
+  custom VJP recomputes P blockwise. Interleaved best-of-5, twice
+  reproduced: parity at S=4096, ~3× faster at S=8192 (116 vs 341 ms
+  wall incl. ~100 ms tunnel RTT), ~1.35× at S=16384 (where XLA
+  evidently switches to a rematerialising schedule itself).
+
+Small blocks (≤256) are pathological (revisit overhead); keep ≥512 on
+hardware."""
 
 from __future__ import annotations
 
