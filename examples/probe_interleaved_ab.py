@@ -1,0 +1,139 @@
+"""Interleaved A/B round-timing probes behind the round-3 perf work.
+
+The shared chip/tunnel shows ~2× bimodal throughput windows lasting
+seconds (docs/PERF_R3.md §3b) — back-to-back blocks of one variant
+measure the mode, not the variant. Every comparison here alternates the
+variants per cycle and reports the per-variant MIN, the discipline all
+recorded A/B numbers in PERF_R3 use.
+
+Probes (`python examples/probe_interleaved_ab.py <which>`, default all):
+  cond — cond-skip vs cond-less round body (resolve_skip_empty_steps)
+  bn   — fused custom-VJP BatchNorm vs plain flax nn.BatchNorm
+Both at the cross-silo ResNet-56 shapes (10 clients × batch 64, homo 512).
+(The norm-free architecture ablation that sized BN's 48% share lives in
+examples/probe_resnet_bf16.py's 'none' variant.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgAPI,
+    client_sampling,
+    make_fedavg_round_body,
+)
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+
+
+def _cfg(dt="bfloat16"):
+    return RunConfig(
+        data=DataConfig(batch_size=64),
+        fed=FedConfig(
+            client_num_in_total=10, client_num_per_round=10, comm_round=1,
+            epochs=1, frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1, compute_dtype=dt),
+        model="resnet56",
+    )
+
+
+def _data():
+    return synthetic_classification(
+        num_clients=10, num_classes=10, feat_shape=(32, 32, 3),
+        samples_per_client=512, partition_method="homo", ragged=False, seed=0,
+    )
+
+
+def _repeat_fn(body, placed):
+    def rep(gv, k_arr):
+        def b(gv, _):
+            return body(gv, *placed)[0], jnp.float32(0)
+
+        gv, _ = jax.lax.scan(b, gv, k_arr)
+        return gv
+
+    return jax.jit(rep)
+
+
+def _fetch(gv):
+    np.asarray(jax.tree_util.tree_leaves(gv)[0])
+
+
+def interleaved_min(fns, gvs, cycles=6):
+    """{name: ms/round} — per-variant min over alternating (K=1, K=3)
+    block pairs; the (t3 − t1)/2 slope cancels dispatch/tunnel RTT."""
+    for n, f in fns.items():
+        for k in (1, 3):
+            _fetch(f(gvs[n], jnp.arange(k)))
+    best = {n: float("inf") for n in fns}
+    for _ in range(cycles):
+        for n, f in fns.items():
+            t0 = time.perf_counter()
+            _fetch(f(gvs[n], jnp.arange(1)))
+            t1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _fetch(f(gvs[n], jnp.arange(3)))
+            t3 = time.perf_counter() - t0
+            best[n] = min(best[n], (t3 - t1) / 2)
+    return {n: round(v * 1e3, 1) for n, v in best.items()}
+
+
+def _api_and_placed(cfg, model):
+    api = FedAvgAPI(cfg, _data(), model)
+    sampled = client_sampling(1, 10, 10)
+    batch = api._round_batch(sampled, 1)
+    placed = tuple(
+        jnp.asarray(p)
+        for p in api._place_batch(batch, jax.random.fold_in(api.rng, 2))
+    )
+    return api, sampled, placed
+
+
+def probe_cond(dt="bfloat16"):
+    cfg = _cfg(dt)
+    model = create_model("resnet56", "cifar10", (32, 32, 3), 10)
+    api, _, placed = _api_and_placed(cfg, model)
+    fns, gvs = {}, {}
+    for name, mp in (("cond", True), ("nocond", False)):
+        body = make_fedavg_round_body(
+            model, cfg, client_mode="scan", may_pad=mp
+        )
+        fns[name] = _repeat_fn(body, placed)
+        gvs[name] = api.global_vars
+    print(json.dumps({"probe": "cond", "dtype": dt, **interleaved_min(fns, gvs)}))
+
+
+def probe_bn(dt="bfloat16"):
+    cfg = _cfg(dt)
+    fns, gvs = {}, {}
+    for name, flag in (("fused", "1"), ("plain", "0")):
+        os.environ["FEDML_TPU_FUSED_BN"] = flag
+        model = create_model("resnet56", "cifar10", (32, 32, 3), 10)
+        api, sampled, placed = _api_and_placed(cfg, model)
+        body = make_fedavg_round_body(
+            model, cfg, client_mode="scan",
+            may_pad=api._cohort_may_pad(sampled),
+        )
+        fns[name] = _repeat_fn(body, placed)
+        gvs[name] = api.global_vars
+    print(json.dumps({"probe": "bn", "dtype": dt, **interleaved_min(fns, gvs)}))
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in ("all", "cond", "bn"):
+        raise SystemExit(f"unknown probe {which!r} (all|cond|bn)")
+    if which in ("all", "cond"):
+        probe_cond()
+    if which in ("all", "bn"):
+        probe_bn()

@@ -21,7 +21,9 @@ A/B differences are trustworthy — see docs/PERF_R3.md §3b):
 
 - FORWARD-only, the kernel is at parity with XLA's attention lowering —
   XLA on TPU already avoids materialising the S×S scores (S=4096:
-  ~11 ms both in the round-3 measurement).
+  ~11 ms both in the round-3 measurement, which used D=128; the training
+  rows below use H=8 D=64, so the two sets of absolute numbers are not
+  comparable to each other).
 - The TRAINING step (fwd+bwd, H=8 D=64) is where the kernel wins:
   reverse-mode AD of plain jnp attention saves the S×S probabilities as
   a residual (H·S²·2 bytes — 2.1 GB at S=8192), while this kernel's

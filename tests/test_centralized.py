@@ -80,15 +80,20 @@ def test_centralized_dp_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_sync_batchnorm_under_dp_mesh():
+@pytest.mark.parametrize("norm_impl", ["flax", "fused"])
+def test_sync_batchnorm_under_dp_mesh(norm_impl):
     """The reference needs 457 LoC of sync-BN helpers (batchnorm_utils.py)
     to make multi-GPU BatchNorm see the global batch. Under GSPMD the same
     guarantee is automatic: BN's batch mean is a reduction over a sharded
     axis, so XLA inserts the cross-device collective — batch_stats after a
-    DP step over 8 devices equal the single-device stats."""
+    DP step over 8 devices equal the single-device stats. Pinned for BOTH
+    implementations: flax nn.BatchNorm and the production custom-VJP path
+    (models/norms.BatchNorm) — the custom VJP must not break the
+    automatic collective insertion."""
     import flax.linen as nn
     import jax
 
+    from fedml_tpu.models.norms import BatchNorm as FusedBN
     from fedml_tpu.parallel.mesh import make_mesh
 
     if len(jax.devices()) < 8:
@@ -98,9 +103,14 @@ def test_sync_batchnorm_under_dp_mesh():
         @nn.compact
         def __call__(self, x, train: bool = False):
             h = nn.Dense(8, name="fc1")(x)
-            h = nn.BatchNorm(
-                use_running_average=not train, momentum=0.9, name="bn"
-            )(h)
+            if norm_impl == "fused":
+                h = FusedBN(
+                    use_running_average=not train, momentum=0.9, name="bn"
+                )(h)
+            else:
+                h = nn.BatchNorm(
+                    use_running_average=not train, momentum=0.9, name="bn"
+                )(h)
             return nn.Dense(NUM_CLASSES, name="fc2")(nn.relu(h))
 
     model = ModelDef(

@@ -150,7 +150,8 @@ def test_resnet_step_equivalent_under_ab_switch(monkeypatch):
     WIRING — identical variable trees, both batch_stats collections
     updated, losses equal — not per-op numerics."""
     from fedml_tpu.config import TrainConfig
-    from fedml_tpu.models import create_model
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.resnet import CifarResNet
     from fedml_tpu.train.client import make_local_train
 
     x = np.random.RandomState(0).randn(2, 4, 32, 32, 3).astype(np.float32)
@@ -161,7 +162,14 @@ def test_resnet_step_equivalent_under_ab_switch(monkeypatch):
     outs = {}
     for flag in ("1", "0"):
         monkeypatch.setenv("FEDML_TPU_FUSED_BN", flag)
-        model = create_model("resnet56", "cifar10", (32, 32, 3), 10)
+        # one block per stage: same wiring (stem + all three BN shapes +
+        # downsample) at a fraction of resnet56's compile time
+        model = ModelDef(
+            module=CifarResNet(layers=(1, 1, 1), num_classes=10),
+            input_shape=(32, 32, 3),
+            num_classes=10,
+            has_batch_stats=True,
+        )
         variables = model.init(jax.random.PRNGKey(0))
         lt = make_local_train(model, tc, epochs=1)
         v2, mets = lt(
