@@ -6,6 +6,7 @@ from fedml_tpu.algorithms.fedavg import (
 )
 from fedml_tpu.algorithms.fedopt import FedOptAPI, make_server_optimizer
 from fedml_tpu.algorithms.fednova import FedNovaAPI, make_fednova_round
+from fedml_tpu.algorithms.scaffold import ScaffoldAPI, make_scaffold_round
 from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI, assign_groups
 
 # Heavier algorithm modules import lazily from their own namespaces:
@@ -24,6 +25,8 @@ __all__ = [
     "FedAvgAPI",
     "FedOptAPI",
     "FedNovaAPI",
+    "ScaffoldAPI",
+    "make_scaffold_round",
     "HierarchicalFedAvgAPI",
     "assign_groups",
     "client_sampling",

@@ -97,21 +97,28 @@ def resolve_client_parallelism(mode: str, model: ModelDef) -> str:
     return mode
 
 
-def client_axis_map(local_train: Callable, mode: str) -> Callable:
-    """Lift ``local_train`` over the leading client axis of (x, y, mask,
-    rngs) with global_vars broadcast — either batched (vmap) or sequential
-    (lax.scan). Both return identically stacked (client_vars, metrics);
-    the math is the same, only the schedule differs (see
-    resolve_client_parallelism)."""
+def client_axis_map(local_train: Callable, mode: str, n_broadcast: int = 1) -> Callable:
+    """Lift ``local_train`` over the leading client axis — either batched
+    (vmap) or sequential (lax.scan). The first ``n_broadcast`` positional
+    args broadcast to every client (global state: variables, and e.g.
+    SCAFFOLD's server control variate); the rest carry a leading client
+    axis. Both schedules return identically stacked outputs; the math is
+    the same, only the schedule differs (see resolve_client_parallelism)."""
     if mode == "vmap":
-        return jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))
 
-    def scanned(global_vars, x, y, mask, rngs):
+        def vmapped(*args):
+            in_axes = (None,) * n_broadcast + (0,) * (len(args) - n_broadcast)
+            return jax.vmap(local_train, in_axes=in_axes)(*args)
+
+        return vmapped
+
+    def scanned(*args):
+        bcast, per = args[:n_broadcast], args[n_broadcast:]
+
         def body(_, per_client):
-            xc, yc, mc, rc = per_client
-            return None, local_train(global_vars, xc, yc, mc, rc)
+            return None, local_train(*bcast, *per_client)
 
-        _, out = jax.lax.scan(body, None, (x, y, mask, rngs))
+        _, out = jax.lax.scan(body, None, per)
         return out
 
     return scanned

@@ -41,6 +41,7 @@ ALGORITHMS = (
     "fedopt",
     "fedprox",
     "fednova",
+    "scaffold",  # beyond the reference: control-variate drift correction
     "hierarchical",
     "fedavg_robust",
     "fedgkt",
@@ -367,6 +368,9 @@ def run(**opt):
                     gv,
                     round_idx=row["round"] + 1,
                     server_opt_state=getattr(api, "server_opt_state", None),
+                    algo_state=getattr(
+                        api, "checkpoint_state", lambda: None
+                    )(),
                 )
 
     _validate_variant(opt)
@@ -447,6 +451,7 @@ def run(**opt):
             getattr(api, "global_vars"),
             round_idx=config.fed.comm_round,
             server_opt_state=getattr(api, "server_opt_state", None),
+            algo_state=getattr(api, "checkpoint_state", lambda: None)(),
         )
     logger.close()
     click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
@@ -489,7 +494,9 @@ def _restore(api, opt):
 
     if not opt["checkpoint_path"]:
         raise click.UsageError("--resume requires --checkpoint_path")
-    loaded_vars, round_idx, _, opt_state = load_checkpoint(str(opt["checkpoint_path"]))
+    loaded_vars, round_idx, _, opt_state, algo_state = load_checkpoint(
+        str(opt["checkpoint_path"])
+    )
     api.global_vars = restore_like(api.global_vars, loaded_vars)
     api.start_round = int(round_idx)
     # Server optimizer state (FedOpt family): restore so Adam/Yogi moments
@@ -497,6 +504,11 @@ def _restore(api, opt):
     # needs no persistence.
     if opt_state is not None and getattr(api, "server_opt_state", None) is not None:
         api.server_opt_state = restore_like(api.server_opt_state, opt_state)
+    # Algorithm-private state (SCAFFOLD control variates): without this a
+    # resumed run silently degenerates to FedAvg until the variates
+    # re-learn, breaking the identical-continuation contract above.
+    if algo_state is not None and hasattr(api, "restore_state"):
+        api.restore_state(algo_state)
 
 
 def _build_api(algorithm, runtime, config, data, model, task, log_fn,
@@ -595,6 +607,10 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         from fedml_tpu.algorithms import FedNovaAPI
 
         return FedNovaAPI(config, data, model, task=task, log_fn=log_fn)
+    if algorithm == "scaffold":
+        from fedml_tpu.algorithms.scaffold import ScaffoldAPI
+
+        return ScaffoldAPI(config, data, model, task=task, log_fn=log_fn)
     if algorithm == "hierarchical":
         from fedml_tpu.algorithms import HierarchicalFedAvgAPI
 

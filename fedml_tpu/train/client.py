@@ -138,6 +138,22 @@ def make_task_loss(task: str) -> Callable:
     }[task]
 
 
+def masked_epoch_perm(ep_rng, m_flat):
+    """Mask-aware shuffle permutation — THE shared shuffle contract (used
+    by make_local_train and the SCAFFOLD local train; a divergence here
+    would silently change which samples share a minibatch): draw a key per
+    slot, pin padded slots to +inf, argsort. Valid samples (slots 0..n-1
+    by the stacking contract) get a random order in the first ceil(n/bs)
+    minibatches; padding compacts to trailing all-padding steps. Because
+    uniform draws are per-position (threefry partitionable) and valid
+    slots always occupy the prefix, minibatch composition is INDEPENDENT
+    of the padded capacity."""
+    keys = jnp.where(
+        m_flat > 0, jax.random.uniform(ep_rng, m_flat.shape), jnp.inf
+    )
+    return jnp.argsort(keys)
+
+
 def make_local_train(
     model: ModelDef,
     tc: TrainConfig,
@@ -180,19 +196,10 @@ def make_local_train(
             params, extra, opt_state = carry
             ep_rng = jax.random.fold_in(rng, epoch_idx)
             if reshuffle_each_epoch:
-                # Mask-aware shuffle: draw a key per slot, pin padded slots
-                # to +inf, argsort. Valid samples (slots 0..n-1 by the
-                # stacking contract) get a random order in the FIRST
-                # ceil(n/bs) minibatches; padding compacts to trailing
-                # all-padding steps (gated no-ops below). Because uniform
-                # draws are per-position (threefry partitionable) and valid
-                # slots always occupy the prefix, the minibatch composition
-                # is INDEPENDENT of the padded capacity — the fused
-                # multi-round scan (uniform chunk shapes) and the eager
-                # per-round path see identical math.
-                keys = jax.random.uniform(ep_rng, (n_flat,))
-                keys = jnp.where(m_flat > 0, keys, jnp.inf)
-                perm = jnp.argsort(keys)
+                # masked_epoch_perm: the fused multi-round scan (uniform
+                # chunk shapes) and the eager per-round path see identical
+                # math — see its docstring
+                perm = masked_epoch_perm(ep_rng, m_flat)
             else:
                 perm = jnp.arange(n_flat)
             xe = x_flat[perm].reshape(x.shape)

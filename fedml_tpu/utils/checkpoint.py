@@ -57,6 +57,7 @@ def save_checkpoint(
     round_idx: int,
     rng=None,
     server_opt_state=None,
+    algo_state=None,
     extra_meta: Optional[dict] = None,
 ) -> None:
     """Atomic write of (params, server opt state, round, rng): everything —
@@ -79,7 +80,15 @@ def save_checkpoint(
         flat["rng"] = np.asarray(rng)
     if server_opt_state is not None:
         _flatten("opt", _to_numpy(server_opt_state), flat)
-    meta = {"round_idx": int(round_idx), "has_opt": server_opt_state is not None}
+    if algo_state is not None:
+        # algorithm-private state (e.g. SCAFFOLD control variates) — the
+        # API's checkpoint_state()/restore_state() hooks own its shape
+        _flatten("algo", _to_numpy(algo_state), flat)
+    meta = {
+        "round_idx": int(round_idx),
+        "has_opt": server_opt_state is not None,
+        "has_algo": algo_state is not None,
+    }
     meta.update(extra_meta or {})
     flat["__meta__"] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), dtype=np.uint8
@@ -91,17 +100,21 @@ def save_checkpoint(
         json.dump(meta, f)
 
 
-def load_checkpoint(path: str) -> Tuple[dict, int, Optional[np.ndarray], Any]:
-    """Returns (global_vars, round_idx, rng, server_opt_state)."""
+def load_checkpoint(
+    path: str,
+) -> Tuple[dict, int, Optional[np.ndarray], Any, Any]:
+    """Returns (global_vars, round_idx, rng, server_opt_state, algo_state)."""
     with np.load(path + ".npz") as z:
         flat = {k: z[k] for k in z.files}
     meta = json.loads(flat.pop("__meta__").tobytes().decode("utf-8"))
     rng = flat.pop("rng", None)
     vars_flat = {k[len("vars/"):]: v for k, v in flat.items() if k.startswith("vars/")}
     opt_flat = {k[len("opt/"):]: v for k, v in flat.items() if k.startswith("opt/")}
+    algo_flat = {k[len("algo/"):]: v for k, v in flat.items() if k.startswith("algo/")}
     global_vars = _unflatten(vars_flat)
     opt_state = _unflatten(opt_flat) if meta.get("has_opt") else None
-    return global_vars, meta["round_idx"], rng, opt_state
+    algo_state = _unflatten(algo_flat) if meta.get("has_algo") else None
+    return global_vars, meta["round_idx"], rng, opt_state, algo_state
 
 
 def _to_numpy(tree):

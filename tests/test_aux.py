@@ -37,9 +37,14 @@ def test_checkpoint_roundtrip(tmp_path):
     opt_state = opt.init(params["params"])
     rng = jax.random.PRNGKey(7)
     p = str(tmp_path / "ckpt")
-    save_checkpoint(p, params, round_idx=5, rng=np.asarray(rng), server_opt_state=opt_state)
-    vars2, round_idx, rng2, opt2_raw = load_checkpoint(p)
+    algo_state = {"c": np.full((2,), 3.5, np.float32)}
+    save_checkpoint(
+        p, params, round_idx=5, rng=np.asarray(rng),
+        server_opt_state=opt_state, algo_state=algo_state,
+    )
+    vars2, round_idx, rng2, opt2_raw, algo2 = load_checkpoint(p)
     assert round_idx == 5
+    np.testing.assert_array_equal(algo2["c"], algo_state["c"])
     np.testing.assert_array_equal(np.asarray(rng), rng2)
     np.testing.assert_array_equal(
         vars2["params"]["dense"]["w"], params["params"]["dense"]["w"]

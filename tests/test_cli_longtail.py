@@ -36,6 +36,7 @@ BASE = [
         ("vertical_fl", ["--dataset", "synthetic", "--lr", "0.05"]),
         ("decentralized", ["--dataset", "synthetic", "--lr", "0.1"]),
         ("secagg", ["--dataset", "synthetic"]),
+        ("scaffold", ["--dataset", "synthetic", "--lr", "0.1"]),
     ],
 )
 def test_every_longtail_algorithm_reachable(algorithm, extra):
@@ -49,7 +50,7 @@ def test_every_longtail_algorithm_reachable(algorithm, extra):
 def test_cli_algorithm_tuple_is_complete():
     """Guard: every algorithms/ package is wired (the r1 gap was 6/15)."""
     assert set(ALGORITHMS) >= {
-        "fedavg", "fedopt", "fedprox", "fednova", "hierarchical",
+        "fedavg", "fedopt", "fedprox", "fednova", "scaffold", "hierarchical",
         "fedavg_robust", "fedgkt", "fedgan", "fedseg", "fednas",
         "split_nn", "vertical_fl", "decentralized", "secagg",
     }

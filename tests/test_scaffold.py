@@ -1,0 +1,216 @@
+"""SCAFFOLD: numpy oracle exactness + drift-regime behavior + state store.
+
+The oracle re-implements Option II of the paper in plain numpy on a tiny
+logistic-regression problem (full-batch, 1 epoch, no shuffle effects:
+every client's data is one exact batch) and must match the jitted round
+bit-for-bit-close over multiple rounds, including the control-variate
+stack. The drift test reproduces the paper's claim on a heterogeneous
+regime: with many local steps, SCAFFOLD's final training accuracy is at
+least FedAvg's.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling
+from fedml_tpu.algorithms.scaffold import ScaffoldAPI
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+
+N_CLIENTS, N_CLASSES, FEAT = 4, 3, 6
+
+
+def _cfg(batch_size=8, epochs=1, rounds=2, per_round=N_CLIENTS, lr=0.1):
+    return RunConfig(
+        data=DataConfig(batch_size=batch_size, pad_bucket=1),
+        fed=FedConfig(
+            client_num_in_total=N_CLIENTS,
+            client_num_per_round=per_round,
+            comm_round=rounds,
+            epochs=epochs,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=lr),
+        model="lr",
+    )
+
+
+def _data(samples=8):
+    return synthetic_classification(
+        num_clients=N_CLIENTS,
+        num_classes=N_CLASSES,
+        feat_shape=(FEAT,),
+        samples_per_client=samples,
+        partition_method="hetero",
+        ragged=False,
+        seed=0,
+    )
+
+
+def _softmax_grads(W, b, x, y):
+    """Mean CE grads for logits = xW + b (numpy, fp64)."""
+    logits = x @ W + b
+    logits -= logits.max(axis=1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(axis=1, keepdims=True)
+    onehot = np.eye(N_CLASSES)[y]
+    d = (p - onehot) / x.shape[0]
+    return x.T @ d, d.sum(axis=0)
+
+
+def test_matches_numpy_oracle():
+    """batch_size=-1 (full batch) + 1 epoch: one SGD step per client per
+    round, no shuffle randomness — the round math is exactly checkable."""
+    data = _data(samples=8)
+    cfg = _cfg(batch_size=-1, epochs=1, rounds=3, lr=0.2)
+    model = create_model("lr", "synthetic", (FEAT,), N_CLASSES)
+    api = ScaffoldAPI(cfg, data, model)
+
+    # numpy state
+    W = np.asarray(api.global_vars["params"]["linear"]["kernel"], np.float64)
+    b = np.asarray(api.global_vars["params"]["linear"]["bias"], np.float64)
+    cW = np.zeros_like(W)
+    cb = np.zeros_like(b)
+    ciW = np.zeros((N_CLIENTS,) + W.shape)
+    cib = np.zeros((N_CLIENTS,) + b.shape)
+    lr = cfg.train.lr
+
+    for r in range(3):
+        api.train_round(r)
+        sampled = client_sampling(r, N_CLIENTS, N_CLIENTS)
+        dWs, dbs, dcW, dcb, ns = [], [], [], [], []
+        for i in sampled:
+            x = np.asarray(data.client_x[i], np.float64)
+            y = np.asarray(data.client_y[i])
+            gW, gb = _softmax_grads(W, b, x, y)
+            yW = W - lr * (gW + cW - ciW[i])
+            yb = b - lr * (gb + cb - cib[i])
+            K = 1.0
+            ciW_new = ciW[i] - cW + (W - yW) / (K * lr)
+            cib_new = cib[i] - cb + (b - yb) / (K * lr)
+            dWs.append(yW - W)
+            dbs.append(yb - b)
+            dcW.append(ciW_new - ciW[i])
+            dcb.append(cib_new - cib[i])
+            ciW[i], cib[i] = ciW_new, cib_new
+            ns.append(len(y))
+        w = np.asarray(ns, np.float64)
+        w /= w.sum()
+        W = W + np.tensordot(w, np.stack(dWs), axes=1)
+        b = b + np.tensordot(w, np.stack(dbs), axes=1)
+        frac = len(sampled) / N_CLIENTS
+        cW = cW + frac * np.mean(np.stack(dcW), axis=0)
+        cb = cb + frac * np.mean(np.stack(dcb), axis=0)
+
+    np.testing.assert_allclose(
+        np.asarray(api.global_vars["params"]["linear"]["kernel"]), W,
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(api.global_vars["params"]["linear"]["bias"]), b,
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(api.c_server["linear"]["kernel"]), cW, rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(api.c_stack["linear"]["kernel"]), ciW, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_partial_participation_updates_only_sampled_rows():
+    data = _data(samples=8)
+    cfg = _cfg(batch_size=4, epochs=1, rounds=1, per_round=2)
+    model = create_model("lr", "synthetic", (FEAT,), N_CLASSES)
+    api = ScaffoldAPI(cfg, data, model)
+    api.train_round(0)
+    sampled = set(client_sampling(0, N_CLIENTS, 2).tolist())
+    ci = np.asarray(api.c_stack["linear"]["kernel"])
+    for i in range(N_CLIENTS):
+        moved = float(np.abs(ci[i]).sum()) > 0
+        assert moved == (i in sampled), (i, sampled, moved)
+
+
+def test_scaffold_at_least_matches_fedavg_under_drift():
+    """Heterogeneous shards + many local steps = client drift; the
+    control variates must not do WORSE than FedAvg (paper's headline)."""
+    data = _data(samples=24)
+    cfg = _cfg(batch_size=8, epochs=8, rounds=30, lr=0.05)
+    model = create_model("lr", "synthetic", (FEAT,), N_CLASSES)
+
+    def final_acc(api):
+        api.train()
+        row = api.local_test_on_all_clients(0)
+        return row["Train/Acc"]
+
+    acc_scaffold = final_acc(ScaffoldAPI(cfg, data, model))
+    acc_fedavg = final_acc(FedAvgAPI(cfg, data, model))
+    assert acc_scaffold >= acc_fedavg - 0.02, (acc_scaffold, acc_fedavg)
+
+
+def test_checkpoint_resume_preserves_control_variates(tmp_path):
+    """Kill-and-resume == uninterrupted, INCLUDING c/c_i: without the
+    algo-state checkpoint hooks a resumed SCAFFOLD silently restarts the
+    control variates at zero and diverges from the straight run."""
+    from fedml_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    data = _data(samples=8)
+    cfg = _cfg(batch_size=4, epochs=2, rounds=4, lr=0.1)
+    model = create_model("lr", "synthetic", (FEAT,), N_CLASSES)
+
+    straight = ScaffoldAPI(cfg, data, model)
+    for r in range(4):
+        straight.train_round(r)
+
+    crashed = ScaffoldAPI(cfg, data, model)
+    for r in range(2):
+        crashed.train_round(r)
+    p = str(tmp_path / "ckpt")
+    save_checkpoint(
+        p, crashed.global_vars, round_idx=2,
+        algo_state=crashed.checkpoint_state(),
+    )
+
+    resumed = ScaffoldAPI(cfg, data, model)
+    loaded_vars, round_idx, _, _, algo_state = load_checkpoint(p)
+    from fedml_tpu.utils.checkpoint import restore_like
+
+    resumed.global_vars = restore_like(resumed.global_vars, loaded_vars)
+    assert algo_state is not None
+    resumed.restore_state(algo_state)
+    for r in range(int(round_idx), 4):
+        resumed.train_round(r)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.global_vars),
+        jax.tree_util.tree_leaves(resumed.global_vars),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+    np.testing.assert_allclose(
+        np.asarray(straight.c_server["linear"]["kernel"]),
+        np.asarray(resumed.c_server["linear"]["kernel"]),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_rejects_momentum_and_oversize_store():
+    data = _data()
+    cfg = dataclasses.replace(
+        _cfg(), train=TrainConfig(client_optimizer="sgd", lr=0.1, momentum=0.9)
+    )
+    model = create_model("lr", "synthetic", (FEAT,), N_CLASSES)
+    with pytest.raises(ValueError, match="plain-SGD"):
+        ScaffoldAPI(cfg, data, model)
+
+    class Tiny(ScaffoldAPI):
+        _MAX_STATE_BYTES = 16  # force the refusal path
+
+    with pytest.raises(ValueError, match="client-state store"):
+        Tiny(_cfg(), data, model)
