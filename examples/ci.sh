@@ -20,7 +20,7 @@ echo "== fast unit tier =="
 python -m pytest tests/ -q -m 'not slow' -x
 
 echo "== CLI smoke: one round per algorithm family (ref CI-script-fedavg.sh:33-39) =="
-for algo in fedavg fedopt fedprox fednova hierarchical fedavg_robust; do
+for algo in fedavg fedopt fedprox fednova scaffold hierarchical fedavg_robust; do
   python -m fedml_tpu --algorithm "$algo" --model lr --dataset synthetic \
     --client_num_in_total 8 --client_num_per_round 4 --comm_round 1 \
     --epochs 1 --ci > /dev/null
@@ -28,7 +28,7 @@ for algo in fedavg fedopt fedprox fednova hierarchical fedavg_robust; do
 done
 
 echo "== CLI smoke: mesh runtime (8-shard virtual farm) =="
-for algo in fedavg fedopt fednova fedavg_robust; do
+for algo in fedavg fedopt fednova scaffold fedavg_robust; do
   python -m fedml_tpu --algorithm "$algo" --runtime mesh --model lr \
     --dataset synthetic --client_num_in_total 8 --client_num_per_round 8 \
     --comm_round 1 --epochs 1 --ci > /dev/null

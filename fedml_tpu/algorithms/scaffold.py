@@ -228,6 +228,113 @@ def make_scaffold_round(
     return jax.jit(round_fn, donate_argnums=(2,) if donate else ())
 
 
+def make_sharded_scaffold_round(model: ModelDef, config: RunConfig, mesh, task: str = "classification", donate: bool = True):
+    """SCAFFOLD round over a client-sharded mesh (the reference has no
+    distributed SCAFFOLD at all — this is the shard_map form of the vmap
+    round above, same signature).
+
+    Sharding layout: the per-client control store ``c_stack`` stays
+    REPLICATED (cross-silo N × |params| fits every chip — SCAFFOLD's own
+    regime) while the sampled cohort's data and index vector shard over
+    the client axis. Each shard gathers its own clients' rows locally,
+    trains, and contributes:
+    - Δy via the same weighted psum as sharded FedAvg;
+    - Δc and the row updates via a psum of a zeros-scattered delta stack
+      (``.at[idx].add``): dummy padding clients train on all-zero masks,
+      end with c_i⁺ == c_i, and therefore contribute exact zeros.
+    c ← c + Σ Δc / N  (≡ the paper's (|S|/N)·mean over the real cohort,
+    with padded rows vanishing)."""
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    mode = resolve_client_parallelism(config.fed.client_parallelism, model)
+    local_train = make_scaffold_local_train(
+        model, config.train, config.fed.epochs, task=task
+    )
+    lifted = client_axis_map(local_train, mode, n_broadcast=2)
+    eta_g = config.server.server_lr
+    n_total = config.fed.client_num_in_total
+
+    def shard_body(global_vars, c_server, c_stack, idx, x, y, mask, num_samples, rngs):
+        varying = lambda t: jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(a, (axis,), to="varying"), t
+        )
+        gv = varying(global_vars)
+        cs = varying(c_server)
+        stack = varying(c_stack)
+        c_gather = jax.tree_util.tree_map(lambda a: a[idx], stack)
+        y_vars, c_new, metrics = lifted(gv, cs, c_gather, x, y, mask, rngs)
+
+        wsum = jax.lax.psum(jnp.sum(num_samples), axis)
+        w = num_samples / jnp.maximum(wsum, 1e-9)
+
+        def psum_avg_delta(stacked, g):
+            return jax.lax.psum(
+                jnp.tensordot(
+                    w,
+                    stacked.astype(jnp.float32) - g.astype(jnp.float32)[None],
+                    axes=1,
+                ),
+                axis,
+            )
+
+        new_params = jax.tree_util.tree_map(
+            lambda g, s: (
+                g.astype(jnp.float32) + eta_g * psum_avg_delta(s, g)
+            ).astype(g.dtype),
+            gv["params"], y_vars["params"],
+        )
+        new_global = {
+            k: (
+                new_params
+                if k == "params"
+                else jax.tree_util.tree_map(
+                    lambda s: jax.lax.psum(
+                        jnp.tensordot(w, s.astype(jnp.float32), axes=1), axis
+                    ),
+                    v,
+                )
+            )
+            for k, v in y_vars.items()
+        }
+        # Row updates travel as the gathered COHORT deltas (O(|S|·params)
+        # over ICI), not a zeros-scattered full stack (O(N·params) psum +
+        # a second full-stack temporary per shard — pathological when the
+        # population is much larger than the cohort).
+        delta = jax.tree_util.tree_map(
+            lambda new, old: new - old, c_new, c_gather
+        )
+        idx_all = jax.lax.all_gather(idx, axis, tiled=True)
+        delta_all = jax.tree_util.tree_map(
+            lambda d: jax.lax.all_gather(d, axis, tiled=True), delta
+        )
+        # c ← c + Σ Δc / N (dummy padding rows are exact zeros)
+        c_server_new = jax.tree_util.tree_map(
+            lambda c, d: c + jnp.sum(d, axis=0) / n_total, cs, delta_all
+        )
+        c_stack_new = jax.tree_util.tree_map(
+            lambda stack_l, d: stack_l.at[idx_all].add(d), stack, delta_all
+        )
+        agg = jax.tree_util.tree_map(
+            lambda m: jax.lax.psum(jnp.sum(m), axis), metrics
+        )
+        return new_global, c_server_new, c_stack_new, agg
+
+    data_spec = P(axis)
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(), P()) + (data_spec,) * 6,
+        out_specs=(P(), P(), P(), P()),
+        # every output is a psum-combined value, replicated by construction;
+        # the custom-VJP norm ops inside local_train defeat static VMA
+        # inference (same situation as parallel/long_context.py) — the
+        # mesh-invariance test pins sharded == single-chip bitwise-close
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,) if donate else ())
+
+
 class ScaffoldAPI(FedAvgAPI):
     """SCAFFOLD simulator on the FedAvg skeleton — adds the server control
     variate and the stacked on-device per-client control store."""
@@ -256,14 +363,22 @@ class ScaffoldAPI(FedAvgAPI):
         self.c_stack = jax.tree_util.tree_map(
             lambda p: jnp.zeros((n,) + p.shape, jnp.float32), params
         )
+        self._scaffold_round = self._build_scaffold_round()
+
+    def _build_scaffold_round(self):
         # donate the c_stack (argnum 2): train_round keeps no alias to the
         # pre-round stack, and without donation every round would hold TWO
         # full N×|params| copies while .at[idx].set builds the new one —
         # exactly the thrashing the _MAX_STATE_BYTES cap exists to prevent
-        self._scaffold_round = make_scaffold_round(
-            model, config, task=self.task, donate=True,
+        return make_scaffold_round(
+            self.model, self.config, task=self.task, donate=True,
             client_mode=self._client_mode,
         )
+
+    def _place_client_indices(self, sampled):
+        """The sampled client ids as the round fn's gather/scatter index
+        vector — the sharded subclass pads to the mesh and shards it."""
+        return jnp.asarray(np.asarray(sampled, np.int32))
 
     def _build_round_fn(self, local_train_fn):
         return None  # unused — train_round is fully overridden
@@ -296,7 +411,7 @@ class ScaffoldAPI(FedAvgAPI):
             self.global_vars,
             self.c_server,
             self.c_stack,
-            jnp.asarray(np.asarray(sampled, np.int32)),
+            self._place_client_indices(sampled),
             *self._place_batch(batch, rng),
         )
         return sampled, metrics

@@ -507,7 +507,15 @@ def _restore(api, opt):
     # Algorithm-private state (SCAFFOLD control variates): without this a
     # resumed run silently degenerates to FedAvg until the variates
     # re-learn, breaking the identical-continuation contract above.
-    if algo_state is not None and hasattr(api, "restore_state"):
+    if hasattr(api, "restore_state"):
+        if algo_state is None:
+            raise click.UsageError(
+                "checkpoint has no algorithm state but "
+                f"{type(api).__name__} needs it to resume faithfully — "
+                "it was written by an older version or a different "
+                "algorithm; restarting from round 0 is the only sound "
+                "continuation"
+            )
         api.restore_state(algo_state)
 
 
@@ -580,6 +588,12 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
             return DistributedFedNovaAPI(
                 config, data, model, task=task, log_fn=log_fn
             )
+        if algorithm == "scaffold":
+            from fedml_tpu.parallel import DistributedScaffoldAPI
+
+            return DistributedScaffoldAPI(
+                config, data, model, task=task, log_fn=log_fn
+            )
         if algorithm == "hierarchical":
             from fedml_tpu.parallel import HierarchicalShardedAPI
 
@@ -590,7 +604,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         if algorithm not in ("fedavg", "fedprox"):
             raise click.UsageError(
                 "runtime=mesh currently supports fedavg/fedprox/fedopt/"
-                "fednova/hierarchical/fedavg_robust"
+                "fednova/scaffold/hierarchical/fedavg_robust"
             )
         return DistributedFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
 

@@ -12,6 +12,7 @@ from fedml_tpu.parallel.fedavg_sharded import (
     make_sharded_fedavg_round,
     DistributedFedAvgAPI,
     DistributedFedNovaAPI,
+    DistributedScaffoldAPI,
     DistributedFedOptAPI,
     RobustDistributedFedAvgAPI,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "make_sharded_fedavg_round",
     "DistributedFedAvgAPI",
     "DistributedFedNovaAPI",
+    "DistributedScaffoldAPI",
     "DistributedFedOptAPI",
     "RobustDistributedFedAvgAPI",
     "make_tp_train_step",

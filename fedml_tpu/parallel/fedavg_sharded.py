@@ -33,6 +33,7 @@ from fedml_tpu.algorithms.fedavg import (
 )
 from fedml_tpu.algorithms.fednova import FedNovaAPI
 from fedml_tpu.algorithms.fedopt import FedOptAPI
+from fedml_tpu.algorithms.scaffold import ScaffoldAPI
 from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import ClientBatch, FederatedDataset
 from fedml_tpu.models import ModelDef
@@ -253,6 +254,33 @@ class DistributedFedNovaAPI(FedNovaAPI, DistributedFedAvgAPI):
             local_train_fn=local_train_fn,
             donate=self._donate,
         )
+
+
+class DistributedScaffoldAPI(ScaffoldAPI, DistributedFedAvgAPI):
+    """SCAFFOLD on the multi-chip mesh runtime (no reference counterpart —
+    its SCAFFOLD doesn't exist at all; SURVEY §2b inventories FedNova as
+    the closest). Cooperative MRO: DistributedFedAvgAPI supplies the mesh
+    bootstrap and sharded batch placement; ScaffoldAPI supplies the
+    control-variate state and train_round; this class swaps in the
+    shard_map round and shards the gather/scatter index vector."""
+
+    def _build_scaffold_round(self):
+        from fedml_tpu.algorithms.scaffold import make_sharded_scaffold_round
+
+        return make_sharded_scaffold_round(
+            self.model, self.config, self.mesh, task=self.task
+        )
+
+    def _place_client_indices(self, sampled):
+        # pad to the mesh exactly like pad_client_batch pads the data:
+        # dummy rows point at client 0 but their Δ-rows are exact zeros
+        # (all-zero masks -> c_i⁺ == c_i), so the scatter-add ignores them
+        n = len(sampled)
+        rem = n % self.n_shards
+        padded = n + (self.n_shards - rem if rem else 0)
+        idx = np.zeros((padded,), np.int32)
+        idx[:n] = np.asarray(sampled, np.int32)
+        return jax.device_put(idx, self._data_sharding)
 
 
 class DistributedFedOptAPI(FedOptAPI, DistributedFedAvgAPI):

@@ -200,6 +200,53 @@ def test_checkpoint_resume_preserves_control_variates(tmp_path):
     )
 
 
+def test_mesh_scaffold_matches_vmap():
+    """DistributedScaffoldAPI (shard_map over a client mesh, replicated
+    control store, psum-scattered row updates) == the single-chip
+    simulator at the same seed — params, c_server, AND every c_i row.
+    Includes a non-divisible cohort (6 clients over 8 shards… padded), so
+    the dummy-client zero-delta path is exercised."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from fedml_tpu.parallel import DistributedScaffoldAPI
+
+    data = synthetic_classification(
+        num_clients=8, num_classes=N_CLASSES, feat_shape=(FEAT,),
+        samples_per_client=16, partition_method="hetero", ragged=False,
+        seed=3,
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=4, pad_bucket=1),
+        fed=FedConfig(
+            client_num_in_total=8, client_num_per_round=6, comm_round=3,
+            epochs=2, frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        model="lr",
+    )
+    model = create_model("lr", "synthetic", (FEAT,), N_CLASSES)
+    sim = ScaffoldAPI(cfg, data, model)
+    mesh_api = DistributedScaffoldAPI(cfg, data, model)
+    for r in range(cfg.fed.comm_round):
+        _, m_sim = sim.train_round(r)
+        _, m_mesh = mesh_api.train_round(r)
+        np.testing.assert_allclose(
+            float(m_sim["loss_sum"]), float(m_mesh["loss_sum"]), rtol=1e-5
+        )
+    for name, a, b in (
+        ("params", sim.global_vars, mesh_api.global_vars),
+        ("c_server", sim.c_server, mesh_api.c_server),
+        ("c_stack", sim.c_stack, mesh_api.c_stack),
+    ):
+        for x, y in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-5,
+                err_msg=name,
+            )
+
+
 def test_rejects_momentum_and_oversize_store():
     data = _data()
     cfg = dataclasses.replace(
