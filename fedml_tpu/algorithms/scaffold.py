@@ -239,9 +239,11 @@ def make_sharded_scaffold_round(model: ModelDef, config: RunConfig, mesh, task: 
     the client axis. Each shard gathers its own clients' rows locally,
     trains, and contributes:
     - Δy via the same weighted psum as sharded FedAvg;
-    - Δc and the row updates via a psum of a zeros-scattered delta stack
-      (``.at[idx].add``): dummy padding clients train on all-zero masks,
-      end with c_i⁺ == c_i, and therefore contribute exact zeros.
+    - the cohort's (idx, Δc) rows via ``all_gather`` — O(|S|·params)
+      over ICI, NOT an O(N·params) zeros-scattered stack psum — followed
+      by one in-place ``.at[idx_all].add`` on the replicated store.
+      Dummy padding clients train on all-zero masks, end with
+      c_i⁺ == c_i, and therefore contribute exact zeros.
     c ← c + Σ Δc / N  (≡ the paper's (|S|/N)·mean over the real cohort,
     with padded rows vanishing)."""
     from jax.sharding import PartitionSpec as P
