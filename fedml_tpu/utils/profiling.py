@@ -123,7 +123,7 @@ def scan_slope_seconds(step_fn, init_carry, k1: int = 1, k2: int = 5, reps: int 
     for k in (k1, k2):  # compile both shapes outside the timing
         fetch(jrep(init_carry, jnp.arange(k)))
     slopes = []
-    for _ in range(3 * reps):  # allow retries when pairs straddle a switch
+    for _ in range(reps + 3):  # a few retries when pairs straddle a switch
         slope = (timed(k2) - timed(k1)) / (k2 - k1)
         if slope > 0:
             slopes.append(slope)
