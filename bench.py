@@ -389,18 +389,20 @@ def _run_to_target(api, target, max_rounds, eval_every, stop_on_reach=True):
     truncated horizon, NOT comparable across algorithms."""
     curve = {}
     reached_at = None
+    prev_at_target = False
     for r in range(max_rounds):
         api.train_round(r)
         if (r + 1) % eval_every == 0:
             _, acc = api.evaluate_global()
             curve[r + 1] = round(float(acc), 4)
-            if acc >= target:
-                if reached_at is None:
-                    reached_at = r + 1
-                elif stop_on_reach and (r + 1) > reached_at:
-                    break  # confirmed: two consecutive evals >= target
-            # a dip back below target keeps training (reached_at stands —
-            # rounds-to-target is the first crossing, per convention)
+            at_target = acc >= target
+            if at_target and reached_at is None:
+                # rounds-to-target is the FIRST crossing, per convention;
+                # the confirmation below only gates the early stop
+                reached_at = r + 1
+            if stop_on_reach and at_target and prev_at_target:
+                break  # confirmed: two CONSECUTIVE evals >= target
+            prev_at_target = at_target  # a dip resets the confirmation
     return {
         "target": target,
         "reached": reached_at is not None,
@@ -577,13 +579,44 @@ def _scale_100k(num_clients=100_000, timed_rounds=20):
 def main():
     import jax
 
+    # The driver gives one shot at this script and a timeout loses the
+    # whole record, so the optional sections check the remaining wall
+    # budget BEFORE starting and degrade to a self-describing skipped row.
+    # This is a pre-start heuristic, not a hard guarantee: the mandatory
+    # rows (north-star, cross-silo) are unguarded, and a section that
+    # stalls mid-flight can still overrun — the per-section estimates and
+    # the accuracy-run early stop are the mitigation, the budget default
+    # leaves headroom under the observed ~45-min full pass.
+    t0 = time.perf_counter()
+    budget_s = float(os.environ.get("FEDML_TPU_BENCH_BUDGET_S", 2100))
+
+    def _with_budget(name, fn, fallback, min_remaining_s):
+        if time.perf_counter() - t0 > budget_s - min_remaining_s:
+            return fallback(
+                f"skipped {name}: {round(time.perf_counter() - t0)}s elapsed "
+                f"of {round(budget_s)}s budget, section needs "
+                f"~{min_remaining_s}s"
+            )
+        return fn()
+
     north_fp32 = _throughput_row(_north_star_api("float32"), 3, 40, "north_star")
     north_bf16 = _throughput_row(_north_star_api("bfloat16"), 3, 40, "north_star")
-    eager_loop, fused_loop = _trainloop_rows("bfloat16")
     bf16 = _bf16_cross_silo()
-    scale = _scale_100k()
-    syn_rows, separated = _hard_synthetic11()
-    lda_rows, parity_row = _hard_femnist_lda()
+    eager_loop, fused_loop = _with_budget(
+        "trainloop", lambda: _trainloop_rows("bfloat16"),
+        lambda why: ({"skipped": why}, None), 240,
+    )
+    scale = _with_budget(
+        "scale", _scale_100k, lambda why: {"skipped": why}, 180,
+    )
+    syn_rows, separated = _with_budget(
+        "synthetic11", _hard_synthetic11,
+        lambda why: ([{"skipped": why}], None), 600,
+    )
+    lda_rows, parity_row = _with_budget(
+        "femnist_lda", _hard_femnist_lda,
+        lambda why: ([{"skipped": why}], {"skipped": why}), 700,
+    )
 
     rows = {
         "eager_fp32": north_fp32,
@@ -592,7 +625,7 @@ def main():
         "trainloop_fused_bf16": fused_loop,
     }
     best_name, best = max(
-        ((k, v) for k, v in rows.items() if v),
+        ((k, v) for k, v in rows.items() if v and "rounds_per_sec" in v),
         key=lambda kv: kv[1]["rounds_per_sec"],
     )
     headline = best["rounds_per_sec"]
@@ -618,7 +651,7 @@ def main():
                     round(
                         fused_loop["rounds_per_sec"] / eager_loop["rounds_per_sec"], 3
                     )
-                    if fused_loop
+                    if fused_loop and "rounds_per_sec" in eager_loop
                     else None
                 ),
                 "fused_note": None if not fused_loop else (
