@@ -576,7 +576,65 @@ def _scale_100k(num_clients=100_000, timed_rounds=20):
     }
 
 
+def _backend_alive(timeout_s: float = 300.0):
+    """Probe jax backend init in a SUBPROCESS with a hard timeout.
+    Observed failure mode (round 3): when the remote TPU tunnel is down,
+    the axon backend init HANGS indefinitely rather than erroring —
+    probing in-process would hang this script past the driver's timeout
+    and lose the whole benchmark record. Returns ``(alive, why)``.
+
+    The probe runs in its own session and the whole process GROUP is
+    killed on timeout (a hung init may have spawned helpers inheriting
+    the stderr pipe; killing only the direct child would leave
+    communicate() blocked on the grandchild — the exact hang this guard
+    exists to prevent). Cost on a healthy backend: one extra device init
+    (~20-40s through the tunnel), paid inside the budget clock."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    p = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        start_new_session=True,
+    )
+    try:
+        _, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        os.killpg(p.pid, signal.SIGKILL)
+        p.wait()
+        return False, (
+            f"device init hung >{round(timeout_s)}s (remote TPU tunnel "
+            "down, or an init slow-window longer than the probe timeout)"
+        )
+    if p.returncode == 0:
+        return True, ""
+    tail = (err or b"").decode("utf-8", "replace").strip().splitlines()
+    return False, "backend init failed: " + ("; ".join(tail[-2:]) or "no stderr")[-300:]
+
+
 def main():
+    t0 = time.perf_counter()  # the probe below counts against the budget
+    alive, why = _backend_alive()
+    if not alive:
+        print(
+            json.dumps(
+                {
+                    "metric": "femnist_cnn_fedavg_rounds_per_sec",
+                    "value": None,
+                    "unit": "rounds/sec",
+                    "error": (
+                        f"no measurements possible this run: {why}. Last "
+                        "recorded full pass: BENCH_r02.json / "
+                        "docs/ROUND3.md headline."
+                    ),
+                }
+            )
+        )
+        return
+
     import jax
 
     # The driver gives one shot at this script and a timeout loses the
@@ -586,8 +644,8 @@ def main():
     # rows (north-star, cross-silo) are unguarded, and a section that
     # stalls mid-flight can still overrun — the per-section estimates and
     # the accuracy-run early stop are the mitigation, the budget default
-    # leaves headroom under the observed ~45-min full pass.
-    t0 = time.perf_counter()
+    # leaves headroom under the observed ~45-min full pass. t0 was set
+    # before the backend probe, so the probe's cost is inside the budget.
     budget_s = float(os.environ.get("FEDML_TPU_BENCH_BUDGET_S", 2100))
 
     def _with_budget(name, fn, fallback, min_remaining_s):
