@@ -505,6 +505,70 @@ def _hard_femnist_lda():
     return rows, parity_row
 
 
+def _mxu_validation():
+    """Framework-ceiling validation (PERF_R3.md §2 finding 3): the
+    cross-silo ResNet-56 bf16 MFU is bounded by that model's 16/32-channel
+    stages under-tiling the 128-lane MXU, not by the round runtime. Run
+    the SAME production FedAvg round at bf16 on two MXU-friendly models —
+    ResNet-18-GN (64..512-channel stages, ref model/cv/resnet_gn.py) and
+    the transformer LM (512-wide matmuls + an 8k-vocab head) — and report
+    device-time MFU. High numbers here pin the ResNet-56 gap on the
+    architecture's channel widths."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import (
+        synthetic_classification,
+        synthetic_shakespeare,
+    )
+    from fedml_tpu.models import create_model
+
+    def cfg(batch_size, n_clients):
+        return RunConfig(
+            data=DataConfig(batch_size=batch_size, pad_bucket=1),
+            fed=FedConfig(
+                client_num_in_total=n_clients,
+                client_num_per_round=n_clients,
+                comm_round=1,
+                epochs=1,
+                frequency_of_the_test=10_000,
+            ),
+            train=TrainConfig(
+                client_optimizer="sgd", lr=0.1, compute_dtype="bfloat16"
+            ),
+            seed=0,
+        )
+
+    rows = {}
+    data = synthetic_classification(
+        num_clients=4, num_classes=100, feat_shape=(32, 32, 3),
+        samples_per_client=512, partition_method="homo", ragged=False, seed=0,
+    )
+    model = create_model("resnet18_gn", "cifar100", (32, 32, 3), 100)
+    api = FedAvgAPI(cfg(256, 4), data, model)
+    rows["resnet18_gn_bf16"] = _throughput_row(
+        api, warmup=1, timed=3, label="mxu_resnet18_gn"
+    )
+
+    data = synthetic_shakespeare(
+        num_clients=4, samples_per_client=64, seq_len=256, vocab_size=8192,
+        seed=0, seq_targets=True,
+    )
+    model = create_model(
+        "transformer", "shakespeare_synth", (256,), 8192,
+        num_layers=4, num_heads=8, embed_dim=512,
+    )
+    api = FedAvgAPI(cfg(16, 4), data, model, task="nwp")
+    rows["transformer_lm_bf16"] = _throughput_row(
+        api, warmup=1, timed=3, label="mxu_transformer_lm"
+    )
+    rows["note"] = (
+        "same production round runtime as the ResNet-56 row; MFU tracks "
+        "the model's MXU tiling (ResNet-56's 16/32-channel stages "
+        "under-tile the 128-lane MXU — PERF_R3.md §2)"
+    )
+    return rows
+
+
 def _scale_100k(num_clients=100_000, timed_rounds=20):
     """100k-client StackOverflow-geometry run off the mmap store
     (VERDICT r2 Next #4; ref benchmark/README.md:57 = 342,477 clients).
@@ -603,7 +667,10 @@ def _backend_alive(timeout_s: float = 300.0):
     try:
         _, err = p.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        os.killpg(p.pid, signal.SIGKILL)
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass  # group died between the timeout and the kill
         p.wait()
         return False, (
             f"device init hung >{round(timeout_s)}s (remote TPU tunnel "
@@ -667,6 +734,9 @@ def main():
     scale = _with_budget(
         "scale", _scale_100k, lambda why: {"skipped": why}, 180,
     )
+    mxu = _with_budget(
+        "mxu_validation", _mxu_validation, lambda why: {"skipped": why}, 240,
+    )
     syn_rows, separated = _with_budget(
         "synthetic11", _hard_synthetic11,
         lambda why: ([{"skipped": why}], None), 600,
@@ -723,6 +793,7 @@ def main():
                     "hidden by an async queue."
                 ),
                 "bf16_cross_silo_resnet56": bf16,
+                "mxu_validation": mxu,
                 "scale_100k_clients": scale,
                 "hard_accuracy": {
                     "synthetic11": syn_rows,
