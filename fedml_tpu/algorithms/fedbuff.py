@@ -1,0 +1,332 @@
+"""Asynchronous buffered federated aggregation (FedBuff) over the
+Message/Observer transport — beyond the reference, which has NO async path:
+its aggregator barrier waits for every worker forever
+(ref FedAVGAggregator.py:43-49; SURVEY §5 "no straggler mitigation, no
+client-dropout tolerance"), so one slow device rate-limits the fleet. The
+sync transport here already softens that with deadline/quorum rounds
+(fedavg_transport.py); this module removes the barrier entirely.
+
+Protocol (the buffered-async scheme of Nguyen et al., AISTATS 2022 —
+public algorithm, implemented fresh):
+
+- the server keeps a model VERSION counter ``t`` and a buffer of client
+  deltas. There are no rounds and no barrier.
+- every client upload is answered IMMEDIATELY with the current model and
+  a fresh client assignment — workers never idle waiting for each other,
+  so a slow worker costs only its own throughput (its eventual update is
+  staleness-discounted, not waited for).
+- a client trains from the version-``b`` model and uploads
+  ``delta = w_local - w_b`` tagged with ``b``; staleness is
+  ``tau = t - b``.
+- when the buffer holds ``k = FedConfig.async_buffer_k`` deltas the
+  server applies one step (``apply_buffered_update``):
+
+      w  <-  w + eta_g * sum_i s(tau_i) d_i / sum_i s(tau_i),
+      s(tau) = (1 + tau) ** -async_staleness_exp
+
+  and advances ``t``. ``FedConfig.comm_round`` counts these server steps.
+
+TPU stance (SURVEY §7 "async/cross-silo boundary"): the jitted programs
+stay pure — the client runs the same compiled local-train scan as the
+sync path, the server step is one jitted stacked-tree contraction — and
+ALL asynchrony lives in the host-side actor loop, which is exactly the
+transport layer the Observer pattern already gives us.
+
+Degenerate-config oracle (tests/test_fedbuff.py): with every delta at
+staleness 0, eta_g=1 and k uploads from equal-sized shards, one buffered
+step equals the synchronous FedAvg average of the k local models.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.config import RunConfig
+from fedml_tpu.core.comm import BaseCommManager
+from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+from fedml_tpu.core.managers import ClientManager, ServerManager
+from fedml_tpu.core.message import Message, MessageType as MT
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.models import ModelDef
+from fedml_tpu.algorithms.fedavg_transport import LocalTrainer
+from fedml_tpu.train.client import make_local_train
+from fedml_tpu.train.evaluate import evaluate, make_eval_fn
+
+
+def staleness_weight(tau, exp: float = 0.5):
+    """Polynomial staleness discount s(tau) = (1+tau)^-exp; s(0) = 1."""
+    return (1.0 + jnp.asarray(tau, jnp.float32)) ** (-exp)
+
+
+def apply_buffered_update(global_vars, deltas: list, taus, eta_g: float, exp: float):
+    """One buffered server step: staleness-weighted mean of client deltas
+    applied to the global model. Pure — jit/oracle-testable independent of
+    the transport machinery."""
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]), *deltas
+    )
+    w = staleness_weight(jnp.asarray(taus, jnp.float32), exp)
+    w = w / jnp.sum(w)
+
+    def upd(g, d):
+        g = jnp.asarray(g)
+        mean = jnp.tensordot(w, d.astype(jnp.float32), axes=1)
+        return (g + eta_g * mean).astype(g.dtype)
+
+    return jax.tree_util.tree_map(upd, global_vars, stacked)
+
+
+class FedBuffServerManager(ServerManager):
+    """Barrier-free server: buffer deltas, flush every k, always answer an
+    upload with the current model + a new client assignment."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        comm: BaseCommManager,
+        model: ModelDef,
+        data: Optional[FederatedDataset] = None,
+        task: str = "classification",
+        worker_num: Optional[int] = None,
+        log_fn=None,
+    ):
+        super().__init__(comm, rank=0)
+        if config.fed.async_buffer_k <= 0:
+            raise ValueError("FedBuff requires FedConfig.async_buffer_k > 0")
+        self.config = config
+        self.model = model
+        self.data = data
+        self.task = task
+        self.log_fn = log_fn or (lambda m: None)
+        self.worker_num = worker_num or config.fed.client_num_per_round
+        self.version = 0  # server model version t
+        self.server_steps = 0  # buffer flushes so far
+        self._dispatch_counter = 0
+        self._buffer: List[dict] = []
+        self._buffer_taus: List[int] = []
+        self._finished = False
+        self._dead_workers: set = set()
+        self._lock = threading.Lock()
+        self.staleness_seen: List[int] = []  # one entry per buffered delta
+        self.global_vars = jax.device_get(
+            model.init(jax.random.fold_in(jax.random.PRNGKey(config.seed), 0))
+        )
+        self.history: List[dict] = []
+        self._eval_fn = make_eval_fn(model, task) if data is not None else None
+
+    # -- dispatch --
+    def _next_client_index(self) -> int:
+        """Seeded assignment stream (the async analog of the sync path's
+        round-seeded client_sampling, ref FedAVGAggregator.py:80-88)."""
+        rng = np.random.default_rng(
+            self.config.seed * 1_000_003 + self._dispatch_counter
+        )
+        self._dispatch_counter += 1
+        return int(rng.integers(0, self.config.fed.client_num_in_total))
+
+    def _dispatch(self, worker: int, msg_type: str = MT.S2C_SYNC_MODEL):
+        if worker in self._dead_workers:
+            return
+        msg = Message(msg_type, 0, worker)
+        msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
+        msg.add_params(MT.ARG_CLIENT_INDEX, self._next_client_index())
+        msg.add_params(MT.ARG_BASE_VERSION, self.version)
+        # ARG_ROUND_IDX doubles as the batch-shuffle seed on the client
+        msg.add_params(MT.ARG_ROUND_IDX, self._dispatch_counter)
+        try:
+            self.send_message(msg)
+        except Exception as e:  # noqa: BLE001 — transport errors vary by backend
+            self._dead_workers.add(worker)
+            logging.warning("async dispatch to worker %d failed (%s)", worker, e)
+
+    def send_init_msg(self):
+        for worker in range(1, self.worker_num + 1):
+            self._dispatch(worker, MT.S2C_INIT_CONFIG)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MT.C2S_SEND_MODEL, self._on_delta_from_client
+        )
+
+    # -- aggregation --
+    def _on_delta_from_client(self, msg: Message):
+        with self._lock:
+            if self._finished:
+                return
+            self._dead_workers.discard(msg.get_sender_id())
+            delta = msg.get(MT.ARG_ASYNC_DELTA)
+            base = msg.get(MT.ARG_BASE_VERSION, -1)
+            if delta is None or base < 0:
+                logging.warning(
+                    "async server dropping malformed upload from sender %s "
+                    "(missing delta or base version — sync-protocol client?)",
+                    msg.get_sender_id(),
+                )
+                return
+            tau = self.version - int(base)
+            self._buffer.append(delta)
+            self._buffer_taus.append(tau)
+            self.staleness_seen.append(tau)
+            if len(self._buffer) >= self.config.fed.async_buffer_k:
+                self._flush()
+            if not self._finished:
+                self._dispatch(msg.get_sender_id())
+
+    def _flush(self):
+        """Apply one buffered server step; caller holds _lock."""
+        fed = self.config.fed
+        taus = list(self._buffer_taus)
+        self.global_vars = jax.device_get(
+            apply_buffered_update(
+                self.global_vars,
+                self._buffer,
+                taus,
+                fed.async_server_lr,
+                fed.async_staleness_exp,
+            )
+        )
+        self._buffer, self._buffer_taus = [], []
+        self.version += 1
+        self.server_steps += 1
+        row = {
+            "server_step": self.server_steps,
+            "version": self.version,
+            "staleness_mean": float(np.mean(taus)),
+            "staleness_max": int(np.max(taus)),
+        }
+        if self.data is not None and (
+            self.server_steps % self.config.fed.frequency_of_the_test == 0
+            or self.server_steps == fed.comm_round
+        ):
+            loss, acc = evaluate(
+                self.model,
+                self.global_vars,
+                self.data.test_x,
+                self.data.test_y,
+                task=self.task,
+                eval_fn=self._eval_fn,
+            )
+            row["Test/Loss"], row["Test/Acc"] = loss, acc
+        self.history.append(row)
+        self.log_fn(row)
+        if self.server_steps >= fed.comm_round:
+            self._finished = True
+            for worker in range(1, self.worker_num + 1):
+                try:
+                    self.send_message(Message(MT.FINISH, 0, worker))
+                except Exception:  # noqa: BLE001 — dead peer at shutdown
+                    pass
+            self.finish()
+
+
+class FedBuffClientManager(ClientManager):
+    """Train-on-arrival worker: every received model is trained from and
+    answered with a delta; FINISH ends the loop. Runs the SAME jitted
+    local-train scan as the sync transport client."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        comm: BaseCommManager,
+        rank: int,
+        trainer: LocalTrainer,
+    ):
+        super().__init__(comm, rank)
+        self.config = config
+        self.trainer = trainer
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MT.S2C_INIT_CONFIG, self._on_model)
+        self.register_message_receive_handler(MT.S2C_SYNC_MODEL, self._on_model)
+        self.register_message_receive_handler(MT.FINISH, lambda m: self.finish())
+
+    def _on_model(self, msg: Message):
+        self.trainer.update_dataset(msg.get(MT.ARG_CLIENT_INDEX))
+        w_base = msg.get(MT.ARG_MODEL_PARAMS)
+        new_vars, n = self.trainer.train(msg.get(MT.ARG_ROUND_IDX), w_base)
+        delta = jax.tree_util.tree_map(
+            lambda a, b: np.asarray(a) - np.asarray(b), new_vars, w_base
+        )
+        out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
+        out.add_params(MT.ARG_ASYNC_DELTA, delta)
+        out.add_params(MT.ARG_NUM_SAMPLES, n)
+        out.add_params(MT.ARG_BASE_VERSION, msg.get(MT.ARG_BASE_VERSION))
+        self.send_message(out)
+
+
+def run_fedbuff_federation(
+    config: RunConfig,
+    data: FederatedDataset,
+    model: ModelDef,
+    comm_factory,
+    task: str = "classification",
+    log_fn=None,
+):
+    """One-process async federation: 1 server + worker_num client actors in
+    threads over any BaseCommManager (structure mirrors
+    fedavg_transport.run_federation)."""
+    K = config.fed.client_num_per_round
+    server = FedBuffServerManager(
+        config, comm_factory(0), model, data=data, task=task,
+        worker_num=K, log_fn=log_fn,
+    )
+    shared_train = jax.jit(
+        make_local_train(model, config.train, config.fed.epochs, task=task)
+    )
+    clients = [
+        FedBuffClientManager(
+            config,
+            comm_factory(rank),
+            rank,
+            LocalTrainer(config, data, model, task, local_train_fn=shared_train),
+        )
+        for rank in range(1, K + 1)
+    ]
+    errors: List[BaseException] = []
+
+    def guarded_run(c):
+        try:
+            c.run()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            server.finish()
+
+    threads = [
+        threading.Thread(target=guarded_run, args=(c,), daemon=True)
+        for c in clients
+    ]
+    for t in threads:
+        t.start()
+    server.send_init_msg()
+    server.run()  # blocks until the last server step or a client failure
+    if errors:
+        for c in clients:
+            c.finish()
+        raise RuntimeError("async client actor failed") from errors[0]
+    for c in clients:
+        c.finish()  # idempotent: unblocks any worker still parked on its inbox
+    for t in threads:
+        t.join(timeout=60)
+        if t.is_alive():
+            raise RuntimeError("async client thread failed to finish")
+    return server
+
+
+def run_fedbuff_loopback(
+    config: RunConfig,
+    data: FederatedDataset,
+    model: ModelDef,
+    task: str = "classification",
+    log_fn=None,
+):
+    hub = LoopbackHub()
+    return run_fedbuff_federation(
+        config, data, model, lambda rank: LoopbackCommManager(hub, rank),
+        task=task, log_fn=log_fn,
+    )

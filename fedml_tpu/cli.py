@@ -42,6 +42,7 @@ ALGORITHMS = (
     "fedprox",
     "fednova",
     "scaffold",  # beyond the reference: control-variate drift correction
+    "fedbuff",  # beyond the reference: barrier-free async aggregation
     "hierarchical",
     "fedavg_robust",
     "fedgkt",
@@ -134,6 +135,14 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="How one chip runs the sampled clients: vmap (batched) "
                    "or scan (sequential — faster for conv models whose "
                    "small channels under-tile the MXU); auto picks per model")
+@click.option("--async_buffer_k", type=int, default=10,
+              help="algorithm=fedbuff: server applies one staleness-"
+                   "weighted step whenever this many client deltas have "
+                   "buffered (no round barrier; comm_round counts steps)")
+@click.option("--staleness_exp", type=float, default=0.5,
+              help="algorithm=fedbuff: staleness discount (1+tau)^-exp")
+@click.option("--async_server_lr", type=float, default=1.0,
+              help="algorithm=fedbuff: global step scale eta_g")
 @click.option("--enable_wandb", is_flag=True, default=False,
               help="Start a wandb run and mirror metric rows to it (ref "
                    "main_fedavg.py:93-108); no-op if wandb is not installed")
@@ -169,6 +178,18 @@ def main(**opt):
     run(**opt)
 
 
+def _checked_buffer_k(opt) -> int:
+    """fedbuff's buffer size, validated at parse time (a 0/negative k would
+    otherwise surface as a mid-run ValueError after data/model setup); 0
+    for every synchronous algorithm."""
+    if opt["algorithm"] != "fedbuff":
+        return 0
+    k = opt.get("async_buffer_k", 10)
+    if k <= 0:
+        raise click.UsageError("--algorithm fedbuff needs --async_buffer_k > 0")
+    return k
+
+
 def build_config(opt) -> RunConfig:
     return RunConfig(
         data=DataConfig(
@@ -193,6 +214,9 @@ def build_config(opt) -> RunConfig:
             deadline_s=opt.get("deadline_s", 0.0),
             min_clients=opt.get("min_clients", 1),
             client_parallelism=opt.get("client_parallelism", "auto"),
+            async_buffer_k=_checked_buffer_k(opt),
+            async_staleness_exp=opt.get("staleness_exp", 0.5),
+            async_server_lr=opt.get("async_server_lr", 1.0),
         ),
         train=TrainConfig(
             client_optimizer=opt["client_optimizer"],
@@ -534,9 +558,34 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         multi_krum_m=multi_krum_m,
     )
     if runtime in ("loopback", "mqtt", "shm"):
+        if algorithm == "fedbuff":
+            from fedml_tpu.algorithms.fedbuff import run_fedbuff_loopback
+
+            if runtime != "loopback":
+                raise click.UsageError(
+                    "fedbuff currently runs over --runtime loopback (the "
+                    "async FSM is transport-generic; mqtt/shm wiring is the "
+                    "same comm_factory plumbing)"
+                )
+
+            class _AsyncRunner:
+                global_vars = None
+                server_opt_state = None
+                start_round = 0
+
+                def train(self):
+                    server = run_fedbuff_loopback(
+                        config, data, model, task=task, log_fn=log_fn,
+                    )
+                    _AsyncRunner.global_vars = server.global_vars
+                    self.global_vars = server.global_vars
+                    return server.history[-1] if server.history else {}
+
+            return _AsyncRunner()
         if algorithm not in ("fedavg", "fedprox", "fedopt"):
             raise click.UsageError(
-                f"runtime={runtime} supports fedavg/fedprox/fedopt"
+                f"runtime={runtime} supports fedavg/fedprox/fedopt (and "
+                "fedbuff over loopback)"
             )
         from fedml_tpu.algorithms.fedavg_transport import (
             run_loopback_federation,
@@ -569,6 +618,11 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
 
         return _Runner()
 
+    if algorithm == "fedbuff":
+        raise click.UsageError(
+            "algorithm=fedbuff is an async TRANSPORT protocol — run it "
+            "with --runtime loopback"
+        )
     if runtime == "mesh":
         from fedml_tpu.parallel import DistributedFedAvgAPI, DistributedFedOptAPI
 

@@ -68,6 +68,20 @@ class FedConfig:
     # (ref _local_test_on_all_clients, fedavg_api.py:117-180) instead of the
     # central test set.
     eval_on_clients: bool = False
+    # Asynchronous buffered aggregation knobs, consumed by the FedBuff
+    # runtime (algorithms/fedbuff.py, selected via the fedbuff entry
+    # points / CLI --algorithm fedbuff — beyond the reference, whose
+    # aggregator barrier waits for every worker forever,
+    # FedAVGAggregator.py:43-49). Under FedBuff the server never barriers:
+    # every upload is answered immediately with the current model, and the
+    # global model advances whenever the buffer holds async_buffer_k client
+    # deltas, each discounted by staleness (1+tau)^(-async_staleness_exp)
+    # and scaled by async_server_lr. comm_round then counts SERVER STEPS
+    # (buffer flushes), not synchronous rounds. The synchronous runtimes
+    # ignore these fields.
+    async_buffer_k: int = 0
+    async_staleness_exp: float = 0.5
+    async_server_lr: float = 1.0
     # How the round executes the sampled clients' local trainings on one
     # chip: "vmap" batches them (one program, grouped convs/batched matmuls
     # — best for small models where per-step overhead dominates), "scan"

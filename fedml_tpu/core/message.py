@@ -60,6 +60,11 @@ class MessageType:
     ARG_CLIENT_INDEX = "client_index"
     ARG_NUM_SAMPLES = "num_samples"
     ARG_ROUND_IDX = "round_idx"
+    # asynchronous buffered aggregation (algorithms/fedbuff.py): clients
+    # upload deltas tagged with the model VERSION they trained from; the
+    # server discounts by staleness = current_version - base_version
+    ARG_ASYNC_DELTA = "async_delta"
+    ARG_BASE_VERSION = "base_version"
     ARG_PUBKEY = "pubkey"
     ARG_PUBKEY_REGISTRY = "pubkey_registry"  # {party: pk}, public material
     ARG_DROPPED = "dropped_parties"
