@@ -734,9 +734,6 @@ def main():
     scale = _with_budget(
         "scale", _scale_100k, lambda why: {"skipped": why}, 180,
     )
-    mxu = _with_budget(
-        "mxu_validation", _mxu_validation, lambda why: {"skipped": why}, 240,
-    )
     syn_rows, separated = _with_budget(
         "synthetic11", _hard_synthetic11,
         lambda why: ([{"skipped": why}], None), 600,
@@ -744,6 +741,11 @@ def main():
     lda_rows, parity_row = _with_budget(
         "femnist_lda", _hard_femnist_lda,
         lambda why: ([{"skipped": why}], {"skipped": why}), 700,
+    )
+    # last on purpose: under budget pressure this validation row is the
+    # right thing to skip — the hard-accuracy gates above must not starve
+    mxu = _with_budget(
+        "mxu_validation", _mxu_validation, lambda why: {"skipped": why}, 240,
     )
 
     rows = {

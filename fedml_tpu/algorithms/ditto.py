@@ -1,0 +1,233 @@
+"""Ditto — personalized federated learning (Li et al., MLSys 2021).
+
+BEYOND the reference's inventory (SURVEY §2b lists no personalization
+algorithm): every client keeps a PERSONAL model v_k alongside the shared
+global model w. The global model trains exactly as FedAvg; after each
+local training, the sampled clients also advance their personal model by
+SGD on the personalized objective
+
+    min_v  F_k(v) + lam/2 * ||v - w||^2
+
+i.e. the task loss plus a proximal pull toward the CURRENT global model
+(w at round start — the model the server broadcast). lam interpolates
+between purely-local models (lam=0: v_k never sees the federation) and
+the global model (lam→inf: v_k pinned to w). Personalized accuracy is
+evaluated per client: v_k on client k's own shard.
+
+TPU-first shape (same pattern as SCAFFOLD's control store,
+algorithms/scaffold.py): the N personal models live as ONE stacked
+[N, ...] device pytree; a round gathers the sampled rows, runs the lifted
+personal trains under the same vmap/scan client schedules as FedAvg, and
+scatters the rows back — all inside one jitted round function.
+
+Oracle discipline (tests/test_ditto.py): the personal-train loop mirrors
+train/client.make_local_train's rng/permutation structure EXACTLY, so at
+lam=0 a personal step sequence is bit-identical to plain local training —
+the degenerate-config equality the CI oracle pattern demands
+(ref CI-script-fedavg.sh:42-48).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgAPI,
+    client_axis_map,
+    make_fedavg_round_body,
+    resolve_client_parallelism,
+)
+from fedml_tpu.config import RunConfig, TrainConfig
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.models import ModelDef
+from fedml_tpu.train.client import make_local_train
+
+
+def make_ditto_personal_train(
+    model: ModelDef, tc: TrainConfig, epochs: int, lam: float,
+    task: str = "classification",
+):
+    """Personal-model training step:
+    ``(w_ref_params, v_vars, x, y, mask, rng) -> (v_vars', metrics)``.
+
+    This IS train/client.make_local_train with ``external_prox=True`` and
+    prox_mu=lam: the one difference from plain local training is that the
+    proximal term pulls toward the EXTERNAL ``w_ref_params`` (the
+    broadcast global model) instead of the entry params — Ditto's
+    personalized objective. Sharing the loop keeps the lam=0 case
+    bit-identical to plain local training by construction."""
+    return make_local_train(
+        model,
+        dataclasses.replace(tc, prox_mu=lam),
+        epochs,
+        task=task,
+        external_prox=True,
+    )
+
+
+def make_ditto_round(
+    model: ModelDef,
+    config: RunConfig,
+    lam: float,
+    task: str = "classification",
+    client_mode: Optional[str] = None,
+    donate: bool = True,
+):
+    """Jitted Ditto round: plain-FedAvg global update + personal-row
+    updates, one program.
+
+    ``(global_vars, v_stack, idx, x, y, mask, num_samples, rngs) ->
+      (global_vars', v_stack', metrics)``
+
+    The personal step's proximal reference is the round-START global model
+    (the broadcast w^t, per the paper's v-update), not the round's new
+    average."""
+    mode = client_mode or resolve_client_parallelism(
+        config.fed.client_parallelism, model
+    )
+    fedavg_body = make_fedavg_round_body(
+        model, config, task=task, client_mode=mode
+    )
+    personal = make_ditto_personal_train(
+        model, config.train, config.fed.epochs, lam, task=task
+    )
+    lifted_personal = client_axis_map(personal, mode, n_broadcast=1)
+
+    def round_fn(global_vars, v_stack, idx, x, y, mask, num_samples, rngs):
+        new_global, (_, g_metrics) = fedavg_body(
+            global_vars, x, y, mask, num_samples, rngs
+        )
+        v_rows = jax.tree_util.tree_map(lambda s: s[idx], v_stack)
+        # independent personal rng stream: same per-round keys, folded so
+        # the global and personal shuffles/dropout draws are uncorrelated
+        p_rngs = jax.vmap(lambda k: jax.random.fold_in(k, 0x0D17_70))(rngs)
+        # personal metrics are dropped (nothing downstream reads them —
+        # FedAvgAPI._pack_metrics consumes the global keys only; XLA DCEs
+        # the unused computation), so the round's metrics are exactly the
+        # FedAvg global-training metrics.
+        new_rows, _ = lifted_personal(
+            global_vars["params"], v_rows, x, y, mask, p_rngs
+        )
+        new_stack = jax.tree_util.tree_map(
+            lambda s, r: s.at[idx].set(r.astype(s.dtype)), v_stack, new_rows
+        )
+        return new_global, new_stack, jax.tree_util.tree_map(jnp.sum, g_metrics)
+
+    return jax.jit(round_fn, donate_argnums=(1,) if donate else ())
+
+
+class DittoAPI(FedAvgAPI):
+    """Ditto simulator on the FedAvg skeleton — adds the stacked on-device
+    personal-model store and per-client personalized evaluation."""
+
+    _supports_fused = False  # per-round personal-state exchange
+
+    # refuse rather than thrash: the v_stack is N x |variables|
+    _MAX_STATE_BYTES = 8 << 30
+
+    def __init__(
+        self, config: RunConfig, data: FederatedDataset, model: ModelDef,
+        lam: float = 0.1, **kw,
+    ):
+        super().__init__(config, data, model, **kw)
+        self.lam = float(lam)
+        n = config.fed.client_num_in_total
+        vbytes = sum(
+            int(np.prod(v.shape)) * v.dtype.itemsize
+            for v in jax.tree_util.tree_leaves(self.global_vars)
+        )
+        if vbytes * n > self._MAX_STATE_BYTES:
+            raise ValueError(
+                f"Ditto personal-model store would need {vbytes*n/2**30:.1f} "
+                f"GiB ({n} clients x {vbytes} bytes) — over the "
+                f"{self._MAX_STATE_BYTES/2**30:.0f} GiB cap. Reduce "
+                "client_num_in_total or shard the store."
+            )
+        # paper init: v_k = w_0 (every personal model starts at the global init)
+        self.v_stack = jax.tree_util.tree_map(
+            lambda g: jnp.broadcast_to(g, (n,) + g.shape), self.global_vars
+        )
+        self._ditto_round = make_ditto_round(
+            self.model, self.config, self.lam, task=self.task,
+            client_mode=self._client_mode,
+        )
+
+    def _build_round_fn(self, local_train_fn):
+        return None  # unused — train_round is fully overridden
+
+    def round_flops(self, round_idx: int = 0):
+        return None  # bespoke round fn; XLA cost analysis not wired
+
+    def checkpoint_state(self):
+        """Personal models are round state — a resume that dropped them
+        would silently reset every client's personalization."""
+        return {"v_stack": self.v_stack}
+
+    def restore_state(self, tree):
+        from fedml_tpu.utils.checkpoint import restore_like
+
+        self.v_stack = restore_like(self.v_stack, tree["v_stack"])
+
+    def train_round(self, round_idx: int):
+        sampled, _steps, _bs = self._round_plan(round_idx)
+        batch = self._round_batch(sampled, round_idx)
+        rng = jax.random.fold_in(self.rng, round_idx + 1)
+        self.global_vars, self.v_stack, metrics = self._ditto_round(
+            self.global_vars,
+            self.v_stack,
+            jnp.asarray(np.asarray(sampled, np.int32)),
+            *self._place_batch(batch, rng),
+        )
+        return sampled, metrics
+
+    def train(self):
+        final = super().train()
+        final = dict(final or {})
+        personalized = self.personalized_test_on_clients()
+        final.update(personalized)
+        self.log_fn(personalized)
+        return final
+
+    def personalized_test_on_clients(
+        self, batch_size: int = 256, max_clients: int = 256,
+    ):
+        """Per-client eval of each personal model on that client's OWN
+        shard (test shard when present, else train shard) — Ditto's
+        headline metric, vs the single global model on the same shards.
+        Above ``max_clients`` clients a seeded subset is evaluated (two
+        evals per client; unbounded N would dwarf the training loop)."""
+        from fedml_tpu.train.evaluate import evaluate
+
+        has_test = self.data.client_test_x is not None
+        ids = range(self.data.num_clients)
+        if self.data.num_clients > max_clients:
+            ids = np.random.default_rng(self.config.seed).choice(
+                self.data.num_clients, size=max_clients, replace=False
+            )
+        per_rows, g_rows = [], []
+        for i in ids:
+            x = (self.data.client_test_x if has_test else self.data.client_x)[i]
+            y = (self.data.client_test_y if has_test else self.data.client_y)[i]
+            if len(y) == 0:
+                continue
+            v_i = jax.tree_util.tree_map(lambda s: s[i], self.v_stack)
+            _, acc_p = evaluate(
+                self.model, v_i, x, y, batch_size=batch_size, task=self.task,
+                eval_fn=self.eval_fn,
+            )
+            _, acc_g = evaluate(
+                self.model, self.global_vars, x, y, batch_size=batch_size,
+                task=self.task, eval_fn=self.eval_fn,
+            )
+            per_rows.append(float(acc_p))
+            g_rows.append(float(acc_g))
+        return {
+            "Personalized/Acc": float(np.mean(per_rows)),
+            "Global/Acc": float(np.mean(g_rows)),
+            "num_clients_evaluated": len(per_rows),
+        }

@@ -43,6 +43,7 @@ ALGORITHMS = (
     "fednova",
     "scaffold",  # beyond the reference: control-variate drift correction
     "fedbuff",  # beyond the reference: barrier-free async aggregation
+    "ditto",  # beyond the reference: personalized FL (per-client models)
     "hierarchical",
     "fedavg_robust",
     "fedgkt",
@@ -135,6 +136,9 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="How one chip runs the sampled clients: vmap (batched) "
                    "or scan (sequential — faster for conv models whose "
                    "small channels under-tile the MXU); auto picks per model")
+@click.option("--ditto_lambda", type=float, default=0.1,
+              help="algorithm=ditto: proximal pull of each personal model "
+                   "toward the global model (0 = purely local models)")
 @click.option("--async_buffer_k", type=int, default=10,
               help="algorithm=fedbuff: server applies one staleness-"
                    "weighted step whenever this many client deltas have "
@@ -445,6 +449,7 @@ def run(**opt):
         norm_bound=opt.get("norm_bound", 5.0),
         noise_stddev=opt.get("noise_stddev", 0.025),
         attack_cfg=attack_cfg,
+        ditto_lambda=opt.get("ditto_lambda", 0.1),
     )
     api_cell.append(api)
 
@@ -545,7 +550,8 @@ def _restore(api, opt):
 
 def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                defense="norm_diff_clipping", num_byzantine=1, multi_krum_m=3,
-               norm_bound=5.0, noise_stddev=0.025, attack_cfg=None):
+               norm_bound=5.0, noise_stddev=0.025, attack_cfg=None,
+               ditto_lambda=0.1):
     from fedml_tpu.robustness import RobustConfig
 
     # one RobustConfig for whichever runtime's robust API is selected —
@@ -577,7 +583,6 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                     server = run_fedbuff_loopback(
                         config, data, model, task=task, log_fn=log_fn,
                     )
-                    _AsyncRunner.global_vars = server.global_vars
                     self.global_vars = server.global_vars
                     return server.history[-1] if server.history else {}
 
@@ -609,7 +614,6 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                     config, data, model, task=task, log_fn=log_fn,
                     server_opt=algorithm == "fedopt",
                 )
-                _Runner.global_vars = server.global_vars
                 self.global_vars = server.global_vars
                 # expose the FedOpt moments so --checkpoint_path persists
                 # them (the vmap --resume path restores from this slot)
@@ -679,6 +683,12 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         from fedml_tpu.algorithms.scaffold import ScaffoldAPI
 
         return ScaffoldAPI(config, data, model, task=task, log_fn=log_fn)
+    if algorithm == "ditto":
+        from fedml_tpu.algorithms.ditto import DittoAPI
+
+        return DittoAPI(
+            config, data, model, task=task, log_fn=log_fn, lam=ditto_lambda,
+        )
     if algorithm == "hierarchical":
         from fedml_tpu.algorithms import HierarchicalFedAvgAPI
 

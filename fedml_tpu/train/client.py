@@ -161,19 +161,30 @@ def make_local_train(
     task: str = "classification",
     reshuffle_each_epoch: bool = True,
     skip_empty_steps: bool = False,
+    external_prox: bool = False,
 ):
     """Build the per-client training function.
 
     Returned fn: ``(variables, x, y, mask, rng) -> (variables', metrics)`` with
     x [S, B, *feat], y [S, B, *lab], mask [S, B]. metrics are SUMS
     {loss_sum, correct, count} so they aggregate exactly across clients.
+
+    ``external_prox=True`` prepends a parameter tree to the signature —
+    ``(prox_ref_params, variables, x, y, mask, rng)`` — and points the
+    tc.prox_mu proximal term at it instead of the entry params. FedProx
+    pulls toward the entry params (which ARE the broadcast global model);
+    Ditto's personal step starts from the personal model but pulls toward
+    the broadcast global model, so the reference must be external
+    (algorithms/ditto.py). One loop serves both, keeping their math
+    bit-identical at prox_mu=0 by construction.
     """
     opt = build_client_optimizer(tc)
     task_loss = make_task_loss(task)
     fwd = make_mixed_forward(model, tc)
 
-    def local_train(variables, x, y, mask, rng):
+    def _local_train(variables, x, y, mask, rng, prox_ref=None):
         params0, extra0 = _split_vars(variables)
+        prox_ref_params = params0 if prox_ref is None else prox_ref
         S, B = mask.shape[0], mask.shape[1]
         n_flat = S * B
         x_flat = x.reshape((n_flat,) + x.shape[2:])
@@ -185,7 +196,9 @@ def make_local_train(
             task_l, correct, total = task_loss(logits, yb, mb)
             loss = task_l
             if tc.prox_mu:
-                loss = loss + 0.5 * tc.prox_mu * L.tree_sq_dist(params, params0)
+                loss = loss + 0.5 * tc.prox_mu * L.tree_sq_dist(
+                    params, prox_ref_params
+                )
             # task_l (not loss) feeds the metrics so FedProx runs report plain
             # task loss, comparable to FedAvg and the reference's logs.
             return loss, (new_extra, task_l, correct, total)
@@ -278,5 +291,12 @@ def make_local_train(
             "steps": mets[3],
         }
         return {"params": params, **extra}, metrics
+
+    if external_prox:
+        def local_train(prox_ref_params, variables, x, y, mask, rng):
+            return _local_train(variables, x, y, mask, rng, prox_ref=prox_ref_params)
+    else:
+        def local_train(variables, x, y, mask, rng):
+            return _local_train(variables, x, y, mask, rng)
 
     return local_train

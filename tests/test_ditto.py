@@ -1,0 +1,181 @@
+"""Ditto personalization (algorithms/ditto.py) — per-client personal
+models with a proximal pull toward the global model; beyond the
+reference's inventory (SURVEY §2b has no personalization algorithm)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.ditto import (
+    DittoAPI,
+    make_ditto_personal_train,
+)
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.base import FederatedDataset
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+from fedml_tpu.train.client import make_local_train
+
+
+def _cfg(total, per_round, rounds, lr=0.1, epochs=1, batch=8):
+    return RunConfig(
+        data=DataConfig(batch_size=batch),
+        fed=FedConfig(
+            client_num_in_total=total,
+            client_num_per_round=per_round,
+            comm_round=rounds,
+            epochs=epochs,
+            frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=lr),
+        seed=0,
+    )
+
+
+def test_lambda_zero_equals_plain_local_train():
+    """Degenerate-config oracle: at lam=0 the personal step IS plain local
+    training — exact equality with make_local_train under the same rng
+    (the personal loop mirrors its rng/permutation structure)."""
+    model = create_model("lr", "synthetic", (12,), 3)
+    cfg = _cfg(4, 2, 1)
+    variables = model.init(jax.random.PRNGKey(7))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, 12)), jnp.float32)
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 3, (2, 8)))
+    mask = jnp.ones((2, 8), jnp.float32)
+    rng = jax.random.PRNGKey(3)
+
+    personal = make_ditto_personal_train(model, cfg.train, epochs=1, lam=0.0)
+    plain = make_local_train(model, cfg.train, epochs=1)
+    v_p, _ = personal(variables["params"], variables, x, y, mask, rng)
+    v_l, _ = plain(variables, x, y, mask, rng)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(v_p), jax.tree_util.tree_leaves(v_l)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_larger_lambda_pins_personal_to_reference():
+    """The proximal pull bounds how far the personal model can wander from
+    the reference: over many local steps, lam=5 (stable: lr*lam < 1) must
+    keep v far closer to w than unregularized training drifts. (A huge
+    lam at fixed lr is NOT tested — lr*lam > 2 makes the prox
+    discretization oscillate, which is a property of SGD, not of Ditto.)"""
+    model = create_model("lr", "synthetic", (12,), 3)
+    cfg = _cfg(4, 2, 1)
+    variables = model.init(jax.random.PRNGKey(7))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, 12)), jnp.float32)
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 3, (2, 8)))
+    mask = jnp.ones((2, 8), jnp.float32)
+    rng = jax.random.PRNGKey(3)
+
+    def drift(lam):
+        fn = jax.jit(
+            make_ditto_personal_train(model, cfg.train, epochs=10, lam=lam)
+        )
+        v, _ = fn(variables["params"], variables, x, y, mask, rng)
+        return sum(
+            float(jnp.sum((a - b) ** 2))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(v["params"]),
+                jax.tree_util.tree_leaves(variables["params"]),
+            )
+        )
+
+    assert drift(5.0) < drift(0.0) * 0.5
+
+
+def _conflicting_label_data(num_clients=6, n=60, feat=10, classes=5, seed=0):
+    """Clients agree on features but DISAGREE on labels: client k's labels
+    are shifted by k mod classes — a single global model cannot fit all
+    clients, personal models can. The regime where personalization wins."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(feat, classes))
+    client_x, client_y = [], []
+    for k in range(num_clients):
+        x = rng.normal(size=(n, feat)).astype(np.float32)
+        base = np.argmax(x @ w, axis=1)
+        client_x.append(x)
+        client_y.append(((base + k) % classes).astype(np.int32))
+    return FederatedDataset(
+        name="conflict",
+        client_x=client_x,
+        client_y=client_y,
+        test_x=client_x[0],
+        test_y=client_y[0],
+        num_classes=classes,
+    )
+
+
+def test_personalization_beats_global_under_label_conflict():
+    data = _conflicting_label_data()
+    model = create_model("lr", "synthetic", (10,), 5)
+    api = DittoAPI(
+        _cfg(6, 6, 20, lr=0.2, epochs=2), data, model, lam=0.1,
+    )
+    for r in range(20):
+        api.train_round(r)
+    rows = api.personalized_test_on_clients()
+    # global model is torn between conflicting label maps (~1/5 chance);
+    # each personal model fits its own map
+    assert rows["Personalized/Acc"] > 0.9, rows
+    assert rows["Personalized/Acc"] > rows["Global/Acc"] + 0.3, rows
+
+
+def test_unsampled_rows_untouched():
+    data = synthetic_classification(
+        num_clients=8, num_classes=3, feat_shape=(6,), samples_per_client=16,
+        partition_method="homo", seed=0,
+    )
+    model = create_model("lr", "synthetic", (6,), 3)
+    api = DittoAPI(_cfg(8, 2, 1), data, model, lam=0.5)
+    before = jax.device_get(api.v_stack)
+    sampled, _ = api.train_round(0)
+    after = jax.device_get(api.v_stack)
+    untouched = sorted(set(range(8)) - set(int(s) for s in sampled))
+    assert untouched
+    for leaf_b, leaf_a in zip(
+        jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)
+    ):
+        np.testing.assert_array_equal(leaf_b[untouched], leaf_a[untouched])
+        assert not np.array_equal(
+            leaf_b[list(sampled)], leaf_a[list(sampled)]
+        )
+
+
+def test_checkpoint_roundtrip_preserves_personal_models():
+    data = synthetic_classification(
+        num_clients=4, num_classes=3, feat_shape=(6,), samples_per_client=16,
+        partition_method="homo", seed=0,
+    )
+    model = create_model("lr", "synthetic", (6,), 3)
+    api = DittoAPI(_cfg(4, 2, 1), data, model, lam=0.5)
+    api.train_round(0)
+    state = jax.device_get(api.checkpoint_state())
+    api2 = DittoAPI(_cfg(4, 2, 1), data, model, lam=0.5)
+    api2.restore_state(state)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(api.v_stack),
+        jax.tree_util.tree_leaves(api2.v_stack),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cli_ditto_reachable():
+    import json
+
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    result = CliRunner().invoke(
+        main,
+        [
+            "--algorithm", "ditto", "--dataset", "synthetic", "--model", "lr",
+            "--client_num_in_total", "4", "--client_num_per_round", "2",
+            "--comm_round", "2", "--batch_size", "8", "--lr", "0.1",
+            "--ditto_lambda", "0.2",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    row = json.loads(result.output.strip().splitlines()[-1])
+    assert "Personalized/Acc" in row and "Global/Acc" in row
