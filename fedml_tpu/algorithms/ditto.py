@@ -121,6 +121,87 @@ def make_ditto_round(
     return jax.jit(round_fn, donate_argnums=(1,) if donate else ())
 
 
+def make_sharded_ditto_round(
+    model: ModelDef,
+    config: RunConfig,
+    mesh,
+    lam: float,
+    task: str = "classification",
+    donate: bool = True,
+):
+    """Ditto round over a client-sharded mesh (shard_map form of
+    make_ditto_round, same signature; no reference counterpart — the ref
+    has no personalization at all).
+
+    Sharding layout mirrors SCAFFOLD's (scaffold.make_sharded_scaffold_round):
+    the personal store ``v_stack`` stays REPLICATED; the cohort's data and
+    index vector shard over the client axis. Each shard gathers its own
+    clients' personal rows, trains them against the replicated broadcast
+    model, and the row updates travel as all_gathered COHORT deltas
+    (O(|S|·params) over ICI) applied with ``.at[idx].add`` — dummy padding
+    clients train on all-zero masks, end exactly where they started, and
+    contribute exact-zero deltas, so idx collisions with padding rows are
+    harmless."""
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    mode = resolve_client_parallelism(config.fed.client_parallelism, model)
+    local_train = make_local_train(
+        model, config.train, config.fed.epochs, task=task
+    )
+    lifted_local = client_axis_map(local_train, mode)
+    personal = make_ditto_personal_train(
+        model, config.train, config.fed.epochs, lam, task=task
+    )
+    lifted_personal = client_axis_map(personal, mode, n_broadcast=1)
+
+    def shard_body(global_vars, v_stack, idx, x, y, mask, num_samples, rngs):
+        varying = lambda t: jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(a, (axis,), to="varying"), t
+        )
+        gv = varying(global_vars)
+        stack = varying(v_stack)
+        client_vars, metrics = lifted_local(gv, x, y, mask, rngs)
+        wsum = jax.lax.psum(jnp.sum(num_samples), axis)
+        w = num_samples / jnp.maximum(wsum, 1e-9)
+        new_global = jax.tree_util.tree_map(
+            lambda s: jax.lax.psum(
+                jnp.tensordot(w, s.astype(jnp.float32), axes=1), axis
+            ),
+            client_vars,
+        )
+        v_rows = jax.tree_util.tree_map(lambda s: s[idx], stack)
+        p_rngs = jax.vmap(lambda k: jax.random.fold_in(k, 0x0D17_70))(rngs)
+        new_rows, _ = lifted_personal(gv["params"], v_rows, x, y, mask, p_rngs)
+        delta = jax.tree_util.tree_map(
+            lambda new, old: new.astype(old.dtype) - old, new_rows, v_rows
+        )
+        idx_all = jax.lax.all_gather(idx, axis, tiled=True)
+        delta_all = jax.tree_util.tree_map(
+            lambda d: jax.lax.all_gather(d, axis, tiled=True), delta
+        )
+        new_stack = jax.tree_util.tree_map(
+            lambda stack_l, d: stack_l.at[idx_all].add(d), stack, delta_all
+        )
+        agg = jax.tree_util.tree_map(
+            lambda m: jax.lax.psum(jnp.sum(m), axis), metrics
+        )
+        return new_global, new_stack, agg
+
+    data_spec = P(axis)
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P()) + (data_spec,) * 6,
+        out_specs=(P(), P(), P()),
+        # every output is psum/all_gather-combined, replicated by
+        # construction; custom-VJP norm ops inside local_train defeat
+        # static VMA inference (same stance as scaffold's sharded round)
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(1,) if donate else ())
+
+
 class DittoAPI(FedAvgAPI):
     """Ditto simulator on the FedAvg skeleton — adds the stacked on-device
     personal-model store and per-client personalized evaluation."""
@@ -152,7 +233,10 @@ class DittoAPI(FedAvgAPI):
         self.v_stack = jax.tree_util.tree_map(
             lambda g: jnp.broadcast_to(g, (n,) + g.shape), self.global_vars
         )
-        self._ditto_round = make_ditto_round(
+        self._ditto_round = self._build_ditto_round()
+
+    def _build_ditto_round(self):
+        return make_ditto_round(
             self.model, self.config, self.lam, task=self.task,
             client_mode=self._client_mode,
         )
@@ -173,6 +257,11 @@ class DittoAPI(FedAvgAPI):
 
         self.v_stack = restore_like(self.v_stack, tree["v_stack"])
 
+    def _place_client_indices(self, sampled):
+        """The sampled client ids as the round fn's gather/scatter index
+        vector — the sharded subclass pads to the mesh and shards it."""
+        return jnp.asarray(np.asarray(sampled, np.int32))
+
     def train_round(self, round_idx: int):
         sampled, _steps, _bs = self._round_plan(round_idx)
         batch = self._round_batch(sampled, round_idx)
@@ -180,7 +269,7 @@ class DittoAPI(FedAvgAPI):
         self.global_vars, self.v_stack, metrics = self._ditto_round(
             self.global_vars,
             self.v_stack,
-            jnp.asarray(np.asarray(sampled, np.int32)),
+            self._place_client_indices(sampled),
             *self._place_batch(batch, rng),
         )
         return sampled, metrics

@@ -652,6 +652,13 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
             return DistributedScaffoldAPI(
                 config, data, model, task=task, log_fn=log_fn
             )
+        if algorithm == "ditto":
+            from fedml_tpu.parallel import DistributedDittoAPI
+
+            return DistributedDittoAPI(
+                config, data, model, task=task, log_fn=log_fn,
+                lam=ditto_lambda,
+            )
         if algorithm == "hierarchical":
             from fedml_tpu.parallel import HierarchicalShardedAPI
 
@@ -662,7 +669,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         if algorithm not in ("fedavg", "fedprox"):
             raise click.UsageError(
                 "runtime=mesh currently supports fedavg/fedprox/fedopt/"
-                "fednova/scaffold/hierarchical/fedavg_robust"
+                "fednova/scaffold/ditto/hierarchical/fedavg_robust"
             )
         return DistributedFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
 

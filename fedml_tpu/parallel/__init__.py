@@ -12,6 +12,7 @@ from fedml_tpu.parallel.fedavg_sharded import (
     make_sharded_fedavg_round,
     DistributedFedAvgAPI,
     DistributedFedNovaAPI,
+    DistributedDittoAPI,
     DistributedScaffoldAPI,
     DistributedFedOptAPI,
     RobustDistributedFedAvgAPI,
@@ -38,6 +39,7 @@ __all__ = [
     "make_sharded_fedavg_round",
     "DistributedFedAvgAPI",
     "DistributedFedNovaAPI",
+    "DistributedDittoAPI",
     "DistributedScaffoldAPI",
     "DistributedFedOptAPI",
     "RobustDistributedFedAvgAPI",

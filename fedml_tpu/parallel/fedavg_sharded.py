@@ -33,6 +33,7 @@ from fedml_tpu.algorithms.fedavg import (
 )
 from fedml_tpu.algorithms.fednova import FedNovaAPI
 from fedml_tpu.algorithms.fedopt import FedOptAPI
+from fedml_tpu.algorithms.ditto import DittoAPI
 from fedml_tpu.algorithms.scaffold import ScaffoldAPI
 from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import ClientBatch, FederatedDataset
@@ -171,6 +172,20 @@ class DistributedFedAvgAPI(FedAvgAPI):
             donate=self._donate,
         )
 
+    def _pad_shard_indices(self, sampled):
+        """Pad a sampled-client index vector to the mesh size and shard it
+        — the gather/scatter vector of stateful algorithms (SCAFFOLD's
+        control rows, Ditto's personal rows). Dummy rows point at client 0
+        but train on all-zero masks, so their state deltas are EXACT zeros
+        (the local-train step where-gates its whole update on has_data;
+        pinned by tests) and the scatter-add ignores them."""
+        n = len(sampled)
+        rem = n % self.n_shards
+        padded = n + (self.n_shards - rem if rem else 0)
+        idx = np.zeros((padded,), np.int32)
+        idx[:n] = np.asarray(sampled, np.int32)
+        return jax.device_put(idx, self._data_sharding)
+
     def _place_batch(self, batch: ClientBatch, round_rng):
         """Pad the client axis to the mesh size and shard everything over it.
         Dummy (padding) clients get zero keys — their mask is all-zero so
@@ -272,15 +287,27 @@ class DistributedScaffoldAPI(ScaffoldAPI, DistributedFedAvgAPI):
         )
 
     def _place_client_indices(self, sampled):
-        # pad to the mesh exactly like pad_client_batch pads the data:
-        # dummy rows point at client 0 but their Δ-rows are exact zeros
-        # (all-zero masks -> c_i⁺ == c_i), so the scatter-add ignores them
-        n = len(sampled)
-        rem = n % self.n_shards
-        padded = n + (self.n_shards - rem if rem else 0)
-        idx = np.zeros((padded,), np.int32)
-        idx[:n] = np.asarray(sampled, np.int32)
-        return jax.device_put(idx, self._data_sharding)
+        return self._pad_shard_indices(sampled)
+
+
+class DistributedDittoAPI(DittoAPI, DistributedFedAvgAPI):
+    """Ditto personalization on the multi-chip mesh runtime (no reference
+    counterpart — its inventory has no personalization). Cooperative MRO:
+    DistributedFedAvgAPI supplies the mesh bootstrap and sharded batch
+    placement; DittoAPI supplies the personal store and train_round; this
+    class swaps in the shard_map round and pads/shards the gather/scatter
+    index vector (dummy rows train on all-zero masks and contribute
+    exact-zero row deltas)."""
+
+    def _build_ditto_round(self):
+        from fedml_tpu.algorithms.ditto import make_sharded_ditto_round
+
+        return make_sharded_ditto_round(
+            self.model, self.config, self.mesh, self.lam, task=self.task
+        )
+
+    def _place_client_indices(self, sampled):
+        return self._pad_shard_indices(sampled)
 
 
 class DistributedFedOptAPI(FedOptAPI, DistributedFedAvgAPI):

@@ -160,6 +160,75 @@ def test_checkpoint_roundtrip_preserves_personal_models():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_all_padding_client_is_exact_noop_even_with_prox():
+    """The sharded round's dummy (padding) clients point at client 0's
+    personal row and rely on their delta being EXACTLY zero. The prox term
+    lam*(v - w) is nonzero whenever v != w — but the local-train step
+    where-gates its ENTIRE update on has_data, so an all-padding client
+    must not move at all. Pinned here so a future change to the gating
+    cannot silently corrupt row 0 under mesh padding."""
+    model = create_model("lr", "synthetic", (6,), 3)
+    tc = TrainConfig(client_optimizer="sgd", lr=0.1)
+    w = model.init(jax.random.PRNGKey(0))
+    v = model.init(jax.random.PRNGKey(1))  # v != w: prox gradient nonzero
+    fn = make_ditto_personal_train(model, tc, epochs=2, lam=5.0)
+    x = jnp.zeros((2, 4, 6))
+    y = jnp.zeros((2, 4), jnp.int32)
+    mask = jnp.zeros((2, 4), jnp.float32)  # ALL padding
+    v2, _ = fn(w["params"], v, x, y, mask, jax.random.PRNGKey(2))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(v2), jax.tree_util.tree_leaves(v)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mesh_ditto_matches_vmap():
+    """DistributedDittoAPI (shard_map over a client mesh, replicated
+    personal store, all_gathered row deltas) == the single-chip simulator
+    at the same seed — global params AND every personal row. Uses a
+    non-divisible cohort (6 clients over 8 shards, padded), so the
+    dummy-client zero-delta path is exercised."""
+    import pytest
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from fedml_tpu.parallel import DistributedDittoAPI
+
+    data = synthetic_classification(
+        num_clients=8, num_classes=3, feat_shape=(6,), samples_per_client=16,
+        partition_method="hetero", ragged=False, seed=3,
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=4, pad_bucket=1),
+        fed=FedConfig(
+            client_num_in_total=8, client_num_per_round=6, comm_round=3,
+            epochs=2, frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        model="lr",
+    )
+    model = create_model("lr", "synthetic", (6,), 3)
+    sim = DittoAPI(cfg, data, model, lam=0.3)
+    mesh_api = DistributedDittoAPI(cfg, data, model, lam=0.3)
+    for r in range(cfg.fed.comm_round):
+        _, m_sim = sim.train_round(r)
+        _, m_mesh = mesh_api.train_round(r)
+        np.testing.assert_allclose(
+            float(m_sim["loss_sum"]), float(m_mesh["loss_sum"]), rtol=1e-5
+        )
+    for name, a, b in (
+        ("params", sim.global_vars, mesh_api.global_vars),
+        ("v_stack", sim.v_stack, mesh_api.v_stack),
+    ):
+        for x_, y_ in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x_), np.asarray(y_), rtol=1e-5, atol=1e-5,
+                err_msg=name,
+            )
+
+
 def test_cli_ditto_reachable():
     import json
 
