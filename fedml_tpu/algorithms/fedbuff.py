@@ -330,3 +330,53 @@ def run_fedbuff_loopback(
         config, data, model, lambda rank: LoopbackCommManager(hub, rank),
         task=task, log_fn=log_fn,
     )
+
+
+def run_fedbuff_shm(
+    config: RunConfig,
+    data: FederatedDataset,
+    model: ModelDef,
+    task: str = "classification",
+    log_fn=None,
+    sock_dir: Optional[str] = None,
+):
+    """Async federation over the shared-memory local transport (the
+    TRPC-slot backend, core/shm_comm.py) — the protocol is comm-agnostic,
+    so the runner only swaps the factory."""
+    import tempfile
+
+    from fedml_tpu.core.shm_comm import ShmCommManager
+
+    def run(d):
+        return run_fedbuff_federation(
+            config, data, model, lambda rank: ShmCommManager(rank, d),
+            task=task, log_fn=log_fn,
+        )
+
+    if sock_dir is not None:
+        return run(sock_dir)
+    with tempfile.TemporaryDirectory(prefix="fedml_shm_async_") as d:
+        return run(d)
+
+
+def run_fedbuff_mqtt(
+    config: RunConfig,
+    data: FederatedDataset,
+    model: ModelDef,
+    task: str = "classification",
+    log_fn=None,
+    host: Optional[str] = None,
+    port: int = 1883,
+):
+    """Async federation over MQTT pub/sub (embedded in-process broker by
+    default, real TCP broker when ``host`` is given)."""
+    from fedml_tpu.core.mqtt_comm import EmbeddedBroker, MqttCommManager
+
+    if host is None:
+        broker = EmbeddedBroker()
+        factory = lambda rank: MqttCommManager(rank, broker=broker)
+    else:
+        factory = lambda rank: MqttCommManager(rank, host=host, port=port)
+    return run_fedbuff_federation(
+        config, data, model, factory, task=task, log_fn=log_fn,
+    )

@@ -565,14 +565,13 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
     )
     if runtime in ("loopback", "mqtt", "shm"):
         if algorithm == "fedbuff":
-            from fedml_tpu.algorithms.fedbuff import run_fedbuff_loopback
+            from fedml_tpu.algorithms import fedbuff as FB
 
-            if runtime != "loopback":
-                raise click.UsageError(
-                    "fedbuff currently runs over --runtime loopback (the "
-                    "async FSM is transport-generic; mqtt/shm wiring is the "
-                    "same comm_factory plumbing)"
-                )
+            runner_fn = {
+                "loopback": FB.run_fedbuff_loopback,
+                "shm": FB.run_fedbuff_shm,
+                "mqtt": FB.run_fedbuff_mqtt,
+            }[runtime]
 
             class _AsyncRunner:
                 global_vars = None
@@ -580,7 +579,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                 start_round = 0
 
                 def train(self):
-                    server = run_fedbuff_loopback(
+                    server = runner_fn(
                         config, data, model, task=task, log_fn=log_fn,
                     )
                     self.global_vars = server.global_vars
@@ -589,8 +588,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
             return _AsyncRunner()
         if algorithm not in ("fedavg", "fedprox", "fedopt"):
             raise click.UsageError(
-                f"runtime={runtime} supports fedavg/fedprox/fedopt (and "
-                "fedbuff over loopback)"
+                f"runtime={runtime} supports fedavg/fedprox/fedopt/fedbuff"
             )
         from fedml_tpu.algorithms.fedavg_transport import (
             run_loopback_federation,
@@ -625,7 +623,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
     if algorithm == "fedbuff":
         raise click.UsageError(
             "algorithm=fedbuff is an async TRANSPORT protocol — run it "
-            "with --runtime loopback"
+            "with --runtime loopback, shm, or mqtt"
         )
     if runtime == "mesh":
         from fedml_tpu.parallel import DistributedFedAvgAPI, DistributedFedOptAPI

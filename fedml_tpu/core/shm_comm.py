@@ -80,6 +80,7 @@ class ShmCommManager(BaseCommManager):
     # -- receive loop: map, decode (optionally aliasing), notify, unlink --
     def handle_receive_message(self) -> None:
         self._loop_running = True
+        self._loop_thread = threading.current_thread()
         try:
             while not self._stopped.is_set():
                 try:
@@ -148,6 +149,19 @@ class ShmCommManager(BaseCommManager):
     def stop_receive_message(self) -> None:
         already = self._stopped.is_set()
         self._stopped.set()
+        if (
+            self._loop_running
+            and threading.current_thread() is getattr(self, "_loop_thread", None)
+        ):
+            # Reentrant stop — called from inside a handler, i.e. ON the
+            # receive-loop thread (an async server finishing from its own
+            # upload handler, fedbuff._flush). The flag alone suffices: the
+            # loop re-checks _stopped before its next accept(), and the
+            # loop's finally owns teardown. The self-connect wake below
+            # would DEADLOCK here: with peers still connecting, the
+            # backlog-1 listener is full and the only accept()-er is this
+            # very thread.
+            return
         if not self._loop_running:
             # no receive loop to drain (never started, or already exited):
             # tear down here instead of queueing a stop record nobody reads
