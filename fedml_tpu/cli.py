@@ -44,6 +44,7 @@ ALGORITHMS = (
     "scaffold",  # beyond the reference: control-variate drift correction
     "fedbuff",  # beyond the reference: barrier-free async aggregation
     "ditto",  # beyond the reference: personalized FL (per-client models)
+    "dp_fedavg",  # beyond the reference: client-level DP with RDP ledger
     "hierarchical",
     "fedavg_robust",
     "fedgkt",
@@ -136,6 +137,13 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="How one chip runs the sampled clients: vmap (batched) "
                    "or scan (sequential — faster for conv models whose "
                    "small channels under-tile the MXU); auto picks per model")
+@click.option("--dp_clip", type=float, default=1.0,
+              help="algorithm=dp_fedavg: per-client update L2 clip S")
+@click.option("--dp_noise_multiplier", type=float, default=1.0,
+              help="algorithm=dp_fedavg: noise multiplier z (stddev z*S "
+                   "on the clipped-update sum)")
+@click.option("--dp_delta", type=float, default=1e-5,
+              help="algorithm=dp_fedavg: report epsilon at this delta")
 @click.option("--ditto_lambda", type=float, default=0.1,
               help="algorithm=ditto: proximal pull of each personal model "
                    "toward the global model (0 = purely local models)")
@@ -180,6 +188,29 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 def main(**opt):
     """Train a federated model on TPU."""
     run(**opt)
+
+
+def _dp_cfg(opt):
+    if opt["algorithm"] != "dp_fedavg":
+        return None
+    from fedml_tpu.privacy import DpConfig
+
+    clip = opt.get("dp_clip", 1.0)
+    z = opt.get("dp_noise_multiplier", 1.0)
+    delta = opt.get("dp_delta", 1e-5)
+    # parse-time validation: z<=0 would otherwise crash the accountant
+    # after data/model setup, and a negative clip would silently INVERT
+    # every client update (scale = clip/norm < 0)
+    if clip <= 0:
+        raise click.UsageError("--dp_clip must be > 0")
+    if z <= 0:
+        raise click.UsageError(
+            "--dp_noise_multiplier must be > 0 (no-noise runs are not DP; "
+            "use --algorithm fedavg instead)"
+        )
+    if not 0.0 < delta < 1.0:
+        raise click.UsageError("--dp_delta must be in (0, 1)")
+    return DpConfig(clip_norm=clip, noise_multiplier=z, delta=delta)
 
 
 def _checked_buffer_k(opt) -> int:
@@ -450,6 +481,7 @@ def run(**opt):
         noise_stddev=opt.get("noise_stddev", 0.025),
         attack_cfg=attack_cfg,
         ditto_lambda=opt.get("ditto_lambda", 0.1),
+        dp_cfg=_dp_cfg(opt),
     )
     api_cell.append(api)
 
@@ -551,7 +583,7 @@ def _restore(api, opt):
 def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                defense="norm_diff_clipping", num_byzantine=1, multi_krum_m=3,
                norm_bound=5.0, noise_stddev=0.025, attack_cfg=None,
-               ditto_lambda=0.1):
+               ditto_lambda=0.1, dp_cfg=None):
     from fedml_tpu.robustness import RobustConfig
 
     # one RobustConfig for whichever runtime's robust API is selected —
@@ -693,6 +725,12 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
 
         return DittoAPI(
             config, data, model, task=task, log_fn=log_fn, lam=ditto_lambda,
+        )
+    if algorithm == "dp_fedavg":
+        from fedml_tpu.privacy import DpConfig, DPFedAvgAPI
+
+        return DPFedAvgAPI(
+            config, data, model, task=task, log_fn=log_fn, dp=dp_cfg or DpConfig(),
         )
     if algorithm == "hierarchical":
         from fedml_tpu.algorithms import HierarchicalFedAvgAPI

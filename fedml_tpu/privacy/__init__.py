@@ -1,0 +1,17 @@
+"""Client-level differential privacy for federated training.
+
+The reference's privacy ceiling is the un-accounted "weak DP" noise of
+its robust aggregator (robust_aggregation.py:51-55); this package adds
+real DP-FedAvg (clip + calibrated Gaussian noise on the uniform cohort
+mean) and a Rényi-DP accountant that reports (epsilon, delta)."""
+
+from fedml_tpu.privacy.accountant import RdpAccountant, rdp_subsampled_gaussian
+from fedml_tpu.privacy.dp_fedavg import DpConfig, DPFedAvgAPI, make_dp_hooks
+
+__all__ = [
+    "RdpAccountant",
+    "rdp_subsampled_gaussian",
+    "DpConfig",
+    "DPFedAvgAPI",
+    "make_dp_hooks",
+]

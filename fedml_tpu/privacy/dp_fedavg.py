@@ -1,0 +1,166 @@
+"""DP-FedAvg — client-level differential privacy with a real ledger.
+
+The reference ships "weak DP" (norm clipping + an arbitrary noise stddev,
+fedml_core/robustness/robust_aggregation.py:38-55) as a backdoor DEFENSE;
+it never says — or knows — what (epsilon, delta) it provides. This module
+implements the DP-FedAvg recipe (McMahan et al., "Learning Differentially
+Private Recurrent Language Models" — public algorithm, fresh
+implementation) on the same round-hook skeleton the robust defenses use:
+
+  1. each sampled client's UPDATE delta_i = w_i - w_t is clipped to L2
+     norm S over the ENTIRE uploaded tree (params and any stats — the
+     guarantee must cover everything transmitted, so unlike the robust
+     defense's BN-stat-aware clipping nothing passes through unclipped);
+  2. aggregation is the UNIFORM mean over the fixed-size cohort —
+     sample-count weighting would make the sensitivity depend on private
+     shard sizes, so it is deliberately NOT used here;
+  3. Gaussian noise N(0, (z*S/m)^2) is added to every coordinate of the
+     mean (sensitivity of the mean to one client is S/m);
+  4. an RDP accountant (privacy/accountant.py) composes the rounds and
+     reports (epsilon, delta) for q = m/N per round.
+
+All of 1-3 run inside the one jitted round function via the
+post_train/aggregate_fn/post_aggregate hooks of make_fedavg_round — the
+DP math adds no host round-trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, make_fedavg_round
+from fedml_tpu.algorithms.fedavg_robust import NOISE_FOLD
+from fedml_tpu.privacy.accountant import RdpAccountant
+
+
+@dataclasses.dataclass(frozen=True)
+class DpConfig:
+    """Client-level DP-FedAvg knobs."""
+
+    clip_norm: float = 1.0  # S: per-client update L2 bound
+    noise_multiplier: float = 1.0  # z: noise stddev in units of S (on the sum)
+    delta: float = 1e-5  # the delta at which epsilon is reported
+
+
+def clip_update_tree(local_tree, global_tree, clip_norm: float):
+    """w_t + clip_S(w_l - w_t) with the L2 norm taken over EVERY leaf of
+    the update (full-tree sensitivity — see module docstring)."""
+    sq = sum(
+        jnp.sum(jnp.square((l - g).astype(jnp.float32)))
+        for l, g in zip(
+            jax.tree_util.tree_leaves(local_tree),
+            jax.tree_util.tree_leaves(global_tree),
+        )
+    )
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(
+        lambda l, g: (g + (l - g) * scale).astype(l.dtype), local_tree, global_tree
+    )
+
+
+def make_dp_hooks(dp: DpConfig, cohort_size: int):
+    """(post_train, aggregate_fn, post_aggregate) for make_fedavg_round."""
+
+    def post_train(client_vars, global_vars, noise_rng):
+        return jax.vmap(
+            lambda cv: clip_update_tree(cv, global_vars, dp.clip_norm)
+        )(client_vars)
+
+    def aggregate_fn(client_vars, num_samples):
+        # UNIFORM mean — num_samples is deliberately unused (weights would
+        # tie the sensitivity to private shard sizes)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.mean(s.astype(jnp.float32), axis=0), client_vars
+        )
+
+    stddev = dp.noise_multiplier * dp.clip_norm / cohort_size
+
+    def post_aggregate(new_global, noise_rng):
+        flat, treedef = jax.tree_util.tree_flatten(new_global)
+        rngs = jax.random.split(noise_rng, len(flat))
+        noised = [
+            leaf + jax.random.normal(r, leaf.shape, jnp.float32) * stddev
+            for r, leaf in zip(rngs, flat)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, noised)
+
+    return post_train, aggregate_fn, post_aggregate
+
+
+class DPFedAvgAPI(FedAvgAPI):
+    """FedAvg simulator with client-level DP and per-round accounting."""
+
+    _supports_fused = False  # the accountant steps on the host every round
+
+    def __init__(self, config, data, model, dp: DpConfig = DpConfig(), **kw):
+        self.dp = dp
+        super().__init__(config, data, model, **kw)
+        self.accountant = RdpAccountant()
+        self._q = (
+            config.fed.client_num_per_round / config.fed.client_num_in_total
+        )
+
+    def _build_round_fn(self, local_train_fn):
+        post_train, aggregate_fn, post_aggregate = make_dp_hooks(
+            self.dp, self.config.fed.client_num_per_round
+        )
+        return make_fedavg_round(
+            self.model,
+            self.config,
+            task=self.task,
+            local_train_fn=local_train_fn,
+            donate=self._donate,
+            post_train=post_train,
+            aggregate_fn=aggregate_fn,
+            post_aggregate=post_aggregate,
+        )
+
+    def _place_batch(self, batch, round_rng):
+        base = super()._place_batch(batch, round_rng)
+        return base + (jax.random.fold_in(round_rng, NOISE_FOLD),)
+
+    def train_round(self, round_idx: int):
+        out = super().train_round(round_idx)
+        self.accountant.step(self._q, self.dp.noise_multiplier)
+        return out
+
+    def checkpoint_state(self):
+        """The RDP ledger is round state: a resume that dropped it would
+        report the epsilon of the post-crash rounds only — under-claiming
+        the true privacy cost of everything already released."""
+        import numpy as np
+
+        return {
+            "dp_rdp": np.asarray(self.accountant._rdp, np.float64),
+            "dp_rounds": np.asarray(self.accountant.rounds, np.int64),
+        }
+
+    def restore_state(self, tree):
+        import numpy as np
+
+        self.accountant._rdp = [float(v) for v in np.asarray(tree["dp_rdp"])]
+        self.accountant.rounds = int(np.asarray(tree["dp_rounds"]))
+
+    def privacy_spent(self):
+        eps, order = self.accountant.epsilon(self.dp.delta)
+        return {
+            "DP/epsilon": round(float(eps), 4),
+            "DP/delta": self.dp.delta,
+            "DP/rdp_order": order,
+            "DP/rounds_accounted": self.accountant.rounds,
+            "DP/sampling_note": (
+                "fixed-size cohort accounted as Poisson sampling at "
+                f"q={self._q:.4g} (standard DP-FL convention)"
+            ),
+        }
+
+    def train(self):
+        final = dict(super().train() or {})
+        spent = self.privacy_spent()
+        final.update(spent)
+        self.log_fn(spent)
+        return final
