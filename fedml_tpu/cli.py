@@ -313,6 +313,10 @@ def run(**opt):
     from fedml_tpu.utils.profiling import trace
 
     config = build_config(opt)
+    # validate DP flags BEFORE data/model setup (a z<=0 would otherwise
+    # surface as a mid-run crash after minutes of dataset loading); the
+    # result is rebuilt at the _build_api call site
+    _dp_cfg(opt)
     if opt["runtime"] in ("vmap", "mesh"):
         if config.comm.compression != "none":
             raise click.UsageError(
@@ -689,6 +693,12 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                 config, data, model, task=task, log_fn=log_fn,
                 lam=ditto_lambda,
             )
+        if algorithm == "dp_fedavg":
+            from fedml_tpu.parallel import DistributedDPFedAvgAPI
+
+            return DistributedDPFedAvgAPI(
+                config, data, model, task=task, log_fn=log_fn, dp=dp_cfg,
+            )
         if algorithm == "hierarchical":
             from fedml_tpu.parallel import HierarchicalShardedAPI
 
@@ -699,7 +709,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
         if algorithm not in ("fedavg", "fedprox"):
             raise click.UsageError(
                 "runtime=mesh currently supports fedavg/fedprox/fedopt/"
-                "fednova/scaffold/ditto/hierarchical/fedavg_robust"
+                "fednova/scaffold/ditto/dp_fedavg/hierarchical/fedavg_robust"
             )
         return DistributedFedAvgAPI(config, data, model, task=task, log_fn=log_fn)
 

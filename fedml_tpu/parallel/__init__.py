@@ -13,6 +13,7 @@ from fedml_tpu.parallel.fedavg_sharded import (
     DistributedFedAvgAPI,
     DistributedFedNovaAPI,
     DistributedDittoAPI,
+    DistributedDPFedAvgAPI,
     DistributedScaffoldAPI,
     DistributedFedOptAPI,
     RobustDistributedFedAvgAPI,
@@ -40,6 +41,7 @@ __all__ = [
     "DistributedFedAvgAPI",
     "DistributedFedNovaAPI",
     "DistributedDittoAPI",
+    "DistributedDPFedAvgAPI",
     "DistributedScaffoldAPI",
     "DistributedFedOptAPI",
     "RobustDistributedFedAvgAPI",

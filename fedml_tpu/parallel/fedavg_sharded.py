@@ -35,6 +35,7 @@ from fedml_tpu.algorithms.fednova import FedNovaAPI
 from fedml_tpu.algorithms.fedopt import FedOptAPI
 from fedml_tpu.algorithms.ditto import DittoAPI
 from fedml_tpu.algorithms.scaffold import ScaffoldAPI
+from fedml_tpu.privacy.dp_fedavg import DPFedAvgAPI
 from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import ClientBatch, FederatedDataset
 from fedml_tpu.models import ModelDef
@@ -250,6 +251,57 @@ class RobustDistributedFedAvgAPI(DistributedFedAvgAPI):
 
         base = super()._place_batch(batch, round_rng)
         return base + (jax.random.fold_in(round_rng, NOISE_FOLD),)
+
+
+class DistributedDPFedAvgAPI(DPFedAvgAPI, DistributedFedAvgAPI):
+    """Client-level DP-FedAvg on the multi-chip mesh runtime. Cooperative
+    MRO: DPFedAvgAPI supplies the clip/noise hooks, the RDP ledger, and
+    its checkpoint/reporting contract; DistributedFedAvgAPI supplies the
+    mesh bootstrap and sharded batch placement (the noise rng rides the
+    same _place_batch chain); this class swaps the round for the sharded
+    skeleton with a psum uniform mean.
+
+    DP subtlety under mesh padding: the uniform mean must divide by the
+    REAL cohort size m, never the padded client axis — the cohort is
+    therefore required to divide the mesh (same stance as the Byzantine
+    aggregators, whose order statistics padding would also corrupt)."""
+
+    def __init__(self, config, data, model, dp=None, mesh=None, **kw):
+        from fedml_tpu.privacy import DpConfig
+
+        super().__init__(
+            config, data, model, dp=dp or DpConfig(), mesh=mesh, **kw
+        )
+        if config.fed.client_num_per_round % self.n_shards:
+            raise ValueError(
+                f"DP on the mesh needs client_num_per_round "
+                f"({config.fed.client_num_per_round}) divisible by the mesh "
+                f"({self.n_shards}) — a padded cohort would skew the "
+                "uniform mean's sensitivity bound"
+            )
+
+    def _build_round_fn(self, local_train_fn):
+        from fedml_tpu.privacy.dp_fedavg import make_dp_hooks
+
+        # the sharded skeleton all_gathers the full client stack before
+        # calling aggregate_fn (same view as the vmap runtime), so the
+        # single-chip uniform mean applies unchanged — and with the
+        # cohort dividing the mesh there are no padding rows to skew it
+        post_train, aggregate_fn, post_aggregate = make_dp_hooks(
+            self.dp, self.config.fed.client_num_per_round
+        )
+        return make_sharded_fedavg_round(
+            self.model,
+            self.config,
+            self.mesh,
+            task=self.task,
+            local_train_fn=local_train_fn,
+            donate=self._donate,
+            post_train=post_train,
+            post_aggregate=post_aggregate,
+            aggregate_fn=aggregate_fn,
+            n_extra=1,  # the replicated noise rng
+        )
 
 
 class DistributedFedNovaAPI(FedNovaAPI, DistributedFedAvgAPI):

@@ -205,6 +205,43 @@ def test_cli_rejects_degenerate_dp_flags():
         assert "dp_" in result.output, bad
 
 
+def test_mesh_dp_matches_vmap():
+    """DistributedDPFedAvgAPI (psum uniform mean + the same clip/noise
+    hooks) == the single-chip DPFedAvgAPI at the same seed — the noise
+    rng chain is identical, so results agree to float tolerance."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from fedml_tpu.parallel import DistributedDPFedAvgAPI
+
+    data, model = _data_model()
+    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.7)
+    sim = DPFedAvgAPI(_cfg(rounds=3, per_round=8), data, model, dp=dp)
+    mesh = DistributedDPFedAvgAPI(
+        _cfg(rounds=3, per_round=8), data, model, dp=dp
+    )
+    for r in range(3):
+        sim.train_round(r)
+        mesh.train_round(r)
+    assert mesh.accountant.rounds == sim.accountant.rounds == 3
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sim.global_vars),
+        jax.tree_util.tree_leaves(mesh.global_vars),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_mesh_dp_rejects_nondivisible_cohort():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from fedml_tpu.parallel import DistributedDPFedAvgAPI
+
+    data, model = _data_model()
+    with pytest.raises(ValueError):
+        DistributedDPFedAvgAPI(_cfg(rounds=1, per_round=6), data, model)
+
+
 def test_cli_dp_fedavg_reachable():
     import json
 
