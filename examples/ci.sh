@@ -20,7 +20,7 @@ echo "== fast unit tier =="
 python -m pytest tests/ -q -m 'not slow' -x
 
 echo "== CLI smoke: one round per algorithm family (ref CI-script-fedavg.sh:33-39) =="
-for algo in fedavg fedopt fedprox fednova scaffold hierarchical fedavg_robust; do
+for algo in fedavg fedopt fedprox fednova scaffold ditto dp_fedavg hierarchical fedavg_robust; do
   python -m fedml_tpu --algorithm "$algo" --model lr --dataset synthetic \
     --client_num_in_total 8 --client_num_per_round 4 --comm_round 1 \
     --epochs 1 --ci > /dev/null
@@ -28,7 +28,7 @@ for algo in fedavg fedopt fedprox fednova scaffold hierarchical fedavg_robust; d
 done
 
 echo "== CLI smoke: mesh runtime (8-shard virtual farm) =="
-for algo in fedavg fedopt fednova scaffold fedavg_robust; do
+for algo in fedavg fedopt fednova scaffold ditto dp_fedavg fedavg_robust; do
   python -m fedml_tpu --algorithm "$algo" --runtime mesh --model lr \
     --dataset synthetic --client_num_in_total 8 --client_num_per_round 8 \
     --comm_round 1 --epochs 1 --ci > /dev/null
@@ -50,6 +50,14 @@ python -m fedml_tpu --algorithm fedavg --runtime loopback --secure_agg \
   --model lr --dataset synthetic --client_num_in_total 4 \
   --client_num_per_round 4 --comm_round 1 --ci > /dev/null
 echo "  transport ok"
+
+echo "== CLI smoke: async federation (fedbuff, barrier-free) =="
+for rt in loopback shm; do
+  python -m fedml_tpu --algorithm fedbuff --runtime "$rt" --model lr \
+    --dataset synthetic --client_num_in_total 6 --client_num_per_round 3 \
+    --comm_round 2 --async_buffer_k 2 > /dev/null
+  echo "  fedbuff/$rt ok"
+done
 
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

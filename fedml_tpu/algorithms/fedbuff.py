@@ -257,7 +257,31 @@ class FedBuffClientManager(ClientManager):
         out.add_params(MT.ARG_ASYNC_DELTA, delta)
         out.add_params(MT.ARG_NUM_SAMPLES, n)
         out.add_params(MT.ARG_BASE_VERSION, msg.get(MT.ARG_BASE_VERSION))
-        self.send_message(out)
+        import time as _time
+
+        for attempt in (1, 2):
+            try:
+                self.send_message(out)
+                return
+            except Exception as e:  # noqa: BLE001 — transport errors vary
+                if attempt == 1:
+                    # one retry distinguishes a transient blip from the
+                    # two terminal cases below
+                    _time.sleep(0.5)
+                    continue
+                # Either the normal end-of-run race — the server reached
+                # its last buffer flush and shut down while we were still
+                # training (its FINISH is already in our inbox and ends
+                # the loop) — or a genuinely lost server. Either way the
+                # barrier-free protocol has no one to hand the delta to;
+                # WARN loudly because in the mid-run case this worker
+                # idles until FINISH (the server only re-dispatches on
+                # upload receipt).
+                logging.warning(
+                    "async upload from rank %d undeliverable after retry "
+                    "(%s) — normal if the server just finished; otherwise "
+                    "this worker is idle until FINISH", self.rank, e,
+                )
 
 
 def run_fedbuff_federation(

@@ -440,9 +440,9 @@ def run(**opt):
     if opt["runtime"] == "grpc":
         # true multi-process federation: this process is ONE participant
         # (ref main_fedavg_rpc.py per-process drivers + run_*.sh launchers)
-        if opt["algorithm"] not in ("fedavg", "fedprox", "fedopt"):
+        if opt["algorithm"] not in ("fedavg", "fedprox", "fedopt", "fedbuff"):
             raise click.UsageError(
-                "runtime=grpc supports fedavg/fedprox/fedopt"
+                "runtime=grpc supports fedavg/fedprox/fedopt/fedbuff"
             )
         final = _run_grpc_process(config, data, model, task, log_fn, opt)
         logger.close()
@@ -996,6 +996,25 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
     else:
         table = {r: "127.0.0.1" for r in range(K + 1)}
     comm = GrpcCommManager(rank, table, base_port=opt["base_port"])
+    if opt["algorithm"] == "fedbuff":
+        from fedml_tpu.algorithms.fedbuff import (
+            FedBuffClientManager,
+            FedBuffServerManager,
+        )
+
+        if rank == 0:
+            server = FedBuffServerManager(
+                config, comm, model, data=data, task=task, worker_num=K,
+                log_fn=log_fn,
+            )
+            server.send_init_msg()
+            server.run()
+            return server.history[-1] if server.history else {}
+        client = FedBuffClientManager(
+            config, comm, rank, LocalTrainer(config, data, model, task)
+        )
+        client.run()
+        return {"rank": rank, "finished": True}
     if rank == 0:
         server = FedAvgServerManager(
             config, comm, model, data=data, task=task, worker_num=K,

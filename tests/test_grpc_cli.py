@@ -60,6 +60,58 @@ def test_multiprocess_grpc_federation(tmp_path):
 
 
 @pytest.mark.slow
+def test_multiprocess_async_grpc_federation(tmp_path):
+    """Barrier-free federation across real OS processes over gRPC:
+    rank 0 runs the FedBuff server, ranks 1-2 train-on-arrival. The
+    server must complete every buffered step and exit 0 — and the
+    clients must exit 0 too, even when their LAST upload races the
+    server's shutdown (the normal async end-of-run)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    base = [
+        sys.executable, "-m", "fedml_tpu",
+        "--algorithm", "fedbuff",
+        "--runtime", "grpc",
+        "--dataset", "synthetic",
+        "--model", "lr",
+        "--client_num_in_total", "6",
+        "--client_num_per_round", "2",
+        "--comm_round", "4",
+        "--async_buffer_k", "2",
+        "--batch_size", "8",
+        "--base_port", "9350",
+        "--seed", "5",
+    ]
+    procs = [
+        subprocess.Popen(
+            base + ["--rank", str(rank)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for rank in (1, 2, 0)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    server_out = outs[-1]
+    last = [l for l in server_out.splitlines() if l.startswith("{")][-1]
+    row = json.loads(last)
+    assert row["server_step"] == 4
+    assert "staleness_mean" in row
+
+
+@pytest.mark.slow
 def test_grpc_client_killed_mid_round_server_completes_on_quorum(tmp_path):
     """Chaos: one client process is SIGKILLed mid-federation (VERDICT r2
     Next #7). The server must absorb the dead peer (broadcast failures
