@@ -806,7 +806,9 @@ def main():
             and "rounds_per_sec" in (eager_loop or {})
             else None
         ),
-        "fused_note": None if not fused_loop else (
+        "fused_note": None if not (
+            fused_loop and "rounds_per_sec" in fused_loop
+        ) else (
             "r2's 13% fused regression (chunk-max step padding) is "
             "eliminated: across interleaved best-of-4 passes the "
             "fused/eager ratio measures 1.00-1.29, never below "

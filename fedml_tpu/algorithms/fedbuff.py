@@ -112,6 +112,12 @@ class FedBuffServerManager(ServerManager):
         self._buffer_taus: List[int] = []
         self._finished = False
         self._dead_workers: set = set()
+        # at-least-once delivery dedupe: a client retries an upload whose
+        # RPC erred client-side AFTER server-side delivery (e.g. a unary
+        # deadline hit while the server was busy flushing); the dispatch
+        # tag is unique per assignment and one assignment is outstanding
+        # per worker, so last-tag-per-sender drops the duplicate
+        self._last_upload_tag: Dict[int, int] = {}
         self._lock = threading.Lock()
         self.staleness_seen: List[int] = []  # one entry per buffered delta
         self.global_vars = jax.device_get(
@@ -169,6 +175,16 @@ class FedBuffServerManager(ServerManager):
                     msg.get_sender_id(),
                 )
                 return
+            sender = msg.get_sender_id()
+            tag = msg.get(MT.ARG_ROUND_IDX, -1)
+            if tag >= 0 and self._last_upload_tag.get(sender) == tag:
+                logging.info(
+                    "async server dropping duplicate upload from rank %d "
+                    "(dispatch tag %d already buffered — client retry "
+                    "after a delivered-but-errored RPC)", sender, tag,
+                )
+                return
+            self._last_upload_tag[sender] = tag
             tau = self.version - int(base)
             self._buffer.append(delta)
             self._buffer_taus.append(tau)
@@ -230,23 +246,89 @@ class FedBuffClientManager(ClientManager):
     answered with a delta; FINISH ends the loop. Runs the SAME jitted
     local-train scan as the sync transport client."""
 
+    #: seconds a worker waits, AFTER an upload, for the server's reply
+    #: (redispatch or FINISH) before declaring itself orphaned. This is a
+    #: HANG guard, not a latency SLA: without it a dead server leaves a
+    #: silently-hung process parked on its inbox forever. The default is
+    #: deliberately generous because the reply to the k-th uploader waits
+    #: on the server's buffer flush, whose first occurrence (and first
+    #: eval round) pays a jit compile — minutes on a slow CI host.
+    #: Startup is fully exempt: a worker waiting for its FIRST dispatch
+    #: waits indefinitely (clients legitimately start before the server).
+    #: Override per-instance via the constructor.
+    ORPHAN_DEADLINE_S = 600.0
+
     def __init__(
         self,
         config: RunConfig,
         comm: BaseCommManager,
         rank: int,
         trainer: LocalTrainer,
+        orphan_deadline_s: Optional[float] = None,
     ):
         super().__init__(comm, rank)
         self.config = config
         self.trainer = trainer
+        if orphan_deadline_s is not None:
+            self.ORPHAN_DEADLINE_S = float(orphan_deadline_s)
+        self._got_finish = False
+        self._liveness_timer: Optional[threading.Timer] = None
+        # arm/disarm/fire are serialized by this lock + generation counter:
+        # Timer.cancel() cannot stop a callback already executing at the
+        # deadline boundary, but a stale generation makes it a no-op
+        self._live_lock = threading.Lock()
+        self._live_gen = 0
+        self.orphaned = False  # set by the deadman timer; checked by runners
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MT.S2C_INIT_CONFIG, self._on_model)
         self.register_message_receive_handler(MT.S2C_SYNC_MODEL, self._on_model)
-        self.register_message_receive_handler(MT.FINISH, lambda m: self.finish())
+        self.register_message_receive_handler(MT.FINISH, self._on_finish)
+
+    def _on_finish(self, msg: Message):
+        self._got_finish = True
+        self.finish()
+
+    def finish(self):
+        # disarm on EVERY termination path (FINISH, runner-driven, deadman)
+        # — a timer left armed across an external finish() would later fire
+        # and spuriously mark an already-exited worker orphaned
+        self._disarm_liveness()
+        super().finish()
+
+    def _arm_liveness(self):
+        with self._live_lock:
+            self._live_gen += 1
+            if self._liveness_timer is not None:
+                self._liveness_timer.cancel()
+            t = threading.Timer(
+                self.ORPHAN_DEADLINE_S, self._deadman, args=(self._live_gen,)
+            )
+            t.daemon = True
+            t.start()
+            self._liveness_timer = t
+
+    def _disarm_liveness(self):
+        with self._live_lock:
+            self._live_gen += 1
+            if self._liveness_timer is not None:
+                self._liveness_timer.cancel()
+                self._liveness_timer = None
+
+    def _deadman(self, gen: int):
+        with self._live_lock:
+            if gen != self._live_gen or self._got_finish:
+                return  # a reply/finish won the race — stale timer
+            self.orphaned = True
+        logging.error(
+            "async worker rank %d: no server reply within %.0fs of the "
+            "last upload — server lost; exiting as ORPHANED",
+            self.rank, self.ORPHAN_DEADLINE_S,
+        )
+        self.finish()
 
     def _on_model(self, msg: Message):
+        self._disarm_liveness()
         self.trainer.update_dataset(msg.get(MT.ARG_CLIENT_INDEX))
         w_base = msg.get(MT.ARG_MODEL_PARAMS)
         new_vars, n = self.trainer.train(msg.get(MT.ARG_ROUND_IDX), w_base)
@@ -257,31 +339,42 @@ class FedBuffClientManager(ClientManager):
         out.add_params(MT.ARG_ASYNC_DELTA, delta)
         out.add_params(MT.ARG_NUM_SAMPLES, n)
         out.add_params(MT.ARG_BASE_VERSION, msg.get(MT.ARG_BASE_VERSION))
+        # dispatch tag: unique per assignment — the server's duplicate
+        # filter keys on it (the retry below is at-least-once delivery)
+        out.add_params(MT.ARG_ROUND_IDX, msg.get(MT.ARG_ROUND_IDX))
         import time as _time
 
-        for attempt in (1, 2):
-            try:
-                self.send_message(out)
-                return
-            except Exception as e:  # noqa: BLE001 — transport errors vary
-                if attempt == 1:
-                    # one retry distinguishes a transient blip from the
-                    # two terminal cases below
-                    _time.sleep(0.5)
-                    continue
-                # Either the normal end-of-run race — the server reached
-                # its last buffer flush and shut down while we were still
-                # training (its FINISH is already in our inbox and ends
-                # the loop) — or a genuinely lost server. Either way the
-                # barrier-free protocol has no one to hand the delta to;
-                # WARN loudly because in the mid-run case this worker
-                # idles until FINISH (the server only re-dispatches on
-                # upload receipt).
-                logging.warning(
-                    "async upload from rank %d undeliverable after retry "
-                    "(%s) — normal if the server just finished; otherwise "
-                    "this worker is idle until FINISH", self.rank, e,
-                )
+        try:
+            for attempt in (1, 2):
+                try:
+                    self.send_message(out)
+                    return
+                except Exception as e:  # noqa: BLE001 — transport errors vary
+                    if attempt == 1:
+                        # one retry distinguishes a transient blip from the
+                        # two terminal cases below
+                        _time.sleep(0.5)
+                        continue
+                    # Either the normal end-of-run race — the server
+                    # reached its last buffer flush and shut down while we
+                    # were still training (its FINISH is already in our
+                    # inbox and ends the loop as soon as this handler
+                    # returns) — or a genuinely lost server. The liveness
+                    # deadman armed below separates the two: FINISH within
+                    # ORPHAN_DEADLINE_S is the clean race, silence marks
+                    # this worker ORPHANED (visible, nonzero-exit via the
+                    # runners) instead of a silent forever-block.
+                    logging.warning(
+                        "async upload from rank %d undeliverable after "
+                        "retry (%s) — waiting %.0fs for FINISH",
+                        self.rank, e, self.ORPHAN_DEADLINE_S,
+                    )
+        finally:
+            # armed on BOTH outcomes: after a successful upload the server
+            # replies immediately (redispatch or FINISH) in steady state,
+            # so a silent gap past the deadline means the server died
+            # between our upload and its reply
+            self._arm_liveness()
 
 
 def run_fedbuff_federation(
@@ -339,6 +432,12 @@ def run_fedbuff_federation(
         t.join(timeout=60)
         if t.is_alive():
             raise RuntimeError("async client thread failed to finish")
+    orphans = [c.rank for c in clients if c.orphaned]
+    if orphans:
+        raise RuntimeError(
+            f"async workers {orphans} were orphaned (server unreachable, "
+            "no FINISH) — federation did not terminate cleanly"
+        )
     return server
 
 

@@ -1014,6 +1014,11 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
             config, comm, rank, LocalTrainer(config, data, model, task)
         )
         client.run()
+        if client.orphaned:
+            raise click.ClickException(
+                f"async worker rank {rank} orphaned: server unreachable "
+                "and no FINISH within its deadline"
+            )
         return {"rank": rank, "finished": True}
     if rank == 0:
         server = FedAvgServerManager(

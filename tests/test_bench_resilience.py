@@ -1,0 +1,47 @@
+"""The benchmark's one-shot record must survive pathology: budget
+exhaustion and failing sections degrade to self-describing rows, never to
+a missing or unparseable record (the driver runs bench.py exactly once
+per round — a lost record loses the round's perf evidence)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_exhausted_budget_still_emits_one_json_record():
+    """FEDML_TPU_BENCH_BUDGET_S=1: every section (including the mandatory
+    throughput rows, which carry min_remaining_s=0 but are budget-gated
+    like the rest) skips, and the script still prints exactly one JSON
+    line with value=None, the error marker, and a skip reason per
+    section."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # inherited by the backend-alive probe
+    env["FEDML_TPU_BENCH_BUDGET_S"] = "1"
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout[-2000:]
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "femnist_cnn_fedavg_rounds_per_sec"
+    assert rec["value"] is None
+    assert rec["error"] == "all throughput sections failed"
+    # the degraded record still carries every section slot, each naming why
+    for key in ("north_star", "bf16_cross_silo_resnet56", "mxu_validation",
+                "scale_100k_clients"):
+        assert "skipped" in rec[key], key
+    for row in rec["hard_accuracy"]["synthetic11"]:
+        assert "skipped" in row
+    # no fabricated measurement claims in a record with no measurements
+    assert rec["fused_note"] is None
+    assert rec["fused_vs_eager_trainloop"] is None

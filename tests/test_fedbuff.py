@@ -240,6 +240,91 @@ def test_async_survives_dead_worker():
     killer.cancel()
 
 
+def test_async_worker_orphan_detection():
+    """A worker whose server is genuinely dead (uploads undeliverable, no
+    FINISH ever arrives) must exit VISIBLY as orphaned within its deadline
+    — never hang forever parked on its inbox."""
+    import threading
+
+    from fedml_tpu.algorithms.fedavg_transport import LocalTrainer
+    from fedml_tpu.algorithms.fedbuff import FedBuffClientManager
+    from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+    from fedml_tpu.core.message import Message, MessageType as MT
+
+    data = synthetic_classification(
+        num_clients=2, num_classes=2, feat_shape=(4,), samples_per_client=8,
+    )
+    model = create_model("lr", "synthetic", (4,), 2)
+    cfg = _cfg(comm_round=2, k=1, workers=1, total=2)
+    hub = LoopbackHub()
+
+    class DeadServerComm(LoopbackCommManager):
+        def send_message(self, msg):
+            if msg.get_receiver_id() == 0:
+                raise ConnectionError("server gone")
+            super().send_message(msg)
+
+    client = FedBuffClientManager(
+        cfg, DeadServerComm(hub, 1), 1,
+        LocalTrainer(cfg, data, model, "classification"),
+    )
+    client.ORPHAN_DEADLINE_S = 0.5
+    dispatch = Message(MT.S2C_INIT_CONFIG, 0, 1)
+    dispatch.add_params(
+        MT.ARG_MODEL_PARAMS,
+        __import__("jax").device_get(
+            model.init(__import__("jax").random.PRNGKey(0))
+        ),
+    )
+    dispatch.add_params(MT.ARG_CLIENT_INDEX, 0)
+    dispatch.add_params(MT.ARG_BASE_VERSION, 0)
+    dispatch.add_params(MT.ARG_ROUND_IDX, 1)
+    hub.deliver(dispatch)
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), "orphaned worker failed to exit"
+    assert client.orphaned
+
+
+def test_async_server_drops_duplicate_upload():
+    """At-least-once delivery: a retried upload whose first copy WAS
+    delivered (client-side RPC error after server-side receipt) must not
+    be buffered twice — the dispatch tag dedupes it."""
+    from fedml_tpu.algorithms.fedbuff import FedBuffServerManager
+    from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+    from fedml_tpu.core.message import Message, MessageType as MT
+
+    data = synthetic_classification(
+        num_clients=4, num_classes=2, feat_shape=(4,), samples_per_client=8,
+    )
+    model = create_model("lr", "synthetic", (4,), 2)
+    cfg = _cfg(comm_round=5, k=3, workers=2, total=4)
+    server = FedBuffServerManager(
+        cfg, LoopbackCommManager(LoopbackHub(), 0), model, data=data,
+        worker_num=2,
+    )
+    delta = jax.device_get(
+        jax.tree_util.tree_map(jnp.zeros_like, server.global_vars)
+    )
+    up = Message(MT.C2S_SEND_MODEL, 1, 0)
+    up.add_params(MT.ARG_ASYNC_DELTA, delta)
+    up.add_params(MT.ARG_NUM_SAMPLES, 8)
+    up.add_params(MT.ARG_BASE_VERSION, 0)
+    up.add_params(MT.ARG_ROUND_IDX, 7)  # dispatch tag
+    server._on_delta_from_client(up)
+    server._on_delta_from_client(up)  # the retry duplicate
+    assert len(server._buffer) == 1
+    # a NEW assignment (different tag) from the same worker is accepted
+    up2 = Message(MT.C2S_SEND_MODEL, 1, 0)
+    up2.add_params(MT.ARG_ASYNC_DELTA, delta)
+    up2.add_params(MT.ARG_NUM_SAMPLES, 8)
+    up2.add_params(MT.ARG_BASE_VERSION, 0)
+    up2.add_params(MT.ARG_ROUND_IDX, 9)
+    server._on_delta_from_client(up2)
+    assert len(server._buffer) == 2
+
+
 def test_async_requires_buffer_k():
     import pytest
 
