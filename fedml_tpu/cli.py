@@ -45,6 +45,7 @@ ALGORITHMS = (
     "fedbuff",  # beyond the reference: barrier-free async aggregation
     "ditto",  # beyond the reference: personalized FL (per-client models)
     "dp_fedavg",  # beyond the reference: client-level DP with RDP ledger
+    "qfedavg",  # beyond the reference: q-FFL fair aggregation
     "hierarchical",
     "fedavg_robust",
     "fedgkt",
@@ -137,6 +138,9 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="How one chip runs the sampled clients: vmap (batched) "
                    "or scan (sequential — faster for conv models whose "
                    "small channels under-tile the MXU); auto picks per model")
+@click.option("--qffl_q", type=float, default=1.0,
+              help="algorithm=qfedavg: fairness exponent q (0 = plain "
+                   "FedAvg; larger = more uniform accuracy across clients)")
 @click.option("--dp_clip", type=float, default=1.0,
               help="algorithm=dp_fedavg: per-client update L2 clip S")
 @click.option("--dp_noise_multiplier", type=float, default=1.0,
@@ -486,6 +490,7 @@ def run(**opt):
         attack_cfg=attack_cfg,
         ditto_lambda=opt.get("ditto_lambda", 0.1),
         dp_cfg=_dp_cfg(opt),
+        qffl_q=opt.get("qffl_q", 1.0),
     )
     api_cell.append(api)
 
@@ -587,7 +592,7 @@ def _restore(api, opt):
 def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                defense="norm_diff_clipping", num_byzantine=1, multi_krum_m=3,
                norm_bound=5.0, noise_stddev=0.025, attack_cfg=None,
-               ditto_lambda=0.1, dp_cfg=None):
+               ditto_lambda=0.1, dp_cfg=None, qffl_q=1.0):
     from fedml_tpu.robustness import RobustConfig
 
     # one RobustConfig for whichever runtime's robust API is selected —
@@ -741,6 +746,12 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
 
         return DPFedAvgAPI(
             config, data, model, task=task, log_fn=log_fn, dp=dp_cfg or DpConfig(),
+        )
+    if algorithm == "qfedavg":
+        from fedml_tpu.algorithms.qfedavg import QFedAvgAPI
+
+        return QFedAvgAPI(
+            config, data, model, task=task, log_fn=log_fn, q=qffl_q,
         )
     if algorithm == "hierarchical":
         from fedml_tpu.algorithms import HierarchicalFedAvgAPI

@@ -1,0 +1,126 @@
+"""q-FedAvg fair aggregation (algorithms/qfedavg.py) — beyond the
+reference's inventory (no fairness-aware aggregation anywhere in
+SURVEY §2b)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.qfedavg import QFedAvgAPI, qfedavg_update
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+
+
+def _cfg(rounds=3, per_round=4, total=8, lr=0.1):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=total, client_num_per_round=per_round,
+            comm_round=rounds, epochs=1, frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=lr),
+        seed=0,
+    )
+
+
+def _data_model(**kw):
+    data = synthetic_classification(
+        num_clients=8, num_classes=3, feat_shape=(6,), samples_per_client=16,
+        partition_method="homo", ragged=False, seed=0, **kw,
+    )
+    return data, create_model("lr", "synthetic", (6,), 3)
+
+
+def test_q_zero_equals_uniform_mean():
+    """Degenerate-config oracle: q=0 reduces q-FedAvg to the uniform mean
+    of the client models (Delta_k = g_k, h_k = 1/lr)."""
+    key = jax.random.PRNGKey(0)
+    gv = {"w": jax.random.normal(key, (4, 3)), "b": jnp.zeros((3,))}
+    cvs = jax.tree_util.tree_map(
+        lambda g: jnp.stack(
+            [g + 0.1 * jax.random.normal(jax.random.fold_in(key, i), g.shape)
+             for i in range(5)]
+        ),
+        gv,
+    )
+    losses = jnp.asarray([0.5, 2.0, 1.0, 0.1, 3.0])
+    out = qfedavg_update(gv, cvs, losses, lr=0.1, q=0.0)
+    mean = jax.tree_util.tree_map(lambda s: jnp.mean(s, axis=0), cvs)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(mean)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_q_upweights_high_loss_clients():
+    """q>0 pulls the update toward the high-loss client's direction."""
+    gv = {"w": jnp.zeros((6,))}
+    lo = {"w": jnp.ones((6,)) * 0.1}    # low-loss client's model
+    hi = {"w": -jnp.ones((6,)) * 0.1}   # high-loss client's model
+    cvs = jax.tree_util.tree_map(lambda a, b: jnp.stack([a, b]), lo, hi)
+    losses = jnp.asarray([0.1, 5.0])
+    out0 = qfedavg_update(gv, cvs, losses, lr=0.1, q=0.0)["w"]
+    out2 = qfedavg_update(gv, cvs, losses, lr=0.1, q=2.0)["w"]
+    # q=0: exact midpoint (zero); q=2: dominated by the high-loss client
+    np.testing.assert_allclose(np.asarray(out0), 0.0, atol=1e-6)
+    assert float(out2[0]) < -0.05  # pulled toward hi's -0.1
+
+
+def test_qfedavg_round_q0_matches_fedavg_uniform():
+    """Full-round oracle on equal shard sizes: QFedAvgAPI at q=0 ==
+    FedAvgAPI (whose sample weights are uniform when shards are equal)."""
+    data, model = _data_model()
+    qa = QFedAvgAPI(_cfg(), data, model, q=0.0)
+    fa = FedAvgAPI(_cfg(), data, model)
+    for r in range(3):
+        qa.train_round(r)
+        fa.train_round(r)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(qa.global_vars),
+        jax.tree_util.tree_leaves(fa.global_vars),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_qfedavg_learns_and_rejects_momentum():
+    data, model = _data_model()
+    api = QFedAvgAPI(_cfg(rounds=20, per_round=8), data, model, q=1.0)
+    for r in range(20):
+        api.train_round(r)
+    _, acc = api.evaluate_global()
+    assert acc > 0.8, f"q-FedAvg failed to learn: {acc}"
+    with pytest.raises(ValueError):
+        QFedAvgAPI(
+            RunConfig(
+                data=DataConfig(batch_size=8),
+                fed=FedConfig(client_num_in_total=4, client_num_per_round=2),
+                train=TrainConfig(client_optimizer="sgd", momentum=0.9),
+            ),
+            data, model, q=1.0,
+        )
+
+
+def test_cli_qfedavg_reachable():
+    import json
+
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    result = CliRunner().invoke(
+        main,
+        [
+            "--algorithm", "qfedavg", "--dataset", "synthetic",
+            "--model", "lr", "--client_num_in_total", "8",
+            "--client_num_per_round", "4", "--comm_round", "2",
+            "--batch_size", "8", "--lr", "0.1", "--qffl_q", "1.0",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    row = json.loads(result.output.strip().splitlines()[-1])
+    assert "Test/Acc" in row
