@@ -214,9 +214,10 @@ def make_fedavg_round(
             if post_train is not None:
                 client_vars = post_train(client_vars, global_vars, *extra)
             # aggregate_fn replaces the weighted average outright (Byzantine-
-            # robust aggregators: median/trimmed-mean/Krum)
+            # robust aggregators: median/trimmed-mean/Krum; DP's fixed-
+            # denominator estimator needs w_t, hence the third argument)
             if aggregate_fn is not None:
-                new_global = aggregate_fn(client_vars, num_samples)
+                new_global = aggregate_fn(client_vars, num_samples, global_vars)
             else:
                 new_global = weighted_average(client_vars, num_samples)
             if post_aggregate is not None:
@@ -592,17 +593,29 @@ class FedAvgAPI:
             from fedml_tpu.data.base import bucket_steps
 
             cfg = self.config
-            sampled = client_sampling(
-                round_idx, self.data.num_clients, cfg.fed.client_num_per_round
-            )
+            sampled = self._sample_clients(round_idx)
             steps, bs, _ = bucket_steps(
-                self._client_counts(sampled),
+                # an empty cohort (possible under DP's Poisson sampling) still
+                # needs a well-formed shape class — shape it like 1 sample
+                self._client_counts(sampled) if len(sampled) else [1],
                 cfg.data.batch_size,
                 cfg.data.pad_bucket,
             )
             plan = (sampled, steps, bs)
             self._round_plans[round_idx] = plan
         return plan
+
+    def _sample_clients(self, round_idx: int) -> np.ndarray:
+        """This round's cohort draw. The default is the reference-parity
+        round-seeded fixed-size draw (:func:`client_sampling`) — deterministic
+        by design, so runs are reproducible and resumable. Algorithms whose
+        GUARANTEES depend on the randomness of participation override this
+        (DP-FedAvg draws Poisson cohorts from a run-seeded secret stream:
+        privacy amplification by subsampling is void if the adversary can
+        predict who participated — privacy/dp_fedavg.py)."""
+        return client_sampling(
+            round_idx, self.data.num_clients, self.config.fed.client_num_per_round
+        )
 
     def _round_steps_class(self, round_idx: int):
         """(steps, bs) bucket of one round's sampled cohort — the jit-shape

@@ -40,6 +40,15 @@ def _next_pow2(x: int) -> int:
     return 1 << (x - 1).bit_length() if x > 1 else 1
 
 
+def size_class(n: int) -> int:
+    """THE size-class policy for jit-shape axes (local-step counts AND DP
+    cohort sizes — one definition so the two can never diverge): power-of-
+    two up to 16, multiples of 8 above. Pure pow2 wastes up to ~2× in
+    padding at larger counts; the 8-classes cap that waste at <⅓ while
+    keeping the set of compiled shapes small."""
+    return _next_pow2(n) if n <= 16 else _ceil_to(n, 8)
+
+
 def bucket_steps(ns: Sequence[int], batch_size: int, pad_bucket: int):
     """Shared shape contract for a stacked client batch: given per-client
     sample counts, return (steps, bs, cap). Used by BOTH host stacking
@@ -47,16 +56,13 @@ def bucket_steps(ns: Sequence[int], batch_size: int, pad_bucket: int):
     (data/device_store.py) — one definition, so the two paths can never
     diverge. ``batch_size == -1`` = full batch (oracle mode).
 
-    Step counts are size-class bucketed: power-of-two up to 16, multiples
-    of 8 above. Pure pow2 wastes up to ~2× compute in padded (masked
-    no-op) steps at larger counts — e.g. 21 real steps padded to 32; the
-    8-step classes cap that waste at <⅓ while keeping the set of compiled
-    shapes small."""
+    Step counts are size-class bucketed via :func:`size_class` (full-batch
+    mode is exempt: S is 1 there)."""
     max_n = max(ns)
     bs = max_n if batch_size == -1 else batch_size
     steps = _ceil_to(_ceil_to(max_n, bs) // bs, pad_bucket)
     if batch_size != -1:
-        steps = _next_pow2(steps) if steps <= 16 else _ceil_to(steps, 8)
+        steps = size_class(steps)
     return steps, bs, steps * bs
 
 
@@ -116,6 +122,32 @@ class FederatedDataset:
             np.concatenate(self.client_x, axis=0),
             np.concatenate(self.client_y, axis=0),
         )
+
+
+def pad_clients_to(batch: ClientBatch, target: int) -> ClientBatch:
+    """Pad the client axis to ``target`` with all-mask-zero dummy clients.
+
+    THE dummy-client contract (one definition; mesh padding and DP cohort
+    padding both ride it): dummies carry num_samples == 0, so weighted
+    aggregation ignores them exactly and DP's inclusion mask excludes
+    them; their mask is all-zero, so the local-train no-op gate leaves
+    their parameters untouched (delta exactly 0 — pinned by tests).
+    Handles both host (numpy) and device-store (jax) batches."""
+    extra = target - batch.num_clients
+    if extra <= 0:
+        return batch
+    import jax.numpy as jnp
+
+    def pad0(a):
+        pad = [(0, extra)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, pad) if isinstance(a, np.ndarray) else jnp.pad(a, pad)
+
+    return ClientBatch(
+        x=pad0(batch.x),
+        y=pad0(batch.y),
+        mask=pad0(batch.mask),
+        num_samples=np.pad(np.asarray(batch.num_samples), (0, extra)),
+    )
 
 
 def stack_clients(

@@ -102,7 +102,7 @@ def make_sharded_fedavg_round(
                 lambda p: jax.lax.all_gather(p, axis, tiled=True), client_vars
             )
             ns_all = jax.lax.all_gather(num_samples, axis, tiled=True)
-            new_global = aggregate_fn(gathered, ns_all)
+            new_global = aggregate_fn(gathered, ns_all, global_vars)
         else:
             # Weighted partial sum on this shard, then one psum over ICI.
             wsum = jax.lax.psum(jnp.sum(num_samples), axis)
@@ -261,10 +261,10 @@ class DistributedDPFedAvgAPI(DPFedAvgAPI, DistributedFedAvgAPI):
     same _place_batch chain); this class swaps the round for the sharded
     skeleton with a psum uniform mean.
 
-    DP subtlety under mesh padding: the uniform mean must divide by the
-    REAL cohort size m, never the padded client axis — the cohort is
-    therefore required to divide the mesh (same stance as the Byzantine
-    aggregators, whose order statistics padding would also corrupt)."""
+    Mesh padding is harmless here: the DP aggregate divides by the FIXED
+    expected cohort and excludes padding rows via its num_samples
+    inclusion mask (privacy/dp_fedavg.make_dp_hooks), so realized Poisson
+    cohorts need not divide the mesh."""
 
     def __init__(self, config, data, model, dp=None, mesh=None, **kw):
         from fedml_tpu.privacy import DpConfig
@@ -272,21 +272,13 @@ class DistributedDPFedAvgAPI(DPFedAvgAPI, DistributedFedAvgAPI):
         super().__init__(
             config, data, model, dp=dp or DpConfig(), mesh=mesh, **kw
         )
-        if config.fed.client_num_per_round % self.n_shards:
-            raise ValueError(
-                f"DP on the mesh needs client_num_per_round "
-                f"({config.fed.client_num_per_round}) divisible by the mesh "
-                f"({self.n_shards}) — a padded cohort would skew the "
-                "uniform mean's sensitivity bound"
-            )
 
     def _build_round_fn(self, local_train_fn):
         from fedml_tpu.privacy.dp_fedavg import make_dp_hooks
 
         # the sharded skeleton all_gathers the full client stack before
         # calling aggregate_fn (same view as the vmap runtime), so the
-        # single-chip uniform mean applies unchanged — and with the
-        # cohort dividing the mesh there are no padding rows to skew it
+        # single-chip fixed-denominator aggregate applies unchanged
         post_train, aggregate_fn, post_aggregate = make_dp_hooks(
             self.dp, self.config.fed.client_num_per_round
         )

@@ -43,23 +43,9 @@ def make_mesh(
 
 def pad_client_batch(batch: ClientBatch, multiple: int) -> ClientBatch:
     """Pad the client axis with all-mask-zero dummy clients so C is divisible
-    by the mesh size. Dummy clients carry num_samples=0, so the weighted
-    aggregation (ref FedAVGAggregator.py:66-71 semantics) ignores them exactly,
-    and the all-padding-step no-op gate in train/client.py leaves their
-    parameters untouched."""
-    C = batch.num_clients
-    rem = C % multiple
-    if rem == 0:
-        return batch
-    extra = multiple - rem
+    by the mesh size (ref FedAVGAggregator.py:66-71 semantics are preserved
+    because dummies have aggregation weight 0 — see data/base.pad_clients_to,
+    the one definition of the dummy-client contract)."""
+    from fedml_tpu.data.base import _ceil_to, pad_clients_to
 
-    def pad0(a):
-        pad = [(0, extra)] + [(0, 0)] * (a.ndim - 1)
-        return np.pad(a, pad)
-
-    return ClientBatch(
-        x=pad0(batch.x),
-        y=pad0(batch.y),
-        mask=pad0(batch.mask),
-        num_samples=pad0(batch.num_samples),
-    )
+    return pad_clients_to(batch, _ceil_to(batch.num_clients, multiple))

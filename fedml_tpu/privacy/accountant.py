@@ -19,10 +19,11 @@ alpha/(2 sigma^2) — pinned as an internal consistency test. RDP composes
 additively over rounds; conversion uses the classic bound
 epsilon = RDP(alpha) + log(1/delta)/(alpha-1), minimized over orders.
 
-Caveat recorded honestly: the round sampler draws a FIXED-size cohort
-without replacement (fedavg.client_sampling), while the bound above is
-for Poisson sampling — the universal convention in DP-FL reporting
-(DP-FedAvg, tf-privacy) and a close approximation at small q.
+The DP training path executes EXACTLY this mechanism: DP-FedAvg samples
+Poisson cohorts (privacy/dp_fedavg.poisson_client_sampling, each client
+independently with probability q from a run-seeded secret stream) and
+aggregates with the fixed-denominator estimator whose sum-sensitivity is
+the clip norm — no fixed-size-vs-Poisson approximation is involved.
 """
 
 from __future__ import annotations

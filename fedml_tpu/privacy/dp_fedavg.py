@@ -7,21 +7,35 @@ implements the DP-FedAvg recipe (McMahan et al., "Learning Differentially
 Private Recurrent Language Models" — public algorithm, fresh
 implementation) on the same round-hook skeleton the robust defenses use:
 
-  1. each sampled client's UPDATE delta_i = w_i - w_t is clipped to L2
+  1. the round's cohort is POISSON-sampled: every client independently
+     with probability q = m_hat/N, from a per-round PRNG seeded by the
+     run seed (np.random.SeedSequence), NOT the round index alone — a
+     round-seeded draw would be publicly predictable, which voids
+     amplification-by-subsampling (the adversary must not know who
+     participated);
+  2. each sampled client's UPDATE delta_i = w_i - w_t is clipped to L2
      norm S over the ENTIRE uploaded tree (params and any stats — the
      guarantee must cover everything transmitted, so unlike the robust
      defense's BN-stat-aware clipping nothing passes through unclipped);
-  2. aggregation is the UNIFORM mean over the fixed-size cohort —
-     sample-count weighting would make the sensitivity depend on private
-     shard sizes, so it is deliberately NOT used here;
-  3. Gaussian noise N(0, (z*S/m)^2) is added to every coordinate of the
-     mean (sensitivity of the mean to one client is S/m);
-  4. an RDP accountant (privacy/accountant.py) composes the rounds and
-     reports (epsilon, delta) for q = m/N per round.
+  3. aggregation is w_t + (1/m_hat) * sum_{i in cohort} clip_S(delta_i)
+     with the FIXED expected cohort size m_hat = qN as denominator (the
+     DP-FedAvg fixed-denominator estimator): the sum's sensitivity to
+     adding/removing one client is exactly S regardless of the realized
+     cohort, and sample-count weighting is deliberately NOT used —
+     weights would make the sensitivity depend on private shard sizes;
+  4. Gaussian noise N(0, (z*S/m_hat)^2) is added to every coordinate
+     (noise z*S on the sum => noise multiplier z, the accounted value);
+  5. an RDP accountant (privacy/accountant.py) composes the rounds. The
+     executed sampler and the accounted mechanism are the SAME object:
+     Poisson(q) sampling, sum-sensitivity S, noise z*S.
 
-All of 1-3 run inside the one jitted round function via the
-post_train/aggregate_fn/post_aggregate hooks of make_fedavg_round — the
-DP math adds no host round-trips.
+Variable Poisson cohorts meet XLA's static shapes by padding the client
+axis to a bucketed size with all-mask-zero dummy clients: their local
+step is a gated no-op (delta exactly 0, pinned by tests) AND the
+aggregate excludes them explicitly (num_samples == 0), so padding never
+changes the mechanism. 2-4 run inside the one jitted round function via
+the post_train/aggregate_fn/post_aggregate hooks of make_fedavg_round —
+the DP math adds no host round-trips.
 """
 
 from __future__ import annotations
@@ -30,10 +44,44 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, make_fedavg_round
 from fedml_tpu.algorithms.fedavg_robust import NOISE_FOLD
+from fedml_tpu.data.base import ClientBatch, pad_clients_to, size_class
 from fedml_tpu.privacy.accountant import RdpAccountant
+
+# Domain tag folded into the cohort-sampling SeedSequence so the DP
+# participation stream can never collide with any other consumer of the
+# run seed (data shuffling uses seed*1_000_003+round, model init folds 0).
+_DP_SAMPLE_TAG = 0x44505F53  # "DP_S"
+
+
+def poisson_client_sampling(
+    run_seed: int, round_idx: int, client_num_in_total: int, q: float
+) -> np.ndarray:
+    """One Poisson cohort draw: every client independently with probability
+    ``q``, from a fresh per-round stream derived from the RUN seed.
+
+    This is the sampler the RDP accountant's subsampled-Gaussian bound is
+    FOR — and unlike :func:`fedavg.client_sampling`'s round-seeded draw
+    (reference parity, FedAVGAggregator.py:80-88) it is not predictable
+    from public information alone: amplification by subsampling requires
+    the adversary not to know who participated, so the run seed must be
+    treated as secret for the epsilon to hold."""
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"sampling probability q must be in (0, 1], got {q}")
+    rng = np.random.default_rng(
+        np.random.SeedSequence((int(run_seed), _DP_SAMPLE_TAG, int(round_idx)))
+    )
+    return np.flatnonzero(rng.random(client_num_in_total) < q)
+
+
+def bucket_cohort(m: int) -> int:
+    """Static client-axis size for a realized Poisson cohort of ``m`` —
+    the shared size-class policy (data/base.size_class), so the set of
+    compiled shapes stays small while padding waste is bounded."""
+    return size_class(max(int(m), 1))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,22 +110,39 @@ def clip_update_tree(local_tree, global_tree, clip_norm: float):
     )
 
 
-def make_dp_hooks(dp: DpConfig, cohort_size: int):
-    """(post_train, aggregate_fn, post_aggregate) for make_fedavg_round."""
+def make_dp_hooks(dp: DpConfig, expected_cohort: int):
+    """(post_train, aggregate_fn, post_aggregate) for make_fedavg_round /
+    make_sharded_fedavg_round.
+
+    The aggregate is the fixed-denominator estimator
+    ``w_t + (1/m_hat) * sum_incl clip_S(delta_i)`` with ``m_hat =
+    expected_cohort``: sensitivity of the sum is exactly clip_norm under
+    add/remove adjacency whatever the realized Poisson cohort, so the
+    noise z*S/m_hat on the result is the accounted subsampled-Gaussian
+    mechanism. Padding rows (num_samples == 0) are excluded by the
+    inclusion mask — and contribute exact-zero deltas anyway (gated no-op
+    local steps). num_samples is used ONLY as the inclusion indicator,
+    never as a weight (weights would tie sensitivity to private shard
+    sizes)."""
 
     def post_train(client_vars, global_vars, noise_rng):
         return jax.vmap(
             lambda cv: clip_update_tree(cv, global_vars, dp.clip_norm)
         )(client_vars)
 
-    def aggregate_fn(client_vars, num_samples):
-        # UNIFORM mean — num_samples is deliberately unused (weights would
-        # tie the sensitivity to private shard sizes)
-        return jax.tree_util.tree_map(
-            lambda s: jnp.mean(s.astype(jnp.float32), axis=0), client_vars
-        )
+    def aggregate_fn(client_vars, num_samples, g):
+        incl = (num_samples > 0).astype(jnp.float32)
 
-    stddev = dp.noise_multiplier * dp.clip_norm / cohort_size
+        def mean_delta(s, gl):
+            base = gl.astype(jnp.float32)
+            delta = s.astype(jnp.float32) - base[None]
+            return base + jnp.tensordot(incl, delta, axes=1) / float(
+                expected_cohort
+            )
+
+        return jax.tree_util.tree_map(mean_delta, client_vars, g)
+
+    stddev = dp.noise_multiplier * dp.clip_norm / expected_cohort
 
     def post_aggregate(new_global, noise_rng):
         flat, treedef = jax.tree_util.tree_flatten(new_global)
@@ -92,17 +157,65 @@ def make_dp_hooks(dp: DpConfig, cohort_size: int):
 
 
 class DPFedAvgAPI(FedAvgAPI):
-    """FedAvg simulator with client-level DP and per-round accounting."""
+    """FedAvg simulator with client-level DP and per-round accounting.
+
+    ``client_num_per_round`` is reinterpreted as the EXPECTED cohort size
+    m_hat: cohorts are Poisson(q = m_hat/N) draws (see
+    :func:`poisson_client_sampling`), padded to a bucketed static client
+    axis so realized sizes don't multiply compiled shapes."""
 
     _supports_fused = False  # the accountant steps on the host every round
+    sampling = "poisson"
 
     def __init__(self, config, data, model, dp: DpConfig = DpConfig(), **kw):
         self.dp = dp
         super().__init__(config, data, model, **kw)
         self.accountant = RdpAccountant()
-        self._q = (
-            config.fed.client_num_per_round / config.fed.client_num_in_total
+        # N from the DATA (the population actually sampled from), not the
+        # config echo — the accounted q and the executed q must be the
+        # same number
+        self._q = config.fed.client_num_per_round / data.num_clients
+        if not 0.0 < self._q <= 1.0:
+            raise ValueError(
+                f"fed.client_num_per_round={config.fed.client_num_per_round} "
+                f"with {data.num_clients} clients gives DP sampling "
+                f"probability q={self._q:.4g}; need 0 < q <= 1"
+            )
+
+    def _sample_clients(self, round_idx: int) -> np.ndarray:
+        # the SAME q the accountant steps with — mechanism == ledger
+        return poisson_client_sampling(
+            self.config.seed, round_idx, self.data.num_clients, self._q
         )
+
+    def _round_batch(self, sampled, round_idx: int):
+        m = len(sampled)
+        if m == 0:
+            # an empty Poisson cohort is a legal round: the model moves by
+            # noise only. Build an all-masked zero batch at the SAME shape
+            # class _round_plan advertised (bucket_steps([1]) — one
+            # notional sample), so plan and executed shapes agree and the
+            # dead compute is one tiny gated no-op step, not a full
+            # client's worth.
+            _, steps, bs = self._round_plan(round_idx)
+            feat = self.data.client_x[0].shape[1:]
+            lab = self.data.client_y[0].shape[1:]
+            batch = ClientBatch(
+                x=np.zeros((1, steps, bs) + feat, self.data.client_x[0].dtype),
+                y=np.zeros((1, steps, bs) + lab, self.data.client_y[0].dtype),
+                mask=np.zeros((1, steps, bs), np.float32),
+                num_samples=np.zeros((1,), np.float32),
+            )
+        else:
+            batch = super()._round_batch(sampled, round_idx)
+        return pad_clients_to(batch, bucket_cohort(m))
+
+    def _round_may_pad(self, round_idx: int, force_steps: int = 0) -> bool:
+        sampled = self._round_plan(round_idx)[0]
+        m = len(sampled)
+        if m == 0 or bucket_cohort(m) > m:
+            return True  # dummy cohort rows are all-padding steps
+        return super()._round_may_pad(round_idx, force_steps)
 
     def _build_round_fn(self, local_train_fn):
         post_train, aggregate_fn, post_aggregate = make_dp_hooks(
@@ -153,8 +266,9 @@ class DPFedAvgAPI(FedAvgAPI):
             "DP/rdp_order": order,
             "DP/rounds_accounted": self.accountant.rounds,
             "DP/sampling_note": (
-                "fixed-size cohort accounted as Poisson sampling at "
-                f"q={self._q:.4g} (standard DP-FL convention)"
+                f"Poisson-sampled cohorts executed at q={self._q:.4g} — "
+                "the accounted mechanism and the run sampler are the same "
+                "object (epsilon assumes the run seed is kept secret)"
             ),
         }
 

@@ -178,8 +178,11 @@ def krum_aggregate(stacked_tree, num_byzantine: int, m: int = 1):
 
 
 def make_byzantine_aggregate(robust: "RobustConfig"):
-    """defense_type → ``aggregate_fn(stacked_client_vars, num_samples)``
-    replacing the weighted average, or None for the clip/noise defenses."""
+    """defense_type → ``aggregate_fn(stacked_client_vars, num_samples,
+    global_vars=None)`` replacing the weighted average, or None for the
+    clip/noise defenses. The order statistics ignore w_t — the third
+    argument exists because the round skeletons pass it for aggregates
+    that DO need it (DP's fixed-denominator estimator)."""
     d = robust.defense_type
     if d in CLIP_DEFENSES:
         return None
@@ -191,12 +194,14 @@ def make_byzantine_aggregate(robust: "RobustConfig"):
     if robust.num_byzantine < 0:
         raise ValueError(f"num_byzantine must be >= 0; got {robust.num_byzantine}")
     builders = {
-        "median": coordinate_median,
-        "trimmed_mean": lambda cv, ns: trimmed_mean(
+        "median": lambda cv, ns, g=None: coordinate_median(cv, ns),
+        "trimmed_mean": lambda cv, ns, g=None: trimmed_mean(
             cv, ns, trim_k=robust.num_byzantine
         ),
-        "krum": lambda cv, ns: krum_aggregate(cv, robust.num_byzantine, m=1),
-        "multi_krum": lambda cv, ns: krum_aggregate(
+        "krum": lambda cv, ns, g=None: krum_aggregate(
+            cv, robust.num_byzantine, m=1
+        ),
+        "multi_krum": lambda cv, ns, g=None: krum_aggregate(
             cv, robust.num_byzantine, m=robust.multi_krum_m
         ),
     }

@@ -103,19 +103,20 @@ def _data_model():
 
 
 def test_zero_noise_huge_clip_equals_uniform_mean_fedavg():
-    """Degenerate-config oracle: z->0 and S->inf turn DP-FedAvg into plain
-    FedAvg with UNIFORM weights — with equal shard sizes that is exactly
-    the sample-weighted FedAvg round."""
+    """Degenerate-config oracle: z->0, S->inf and q=1 (per_round == total,
+    so the Poisson draw includes everyone surely) turn DP-FedAvg into
+    plain FedAvg with UNIFORM weights — with equal shard sizes that is
+    exactly the sample-weighted FedAvg round."""
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
 
     data, model = _data_model()
     # clip far above any real update norm (but not so large that the
     # noise stddev z*S/m becomes visible even at tiny z)
     dp_api = DPFedAvgAPI(
-        _cfg(), data, model,
+        _cfg(per_round=8), data, model,
         dp=DpConfig(clip_norm=1e4, noise_multiplier=1e-15),
     )
-    plain = FedAvgAPI(_cfg(), data, model)
+    plain = FedAvgAPI(_cfg(per_round=8), data, model)
     for r in range(3):
         dp_api.train_round(r)
         plain.train_round(r)
@@ -232,14 +233,132 @@ def test_mesh_dp_matches_vmap():
         )
 
 
-def test_mesh_dp_rejects_nondivisible_cohort():
+def test_mesh_dp_poisson_cohort_matches_vmap():
+    """q < 1: realized Poisson cohorts vary per round and need NOT divide
+    the mesh — padding rows are excluded by the aggregate's inclusion
+    mask, so the mesh run still bit-matches the single-chip simulator."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
     from fedml_tpu.parallel import DistributedDPFedAvgAPI
 
     data, model = _data_model()
+    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.7)
+    sim = DPFedAvgAPI(_cfg(rounds=4, per_round=5), data, model, dp=dp)
+    mesh = DistributedDPFedAvgAPI(
+        _cfg(rounds=4, per_round=5), data, model, dp=dp
+    )
+    saw_nondivisible = False
+    for r in range(4):
+        sampled, _ = sim.train_round(r)
+        mesh.train_round(r)
+        saw_nondivisible |= len(sampled) % mesh.n_shards != 0
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sim.global_vars),
+        jax.tree_util.tree_leaves(mesh.global_vars),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+    # the run must actually have exercised a cohort that doesn't divide
+    # the mesh — otherwise this test silently degrades to the q=1 one
+    assert saw_nondivisible
+
+
+# ------------------------------------------------------------ Poisson sampler
+def test_poisson_sampling_matches_accounted_q():
+    """The executed inclusion frequency is the accounted q (LLN check),
+    and the API's sampler and accountant share the same q object."""
+    from fedml_tpu.privacy.dp_fedavg import poisson_client_sampling
+
+    N, q = 64, 0.25
+    hits = np.zeros(N)
+    rounds = 400
+    for r in range(rounds):
+        hits[poisson_client_sampling(0, r, N, q)] += 1
+    freq = hits / rounds
+    # per-client binomial stddev ~ sqrt(q(1-q)/rounds) ~ 0.022
+    assert abs(freq.mean() - q) < 0.01
+    assert np.all(np.abs(freq - q) < 0.1)
+
+    data, model = _data_model()
+    api = DPFedAvgAPI(_cfg(), data, model)
+    assert api.sampling == "poisson"
+    assert api._q == pytest.approx(4 / 8)
+    cohorts = [set(api._sample_clients(r).tolist()) for r in range(50)]
+    sizes = [len(c) for c in cohorts]
+    assert min(sizes) < 4 < max(sizes), "cohort sizes should vary (Poisson)"
+
+
+def test_poisson_sampling_is_run_dependent_not_public():
+    """The ADVICE-high fix: cohort draws must depend on the run seed, not
+    the round index alone (a round-only seed is publicly predictable,
+    voiding amplification), and must not touch numpy's global PRNG."""
+    from fedml_tpu.privacy.dp_fedavg import poisson_client_sampling
+
+    a = [poisson_client_sampling(0, r, 32, 0.3).tolist() for r in range(20)]
+    b = [poisson_client_sampling(1, r, 32, 0.3).tolist() for r in range(20)]
+    assert a != b, "different run seeds must draw different cohorts"
+    # deterministic per (seed, round) — reproducibility/resume contract
+    assert a == [
+        poisson_client_sampling(0, r, 32, 0.3).tolist() for r in range(20)
+    ]
+    # global numpy stream untouched (np.random.seed would be the old sin)
+    np.random.seed(123)
+    before = np.random.get_state()[1].copy()
+    poisson_client_sampling(7, 3, 32, 0.3)
+    np.random.seed(123)
+    assert np.array_equal(before, np.random.get_state()[1])
+
     with pytest.raises(ValueError):
-        DistributedDPFedAvgAPI(_cfg(rounds=1, per_round=6), data, model)
+        poisson_client_sampling(0, 0, 8, 0.0)
+    with pytest.raises(ValueError):
+        poisson_client_sampling(0, 0, 8, 1.5)
+
+
+def test_dp_padding_invariance():
+    """Padding the cohort axis further must not change the mechanism: the
+    fixed-denominator aggregate excludes dummy rows exactly."""
+    import fedml_tpu.privacy.dp_fedavg as dpmod
+
+    data, model = _data_model()
+    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.9)
+    a = DPFedAvgAPI(_cfg(rounds=2), data, model, dp=dp)
+    b = DPFedAvgAPI(_cfg(rounds=2), data, model, dp=dp)
+    orig = dpmod.bucket_cohort
+    try:
+        dpmod.bucket_cohort = lambda m: orig(m) * 2  # double the padding
+        for r in range(2):
+            b.train_round(r)
+    finally:
+        dpmod.bucket_cohort = orig
+    for r in range(2):
+        a.train_round(r)
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a.global_vars),
+        jax.tree_util.tree_leaves(b.global_vars),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_dp_empty_cohort_round_is_noise_only():
+    """An empty Poisson draw is a legal round: w moves by noise only, and
+    with z ~ 0 the model is unchanged."""
+    data, model = _data_model()
+    api = DPFedAvgAPI(
+        _cfg(rounds=1), data, model,
+        dp=DpConfig(clip_norm=1.0, noise_multiplier=1e-15),
+    )
+    api._sample_clients = lambda r: np.array([], dtype=np.int64)
+    before = jax.tree_util.tree_map(np.asarray, api.global_vars)
+    api.train_round(0)
+    assert api.accountant.rounds == 1
+    for x, y in zip(
+        jax.tree_util.tree_leaves(before),
+        jax.tree_util.tree_leaves(api.global_vars),
+    ):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
 
 
 def test_cli_dp_fedavg_reachable():
