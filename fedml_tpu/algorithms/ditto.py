@@ -238,7 +238,7 @@ class DittoAPI(FedAvgAPI):
     def _build_ditto_round(self):
         return make_ditto_round(
             self.model, self.config, self.lam, task=self.task,
-            client_mode=self._client_mode,
+            client_mode=self._client_mode, donate=self._donate,
         )
 
     def _build_round_fn(self, local_train_fn):

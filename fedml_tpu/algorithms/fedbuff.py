@@ -118,6 +118,10 @@ class FedBuffServerManager(ServerManager):
         # tag is unique per assignment and one assignment is outstanding
         # per worker, so last-tag-per-sender drops the duplicate
         self._last_upload_tag: Dict[int, int] = {}
+        # worker -> (client_index, tag) of its one outstanding assignment;
+        # duplicate uploads are answered by re-sending THIS, never by
+        # minting a second assignment (see _dispatch reuse)
+        self._outstanding: Dict[int, tuple] = {}
         self._lock = threading.Lock()
         self.staleness_seen: List[int] = []  # one entry per buffered delta
         self.global_vars = jax.device_get(
@@ -136,15 +140,29 @@ class FedBuffServerManager(ServerManager):
         self._dispatch_counter += 1
         return int(rng.integers(0, self.config.fed.client_num_in_total))
 
-    def _dispatch(self, worker: int, msg_type: str = MT.S2C_SYNC_MODEL):
+    def _dispatch(
+        self, worker: int, msg_type: str = MT.S2C_SYNC_MODEL, reuse: bool = False
+    ):
         if worker in self._dead_workers:
             return
+        if reuse and worker in self._outstanding:
+            # duplicate-upload reply: re-send the SAME outstanding
+            # assignment (same tag/client) rather than minting a new one —
+            # a duplicate must never increase the number of outstanding
+            # assignments per worker (the dedupe invariant), only restate
+            # the one that may have been lost. The model/base are CURRENT:
+            # strictly fresher is fine, the tag is what dedupes.
+            client_index, tag = self._outstanding[worker]
+        else:
+            client_index = self._next_client_index()
+            tag = self._dispatch_counter
+            self._outstanding[worker] = (client_index, tag)
         msg = Message(msg_type, 0, worker)
         msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
-        msg.add_params(MT.ARG_CLIENT_INDEX, self._next_client_index())
+        msg.add_params(MT.ARG_CLIENT_INDEX, client_index)
         msg.add_params(MT.ARG_BASE_VERSION, self.version)
         # ARG_ROUND_IDX doubles as the batch-shuffle seed on the client
-        msg.add_params(MT.ARG_ROUND_IDX, self._dispatch_counter)
+        msg.add_params(MT.ARG_ROUND_IDX, tag)
         try:
             self.send_message(msg)
         except Exception as e:  # noqa: BLE001 — transport errors vary by backend
@@ -186,9 +204,13 @@ class FedBuffServerManager(ServerManager):
                 # still answer with a dispatch: the duplicate means the
                 # client never saw OUR reply (it may have been the send
                 # that failed) — dropping silently would leave the worker
-                # assignment-less until its deadman fired
+                # assignment-less until its deadman fired. reuse=True
+                # re-sends the outstanding assignment: if the original
+                # reply WAS delivered after all, the worker redoes one
+                # assignment and its re-upload dedupes here — outstanding
+                # work can never grow.
                 if not self._finished:
-                    self._dispatch(sender)
+                    self._dispatch(sender, reuse=True)
                 return
             self._last_upload_tag[sender] = tag
             tau = self.version - int(base)

@@ -15,17 +15,19 @@ The q-FedAvg update (paper's Algorithm 2, public; implemented fresh):
     h_k   = q * F_k^{q-1} * ||g_k||^2 + F_k^q / lr
     w_{t+1} = w_t - (sum_k Delta_k) / (sum_k h_k)
 
-where F_k is client k's TRAINING loss at the broadcast model, estimated
-here (as in the paper's implementation) by the client's mean local
-training loss. At q=0 this reduces EXACTLY to the uniform mean of the
+where F_k is client k's TRAINING loss at the broadcast model w_t —
+computed EXACTLY here: one forward pass over the client's shard at w_t
+inside the jitted round, before local training (an earlier draft used
+the mean loss over the whole local trajectory, which systematically
+down-weights fast-learning clients; the paper's weights are defined at
+w_t). At q=0 this reduces EXACTLY to the uniform mean of the
 client models: Delta_k = g_k, h_k = 1/lr, so
 w - lr/K * sum (w - w_k)/lr... = mean_k w_k — the degenerate-config
 oracle tests/test_qfedavg.py pins.
 
-TPU shape: the whole update is one jitted round — the per-client losses
-come from the SAME lifted local trains the plain round already runs (the
-metrics the reference throws at wandb are the aggregation weights here);
-no extra pass, no host round-trip.
+TPU shape: the whole update is one jitted round — the F_k forward pass
+rides the same lifted client schedule as the local trains (fused by XLA
+into the round program); no host round-trip.
 """
 
 from __future__ import annotations
@@ -35,9 +37,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI, make_fedavg_round_body
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgAPI,
+    client_axis_map,
+    make_fedavg_round_body,
+    resolve_client_parallelism,
+)
 from fedml_tpu.config import RunConfig
 from fedml_tpu.models import ModelDef
+from fedml_tpu.train.client import make_task_loss
 
 
 def qfedavg_update(global_vars, client_vars, losses, lr: float, q: float):
@@ -86,12 +94,33 @@ def make_qfedavg_round(
         local_train_fn=local_train_fn,
     )
     lr = config.train.lr
+    mode = client_mode or resolve_client_parallelism(
+        config.fed.client_parallelism, model
+    )
+    task_loss = make_task_loss(task)
+
+    def broadcast_loss(gv, xc, yc, mc):
+        """Mean training loss of ONE client's shard at the broadcast model
+        — the F_k(w_t) that q-FFL's weights are defined on."""
+
+        def step(carry, inp):
+            xb, yb, mb = inp
+            logits, _ = model.apply(gv, xb, train=False)
+            loss, _, total = task_loss(logits, yb, mb)
+            return carry + jnp.stack([loss * total, total]), None
+
+        sums, _ = jax.lax.scan(step, jnp.zeros(2), (xc, yc, mc))
+        return sums[0] / jnp.maximum(sums[1], 1.0)
+
+    lifted_loss = client_axis_map(broadcast_loss, mode)
 
     def round_fn(global_vars, x, y, mask, num_samples, client_rngs):
+        # F_k at w_t BEFORE local training (XLA may still schedule both
+        # passes together — no data dependence forces an ordering)
+        losses = lifted_loss(global_vars, x, y, mask)
         _, (client_vars, metrics) = body(
             global_vars, x, y, mask, num_samples, client_rngs
         )
-        losses = metrics["loss_sum"] / jnp.maximum(metrics["count"], 1.0)
         new_global = qfedavg_update(global_vars, client_vars, losses, lr, q)
         return new_global, jax.tree_util.tree_map(jnp.sum, metrics)
 

@@ -146,7 +146,7 @@ def pad_clients_to(batch: ClientBatch, target: int) -> ClientBatch:
         x=pad0(batch.x),
         y=pad0(batch.y),
         mask=pad0(batch.mask),
-        num_samples=np.pad(np.asarray(batch.num_samples), (0, extra)),
+        num_samples=pad0(batch.num_samples),
     )
 
 

@@ -347,7 +347,8 @@ class DistributedDittoAPI(DittoAPI, DistributedFedAvgAPI):
         from fedml_tpu.algorithms.ditto import make_sharded_ditto_round
 
         return make_sharded_ditto_round(
-            self.model, self.config, self.mesh, self.lam, task=self.task
+            self.model, self.config, self.mesh, self.lam, task=self.task,
+            donate=self._donate,
         )
 
     def _place_client_indices(self, sampled):

@@ -118,11 +118,79 @@ def gen_additive_shares(x: np.ndarray, n_out: int, p: int = FIELD_PRIME, rng=Non
     return np.concatenate([parts, last[None]], axis=0)
 
 
-def pk_gen(sk: int, p: int = FIELD_PRIME, g: int = 5):
-    """g^sk mod p (ref my_pk_gen:263-268)."""
-    return pow(g, int(sk), p)
+# ---- key agreement: 2048-bit MODP group + SHA-256/SHAKE KDF ----
+# Supersedes the reference's my_pk_gen/my_key_agreement
+# (mpc_function.py:263-271+), which run DH in the toy aggregation field.
+# The aggregation FIELD stays the 31-bit Mersenne prime above — field size
+# is about exact int64 share arithmetic, not secrecy. Mask secrecy rests on
+# this group and KDF: RFC 3526 group-14 DH with 256-bit secrets-sourced
+# exponents (>= 128-bit security), SHA-256 extract + SHAKE-256 expand into
+# field elements. The reference's my_key_agreement runs DH in the toy field
+# itself (mpc_function.py:271) — brute-forceable by Pohlig-Hellman; this
+# replaces it at zero dependency cost (all stdlib).
+
+MODP_2048_P = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+MODP_2048_G = 2
+DH_SECRET_BITS = 256
 
 
-def key_agreement(my_sk: int, their_pk: int, p: int = FIELD_PRIME, g: int = 5):
-    """DH shared key their_pk^my_sk mod p (ref my_key_agreement:271+)."""
-    return pow(int(their_pk), int(my_sk), p)
+def dh_secret(rng=None) -> int:
+    """256-bit DH exponent. ``rng=None`` (production) draws from OS
+    entropy via ``secrets``; a caller-supplied numpy Generator keeps
+    simulations/tests reproducible. The top bit is pinned so the secret
+    space is exactly 2^255 — comfortably past 128-bit security for a
+    2048-bit group."""
+    if rng is None:
+        import secrets
+
+        v = secrets.randbits(DH_SECRET_BITS)
+    else:
+        v = int.from_bytes(rng.bytes(DH_SECRET_BITS // 8), "big")
+    return v | (1 << (DH_SECRET_BITS - 1))
+
+
+def dh_public(sk: int) -> int:
+    return pow(MODP_2048_G, int(sk), MODP_2048_P)
+
+
+def dh_shared(my_sk: int, their_pk: int) -> int:
+    """their_pk^my_sk in the 2048-bit group. Degenerate public keys
+    (0, ±1 mod p — which would force a known shared key) are rejected."""
+    pk = int(their_pk) % MODP_2048_P
+    if pk in (0, 1, MODP_2048_P - 1):
+        raise ValueError("degenerate DH public key")
+    return pow(pk, int(my_sk), MODP_2048_P)
+
+
+def derive_pair_mask(
+    shared_key: int, lo: int, hi: int, dim: int, p: int = FIELD_PRIME
+) -> np.ndarray:
+    """Expand a DH shared secret into ``dim`` field elements — the pair
+    mask both endpoints compute identically (the context is the ORDERED
+    pair (lo, hi), so each unordered pair has one mask).
+
+    Extract: SHA-256 over a domain tag, the pair context, and the
+    fixed-width shared secret. Expand: SHAKE-256 XOF, 8 bytes per
+    element, reduced mod p (statistical distance from uniform is
+    <= p/2^64 ~ 2^-33 per element)."""
+    import hashlib
+    import struct
+
+    ikm = hashlib.sha256(
+        b"fedml-tpu-secagg-v1"
+        + struct.pack(">II", int(lo), int(hi))
+        + int(shared_key).to_bytes(MODP_2048_P.bit_length() // 8, "big")
+    ).digest()
+    raw = hashlib.shake_256(ikm).digest(8 * int(dim))
+    vals = np.frombuffer(raw, dtype=np.dtype(">u8"))
+    return (vals % np.uint64(p)).astype(np.int64)

@@ -4,15 +4,19 @@ TA_decentralized_worker.py + mpc_function.py) as a complete, testable
 protocol: in the aggregation path the server only ever combines masked
 uploads, so the protocol *structure* reveals only the sum of client updates.
 
-SECURITY NOTE — this is a protocol simulation, not a cryptographic
-implementation (matching the reference, whose field/DH parameters are the
-same scale): DH runs in Z_p* with p = 2^31−1, whose smooth group order makes
-discrete logs easy (Pohlig–Hellman), and pair keys are truncated to 31 bits
-before seeding the PRG, so the masks are brute-forceable. The 31-bit
-Mersenne field is the right choice for exact int64 share arithmetic; real
-deployments must swap the key agreement to a standard large group (X25519
-etc.) and expand seeds through a proper KDF/CSPRNG — the protocol logic
-(masking, cancellation, BGW dropout recovery) is unchanged by that swap.
+Key agreement runs in the RFC 3526 2048-bit MODP group with 256-bit
+``secrets``-sourced exponents, and pair masks are expanded from the shared
+secret by SHA-256 extract + SHAKE-256 XOF into the aggregation field
+(mpc.dh_secret/dh_shared/derive_pair_mask) — ≥128-bit secret space, no
+brute-forceable parameter anywhere (the reference's my_key_agreement runs
+DH in its toy field, mpc_function.py:271). The 31-bit Mersenne FIELD is
+kept for exact int64 share arithmetic; field size is about arithmetic
+range, not secrecy. HONESTY NOTE — the protocol assumes an
+honest-but-curious server and non-colluding parties: there are no
+signatures or consistency checks against a MALICIOUS server (who could
+partition parties into singleton "registries"), and the BGW seed-share
+round of full SecAgg (Bonawitz et al.) is elided to the pair-key registry
+(the share math itself is mpc.bgw_encode/decode, tested independently).
 
 Fixed-point encode → field; client i's upload is
 ``x_i + Σ_{j>i} PRG(k_ij) − Σ_{j<i} PRG(k_ij)  (mod p)``
@@ -48,12 +52,6 @@ def decode_fixed(v: np.ndarray, n_summed: int, p: int = FIELD_PRIME) -> np.ndarr
     return signed.astype(np.float64) / _SCALE
 
 
-def _prg(seed: int, size: int, p: int) -> np.ndarray:
-    return np.random.default_rng(seed & 0x7FFFFFFF).integers(
-        0, p, size=size, dtype=np.int64
-    )
-
-
 class SecureAggregator:
     """N-party masked aggregation with dropout recovery."""
 
@@ -63,18 +61,23 @@ class SecureAggregator:
         self.p = p
         self.T = threshold if threshold is not None else max(1, num_clients // 2)
         rng = np.random.default_rng(seed)
-        self.sks = [int(rng.integers(2, p - 2)) for _ in range(self.N)]
-        self.pks = [mpc.pk_gen(sk, p) for sk in self.sks]
-        # pairwise DH keys (ref my_key_agreement)
+        self.sks = [mpc.dh_secret(rng) for _ in range(self.N)]
+        self.pks = [mpc.dh_public(sk) for sk in self.sks]
+        # pairwise DH keys in the 2048-bit group (ref my_key_agreement,
+        # which ran in the toy field). Only unordered pairs: dh_shared is
+        # symmetric and every consumer keys on (lo, hi) — the ordered
+        # variant would double an O(N^2) bill of 2048-bit modexps.
         self.pair_keys: Dict[tuple, int] = {
-            (i, j): mpc.key_agreement(self.sks[i], self.pks[j], p)
+            (i, j): mpc.dh_shared(self.sks[i], self.pks[j])
             for i in range(self.N)
-            for j in range(self.N)
-            if i != j
+            for j in range(i + 1, self.N)
         }
 
     def mask_of_pair(self, i: int, j: int) -> np.ndarray:
-        return _prg(self.pair_keys[(min(i, j), max(i, j))], self.dim, self.p)
+        lo, hi = min(i, j), max(i, j)
+        return mpc.derive_pair_mask(
+            self.pair_keys[(lo, hi)], lo, hi, self.dim, self.p
+        )
 
     def client_upload(self, i: int, x: np.ndarray, active: Sequence[int]) -> np.ndarray:
         v = encode_fixed(x, self.p)
@@ -172,19 +175,18 @@ class ClientParty:
     Round 2 derived every party's secret key from the shared ``config.seed``
     (VERDICT r2 Weak #4), so the server could recompute every client's
     masks and the protocol structure hid nothing. Here the secret key is
-    drawn from client-local entropy and NEVER leaves this object; only the
-    public key goes on the wire (ref turboaggregate my_key_agreement,
-    mpc_function.py:271). Fresh party = fresh keys each round, so masks
-    are never reused across rounds. The SECURITY NOTE in the module
-    docstring still applies to the field/PRG parameters."""
+    drawn from client-local entropy (``secrets`` OS entropy when ``rng``
+    is None) and NEVER leaves this object; only the 2048-bit-group public
+    key goes on the wire (contrast ref turboaggregate my_key_agreement,
+    mpc_function.py:271, toy-field DH). Fresh party = fresh keys each
+    round, so masks are never reused across rounds."""
 
     def __init__(self, party: int, dim: int, p: int = FIELD_PRIME, rng=None):
-        rng = rng if rng is not None else np.random.default_rng()
         self.party = party
         self.dim = dim
         self.p = p
-        self._sk = int(rng.integers(2, p - 2))
-        self.pk = mpc.pk_gen(self._sk, p)
+        self._sk = mpc.dh_secret(rng)
+        self.pk = mpc.dh_public(self._sk)
         self._pair_keys: Dict[int, int] = {}
         self.active: List[int] = []
 
@@ -193,13 +195,14 @@ class ClientParty:
         public material only) and agree pairwise keys with OWN secret."""
         self.active = sorted(int(j) for j in pks)
         self._pair_keys = {
-            int(j): mpc.key_agreement(self._sk, int(pk), self.p)
+            int(j): mpc.dh_shared(self._sk, int(pk))
             for j, pk in pks.items()
             if int(j) != self.party
         }
 
     def _mask(self, j: int) -> np.ndarray:
-        return _prg(self._pair_keys[j], self.dim, self.p)
+        lo, hi = min(self.party, j), max(self.party, j)
+        return mpc.derive_pair_mask(self._pair_keys[j], lo, hi, self.dim, self.p)
 
     def masked_update(self, w_local, w_round, n_samples: float) -> np.ndarray:
         """Masked field vector of n_i · (w_i − w_round), masks vs every

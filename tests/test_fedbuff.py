@@ -325,6 +325,53 @@ def test_async_server_drops_duplicate_upload():
     assert len(server._buffer) == 2
 
 
+def test_async_duplicate_reply_resends_same_assignment():
+    """A duplicate upload must be answered by RE-SENDING the worker's one
+    outstanding assignment (same tag), never by minting a new one — else
+    a client whose original reply WAS delivered ends up with two
+    outstanding assignments and in-flight work grows unboundedly."""
+    from fedml_tpu.algorithms.fedbuff import FedBuffServerManager
+    from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+    from fedml_tpu.core.message import Message, MessageType as MT
+
+    model = create_model("lr", "synthetic", (4,), 2)
+    cfg = _cfg(comm_round=5, k=3, workers=2, total=4)
+    server = FedBuffServerManager(
+        cfg, LoopbackCommManager(LoopbackHub(), 0), model, worker_num=2,
+    )
+    sent = []
+    server.send_message = lambda m: sent.append(m)
+
+    def upload(tag):
+        up = Message(MT.C2S_SEND_MODEL, 1, 0)
+        up.add_params(
+            MT.ARG_ASYNC_DELTA,
+            jax.device_get(
+                jax.tree_util.tree_map(jnp.zeros_like, server.global_vars)
+            ),
+        )
+        up.add_params(MT.ARG_NUM_SAMPLES, 8)
+        up.add_params(MT.ARG_BASE_VERSION, 0)
+        up.add_params(MT.ARG_ROUND_IDX, tag)
+        server._on_delta_from_client(up)
+
+    upload(7)  # accepted: server replies with a fresh assignment
+    assert len(sent) == 1
+    fresh_tag = sent[0].get(MT.ARG_ROUND_IDX)
+    fresh_client = sent[0].get(MT.ARG_CLIENT_INDEX)
+    for _ in range(3):  # storm of duplicate retries
+        upload(7)
+    assert len(sent) == 4
+    for m in sent[1:]:
+        assert m.get(MT.ARG_ROUND_IDX) == fresh_tag
+        assert m.get(MT.ARG_CLIENT_INDEX) == fresh_client
+    # the worker's re-upload of the outstanding assignment is accepted once
+    upload(fresh_tag)
+    assert len(server._buffer) == 2
+    # ...and the reply to IT is a genuinely new assignment
+    assert sent[-1].get(MT.ARG_ROUND_IDX) != fresh_tag
+
+
 def test_async_requires_buffer_k():
     import pytest
 
