@@ -138,6 +138,17 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="How one chip runs the sampled clients: vmap (batched) "
                    "or scan (sequential — faster for conv models whose "
                    "small channels under-tile the MXU); auto picks per model")
+@click.option("--state_store", type=click.Choice(("auto", "device", "mmap")),
+              default="auto",
+              help="Where scaffold/ditto keep their per-client state: HBM "
+                   "stack (device), disk spill with cohort-only HBM rows "
+                   "(mmap), or auto by size vs --state_budget_bytes")
+@click.option("--state_budget_bytes", type=int, default=8 << 30,
+              help="state_store=auto: spill the per-client state to disk "
+                   "past this many bytes (default 8 GiB)")
+@click.option("--state_dir", type=str, default="",
+              help="Directory for the spilled state store (default: a "
+                   "fresh temp dir per run)")
 @click.option("--qffl_q", type=float, default=1.0,
               help="algorithm=qfedavg: fairness exponent q (0 = plain "
                    "FedAvg; larger = more uniform accuracy across clients)")
@@ -256,6 +267,9 @@ def build_config(opt) -> RunConfig:
             async_buffer_k=_checked_buffer_k(opt),
             async_staleness_exp=opt.get("staleness_exp", 0.5),
             async_server_lr=opt.get("async_server_lr", 1.0),
+            state_store=opt.get("state_store", "auto"),
+            state_budget_bytes=opt.get("state_budget_bytes", 8 << 30),
+            state_dir=opt.get("state_dir", ""),
         ),
         train=TrainConfig(
             client_optimizer=opt["client_optimizer"],
