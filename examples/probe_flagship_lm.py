@@ -1,0 +1,39 @@
+"""Calibrate the flagship bf16 gated row on the transformer LM (the
+47%-MFU mxu_validation config): synthetic shakespeare-geometry NWP,
+Markov next-char ceiling ~0.85 — find rounds-to-target + round cost."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_shakespeare
+from fedml_tpu.models import create_model
+
+opt = sys.argv[1] if len(sys.argv) > 1 else "sgd"
+lr = float(sys.argv[2]) if len(sys.argv) > 2 else 0.1
+data = synthetic_shakespeare(
+    num_clients=8, samples_per_client=512, seq_len=256, vocab_size=8192,
+    seed=0, seq_targets=True,
+)
+model = create_model(
+    "transformer", "shakespeare_synth", (256,), 8192,
+    num_layers=4, num_heads=8, embed_dim=512,
+)
+cfg = RunConfig(
+    data=DataConfig(batch_size=16, pad_bucket=1),
+    fed=FedConfig(
+        client_num_in_total=8, client_num_per_round=8, comm_round=60,
+        epochs=1, frequency_of_the_test=10_000,
+    ),
+    train=TrainConfig(client_optimizer=opt, lr=lr, compute_dtype="bfloat16"),
+    seed=0,
+)
+api = FedAvgAPI(cfg, data, model, task="nwp")
+t0 = time.perf_counter()
+for r in range(60):
+    api.train_round(r)
+    if (r + 1) % 5 == 0:
+        loss, acc = api.evaluate_global()
+        print(f"round {r+1}: loss={loss:.3f} acc={acc:.4f} elapsed={time.perf_counter()-t0:.0f}s", flush=True)

@@ -1,0 +1,45 @@
+"""Flagship LM calibration v2: vocab 1024 — check BOTH the accuracy
+trajectory and that device MFU stays >= 35%."""
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_shakespeare
+from fedml_tpu.models import create_model
+
+vocab = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+data = synthetic_shakespeare(
+    num_clients=8, samples_per_client=512, seq_len=256, vocab_size=vocab,
+    seed=0, seq_targets=True,
+)
+model = create_model(
+    "transformer", "shakespeare_synth", (256,), vocab,
+    num_layers=4, num_heads=8, embed_dim=512,
+)
+cfg = RunConfig(
+    data=DataConfig(batch_size=16, pad_bucket=1),
+    fed=FedConfig(
+        client_num_in_total=8, client_num_per_round=8, comm_round=100,
+        epochs=1, frequency_of_the_test=10_000,
+    ),
+    train=TrainConfig(client_optimizer="adam", lr=1e-3, compute_dtype="bfloat16"),
+    seed=0,
+)
+api = FedAvgAPI(cfg, data, model, task="nwp")
+
+# MFU first (same api, one warm round class)
+import bench
+row = bench._throughput_row(api, warmup=1, timed=3, label="probe_lm_v1024")
+print(json.dumps(row), flush=True)
+
+api = FedAvgAPI(cfg, data, model, task="nwp")
+t0 = time.perf_counter()
+for r in range(100):
+    api.train_round(r)
+    if (r + 1) % 10 == 0:
+        loss, acc = api.evaluate_global()
+        print(f"round {r+1}: loss={loss:.3f} acc={acc:.4f} elapsed={time.perf_counter()-t0:.0f}s", flush=True)

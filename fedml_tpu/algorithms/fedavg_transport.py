@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -82,6 +83,7 @@ class LocalTrainer:
         model: ModelDef,
         task: str,
         local_train_fn=None,
+        straggle_s: float = 0.0,
     ):
         self.config = config
         self.data = data
@@ -92,6 +94,10 @@ class LocalTrainer:
             make_local_train(model, config.train, config.fed.epochs, task=task)
         )
         self.client_index = 0
+        # Simulated compute heterogeneity: sleep this long after every
+        # local training (a slow phone among fast ones). Drives the
+        # straggler/async benchmarks; 0 = off.
+        self.straggle_s = float(straggle_s)
 
     def update_dataset(self, client_index: int):
         self.client_index = int(client_index)
@@ -118,7 +124,10 @@ class LocalTrainer:
             rng,
         )
         n = len(self.data.client_y[self.client_index])
-        return jax.device_get(new_vars), n
+        out = jax.device_get(new_vars)
+        if self.straggle_s:
+            time.sleep(self.straggle_s)
+        return out, n
 
 
 class FedAvgServerManager(ServerManager):
@@ -224,6 +233,7 @@ class FedAvgServerManager(ServerManager):
 
     def send_init_msg(self):
         """Sample round-0 clients, broadcast w0 (ref send_init_msg :20-28)."""
+        self._t0 = time.monotonic()
         sampled = client_sampling(
             0, self.config.fed.client_num_in_total, self.worker_num
         )
@@ -535,7 +545,12 @@ class FedAvgServerManager(ServerManager):
             )
         else:
             self.global_vars = avg
-        row = {"round": self.round_idx}
+        row = {
+            "round": self.round_idx,
+            # wall clock since w0 went out — the async bench's
+            # accuracy-at-matched-wall-clock comparison keys on this
+            "t_s": round(time.monotonic() - getattr(self, "_t0", time.monotonic()), 3),
+        }
         eval_now = self.data is not None and (
             self.round_idx % self.config.fed.frequency_of_the_test == 0
             or self.round_idx == self.config.fed.comm_round - 1

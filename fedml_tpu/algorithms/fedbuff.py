@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -170,6 +171,7 @@ class FedBuffServerManager(ServerManager):
             logging.warning("async dispatch to worker %d failed (%s)", worker, e)
 
     def send_init_msg(self):
+        self._t0 = time.monotonic()
         for worker in range(1, self.worker_num + 1):
             self._dispatch(worker, MT.S2C_INIT_CONFIG)
 
@@ -243,6 +245,8 @@ class FedBuffServerManager(ServerManager):
             "version": self.version,
             "staleness_mean": float(np.mean(taus)),
             "staleness_max": int(np.max(taus)),
+            # wall clock since w0 went out (matched-wall accuracy races)
+            "t_s": round(time.monotonic() - getattr(self, "_t0", time.monotonic()), 3),
         }
         if self.data is not None and (
             self.server_steps % self.config.fed.frequency_of_the_test == 0
@@ -260,6 +264,17 @@ class FedBuffServerManager(ServerManager):
         self.history.append(row)
         self.log_fn(row)
         if self.server_steps >= fed.comm_round:
+            hist = {}
+            for t in self.staleness_seen:
+                hist[int(t)] = hist.get(int(t), 0) + 1
+            self.log_fn(
+                {
+                    "async_final": True,
+                    "server_steps": self.server_steps,
+                    "wall_s": row["t_s"],
+                    "staleness_hist": {str(k): v for k, v in sorted(hist.items())},
+                }
+            )
             self._finished = True
             for worker in range(1, self.worker_num + 1):
                 try:

@@ -149,6 +149,10 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--state_dir", type=str, default="",
               help="Directory for the spilled state store (default: a "
                    "fresh temp dir per run)")
+@click.option("--straggle_ms", type=float, default=0.0,
+              help="Simulated compute heterogeneity for THIS rank's "
+                   "clients: sleep this long after every local training "
+                   "(drives the straggler/async benchmarks)")
 @click.option("--qffl_q", type=float, default=1.0,
               help="algorithm=qfedavg: fairness exponent q (0 = plain "
                    "FedAvg; larger = more uniform accuracy across clients)")
@@ -1036,7 +1040,11 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
             server.run()
             return server.history[-1] if server.history else {}
         client = FedBuffClientManager(
-            config, comm, rank, LocalTrainer(config, data, model, task)
+            config, comm, rank,
+            LocalTrainer(
+                config, data, model, task,
+                straggle_s=opt.get("straggle_ms", 0.0) / 1e3,
+            ),
         )
         client.run()
         if client.orphaned:
@@ -1067,7 +1075,11 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
             ) from server.deadline_error
         return server.history[-1] if server.history else {}
     client = FedAvgClientManager(
-        config, comm, rank, LocalTrainer(config, data, model, task)
+        config, comm, rank,
+        LocalTrainer(
+            config, data, model, task,
+            straggle_s=opt.get("straggle_ms", 0.0) / 1e3,
+        ),
     )
     client.run()
     return {"rank": rank, "finished": True}
