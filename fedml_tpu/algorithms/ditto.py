@@ -323,6 +323,7 @@ class DittoAPI(FedAvgAPI):
         # information content (untouched rows gather as w_0), so the
         # checkpoint survives tmp-cleaners and never references the live
         # (still-mutating) directory
+        self._v_store.flush()  # checkpoint == durability point for the spill tier
         idx = self._v_store.initialized_ids()
         rows = self._v_store.gather(idx)
         out = {"v_rows_idx": idx}

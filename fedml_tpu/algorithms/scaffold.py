@@ -235,10 +235,15 @@ def _make_scaffold_cohort_body(model, config, task, client_mode):
             )
             for k, v in y_vars.items()
         }
-        # c ← c + (|S|/N) · mean Δc_i  (uniform mean, per the paper)
-        frac = mask.shape[0] / n_total
+        # c ← c + (|S|/N) · mean Δc_i  (uniform mean, per the paper).
+        # |S| and the mean are derived from the inclusion mask, not the
+        # array axis: (|S|/N)·mean over REAL rows ≡ Σ_incl Δc_i / N, so a
+        # padded cohort (num_samples == 0 dummy rows, pad_clients_to's
+        # contract) cannot inflate |S| or deflate the update — advisor r4.
+        incl = (num_samples > 0).astype(jnp.float32)
         c_server_new = jax.tree_util.tree_map(
-            lambda cs, new, old: cs + frac * jnp.mean(new - old, axis=0),
+            lambda cs, new, old: cs
+            + jnp.tensordot(incl, new - old, axes=1) / n_total,
             c_server, c_new, c_rows,
         )
         agg = jax.tree_util.tree_map(jnp.sum, metrics)
@@ -464,6 +469,7 @@ class ScaffoldAPI(FedAvgAPI):
         # information content (untouched rows gather as zeros), so the
         # checkpoint survives tmp-cleaners and never references the live
         # (still-mutating) directory
+        self._c_store.flush()  # checkpoint == durability point for the spill tier
         idx = self._c_store.initialized_ids()
         rows = self._c_store.gather(idx)
         out = {"c_server": self.c_server, "c_rows_idx": idx}

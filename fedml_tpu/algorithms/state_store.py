@@ -31,7 +31,9 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import tempfile
+import weakref
 from typing import Optional, Sequence
 
 import jax
@@ -49,7 +51,18 @@ class MmapClientState:
         self.n = int(n_clients)
         leaves, self._treedef = jax.tree_util.tree_flatten(init_tree)
         self._init_leaves = [np.asarray(l) for l in leaves]
+        path = path or None  # "" (FedConfig.state_dir default) == unset
         self.path = path or tempfile.mkdtemp(prefix="fedml_tpu_state_")
+        if path is None:
+            # a self-created temp spill dir is scratch, not a deliverable:
+            # without cleanup every 100k-client run leaks N x |params|
+            # bytes of /tmp (advisor r4). User-supplied paths are THEIRS
+            # (resume target) and are never removed.
+            self._cleanup = weakref.finalize(
+                self, shutil.rmtree, self.path, ignore_errors=True
+            )
+        else:
+            self._cleanup = None
         os.makedirs(self.path, exist_ok=True)
         meta_path = os.path.join(self.path, "meta.json")
         schema = [

@@ -8,11 +8,13 @@ Private Recurrent Language Models" — public algorithm, fresh
 implementation) on the same round-hook skeleton the robust defenses use:
 
   1. the round's cohort is POISSON-sampled: every client independently
-     with probability q = m_hat/N, from a per-round PRNG seeded by the
-     run seed (np.random.SeedSequence), NOT the round index alone — a
-     round-seeded draw would be publicly predictable, which voids
-     amplification-by-subsampling (the adversary must not know who
-     participated);
+     with probability q = m_hat/N, from a per-round PRNG seeded by a
+     128-bit OS-entropy secret drawn at API construction
+     (np.random.SeedSequence), NOT the round index alone and NOT
+     config.seed — a round-seeded or default-seeded draw would be
+     publicly predictable, which voids amplification-by-subsampling
+     (the adversary must not know who participated). The secret rides
+     in checkpoint_state so a resume continues the same stream;
   2. each sampled client's UPDATE delta_i = w_i - w_t is clipped to L2
      norm S over the ENTIRE uploaded tree (params and any stats — the
      guarantee must cover everything transmitted, so unlike the robust
@@ -41,6 +43,8 @@ the DP math adds no host round-trips.
 from __future__ import annotations
 
 import dataclasses
+import secrets
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -61,14 +65,16 @@ def poisson_client_sampling(
     run_seed: int, round_idx: int, client_num_in_total: int, q: float
 ) -> np.ndarray:
     """One Poisson cohort draw: every client independently with probability
-    ``q``, from a fresh per-round stream derived from the RUN seed.
+    ``q``, from a fresh per-round stream derived from ``run_seed`` — which
+    the API feeds from a 128-bit OS-entropy secret (``fresh_sample_secret``),
+    never from ``config.seed``.
 
     This is the sampler the RDP accountant's subsampled-Gaussian bound is
     FOR — and unlike :func:`fedavg.client_sampling`'s round-seeded draw
     (reference parity, FedAVGAggregator.py:80-88) it is not predictable
     from public information alone: amplification by subsampling requires
-    the adversary not to know who participated, so the run seed must be
-    treated as secret for the epsilon to hold."""
+    the adversary not to know who participated, so the stream's seed must
+    be secret AND high-entropy for the epsilon to hold."""
     if not 0.0 < q <= 1.0:
         raise ValueError(f"sampling probability q must be in (0, 1], got {q}")
     rng = np.random.default_rng(
@@ -91,6 +97,41 @@ class DpConfig:
     clip_norm: float = 1.0  # S: per-client update L2 bound
     noise_multiplier: float = 1.0  # z: noise stddev in units of S (on the sum)
     delta: float = 1e-5  # the delta at which epsilon is reported
+    # Secret seeding the Poisson participation stream. None (the default)
+    # draws 128 bits from OS entropy at API construction — the epsilon
+    # claim requires the adversary not to predict who participated, and
+    # config.seed is a public, low-entropy, reused value (data shuffling
+    # and the broadcast w_0 both derive from it), so it must never seed
+    # the cohorts. Pass an explicit value ONLY for tests/repro; anything
+    # under 64 bits warns that amplification-by-subsampling is void.
+    sample_secret: int | None = None
+
+
+def fresh_sample_secret() -> int:
+    """128 bits of OS entropy for the DP participation stream."""
+    return secrets.randbits(128)
+
+
+def _secret_to_words(secret: int, n_words: int = 8) -> np.ndarray:
+    """Secret int -> uint32 word array (little-endian). uint32 because the
+    words may ride through jax collectives (multi-host broadcast), where
+    64-bit ints are silently truncated to 32 bits with x64 disabled — the
+    one encoding shared by checkpointing and broadcast so a truncating
+    variant can't creep in."""
+    if secret.bit_length() > 32 * n_words:
+        raise ValueError(f"secret exceeds {32 * n_words} bits")
+    return np.asarray(
+        [(secret >> (32 * i)) & 0xFFFFFFFF for i in range(n_words)], np.uint32
+    )
+
+
+def _words_to_secret(words) -> int:
+    """Inverse of :func:`_secret_to_words`; decodes by the array's actual
+    word width rather than assuming 32 bits (defensive — a checkpoint
+    edited or produced by other tooling stays restorable)."""
+    words = np.asarray(words)
+    bits = words.dtype.itemsize * 8
+    return sum(int(w) << (bits * i) for i, w in enumerate(words.tolist()))
 
 
 def clip_update_tree(local_tree, global_tree, clip_norm: float):
@@ -170,6 +211,51 @@ class DPFedAvgAPI(FedAvgAPI):
     def __init__(self, config, data, model, dp: DpConfig = DpConfig(), **kw):
         self.dp = dp
         super().__init__(config, data, model, **kw)
+        # The participation stream's seed is OS entropy, NOT config.seed:
+        # config.seed is public/low-entropy (defaults to 0, reused by data
+        # shuffling and the broadcast init), so cohorts derived from it are
+        # predictable and the accountant's amplification-by-subsampling
+        # claim is void (advisor r4, medium). An explicit dp.sample_secret
+        # is honored for tests/repro and resume, with a warning when it is
+        # too small to be credible entropy.
+        if dp.sample_secret is None:
+            self._sample_secret = fresh_sample_secret()
+            self._secret_provenance = "128-bit OS entropy"
+            if jax.process_count() > 1:
+                # every process must draw the SAME cohorts (mismatched
+                # cohort shapes would wedge the SPMD round's collectives):
+                # process 0's draw wins, broadcast as uint32 words (jax
+                # would silently truncate 64-bit words with x64 disabled)
+                from jax.experimental import multihost_utils
+
+                self._sample_secret = _words_to_secret(
+                    np.asarray(
+                        multihost_utils.broadcast_one_to_all(
+                            _secret_to_words(self._sample_secret)
+                        )
+                    ).astype(np.uint32)
+                )
+        else:
+            self._sample_secret = int(dp.sample_secret)
+            if self._sample_secret < 0:
+                raise ValueError(
+                    "DpConfig.sample_secret must be a non-negative integer "
+                    f"(got {self._sample_secret}); SeedSequence rejects "
+                    "negative entropy"
+                )
+            self._secret_provenance = (
+                f"explicit DpConfig.sample_secret "
+                f"({self._sample_secret.bit_length()} bits — amplification "
+                "holds only if this value is secret and high-entropy)"
+            )
+            if self._sample_secret.bit_length() < 64:
+                warnings.warn(
+                    "DpConfig.sample_secret has <64 bits of entropy: the "
+                    "Poisson cohorts are predictable and the reported "
+                    "epsilon's amplification-by-subsampling does not hold. "
+                    "Use this only for tests/reproduction.",
+                    stacklevel=2,
+                )
         self.accountant = RdpAccountant()
         # N from the DATA (the population actually sampled from), not the
         # config echo — the accounted q and the executed q must be the
@@ -185,7 +271,7 @@ class DPFedAvgAPI(FedAvgAPI):
     def _sample_clients(self, round_idx: int) -> np.ndarray:
         # the SAME q the accountant steps with — mechanism == ledger
         return poisson_client_sampling(
-            self.config.seed, round_idx, self.data.num_clients, self._q
+            self._sample_secret, round_idx, self.data.num_clients, self._q
         )
 
     def _round_batch(self, sampled, round_idx: int):
@@ -247,9 +333,18 @@ class DPFedAvgAPI(FedAvgAPI):
         the true privacy cost of everything already released."""
         import numpy as np
 
+        # the sampling secret rides along (as uint32 words — it exceeds
+        # int64): a resume that re-drew it would fork the participation
+        # stream mid-ledger, decoupling the executed mechanism from the
+        # accounted one for the remaining rounds. DISCLOSURE: a checkpoint
+        # carrying dp_sample_secret reveals the whole participation stream
+        # to anyone who reads it — checkpoints of DP runs are secrets
+        # themselves and must not be published while the epsilon claim is
+        # supposed to hold against recipients of the artifact
         return {
             "dp_rdp": np.asarray(self.accountant._rdp, np.float64),
             "dp_rounds": np.asarray(self.accountant.rounds, np.int64),
+            "dp_sample_secret": _secret_to_words(self._sample_secret),
         }
 
     def restore_state(self, tree):
@@ -257,6 +352,23 @@ class DPFedAvgAPI(FedAvgAPI):
 
         self.accountant._rdp = [float(v) for v in np.asarray(tree["dp_rdp"])]
         self.accountant.rounds = int(np.asarray(tree["dp_rounds"]))
+        if "dp_sample_secret" in tree:
+            self._sample_secret = _words_to_secret(tree["dp_sample_secret"])
+        else:
+            warnings.warn(
+                "checkpoint predates dp_sample_secret: it was written by a "
+                "build whose cohorts derived from the public config.seed "
+                "(amplification-by-subsampling did not hold for those "
+                "rounds). The participation stream forks here — continuing "
+                "with this API's constructed secret (fresh OS entropy "
+                "unless DpConfig.sample_secret was set); the ledger's "
+                "epsilon is honest only from this round on.",
+                stacklevel=2,
+            )
+            self._secret_provenance += (
+                " (resumed from a pre-secret checkpoint: earlier cohorts "
+                "derived from the public config.seed)"
+            )
 
     def privacy_spent(self):
         eps, order = self.accountant.epsilon(self.dp.delta)
@@ -268,7 +380,9 @@ class DPFedAvgAPI(FedAvgAPI):
             "DP/sampling_note": (
                 f"Poisson-sampled cohorts executed at q={self._q:.4g} — "
                 "the accounted mechanism and the run sampler are the same "
-                "object (epsilon assumes the run seed is kept secret)"
+                "object; participation stream seeded from "
+                f"{self._secret_provenance} (epsilon assumes the seed "
+                "stays secret)"
             ),
         }
 

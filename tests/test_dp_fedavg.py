@@ -3,6 +3,7 @@ accounted upgrade over the reference's ad-hoc weak-DP noise
 (robust_aggregation.py:38-55, which never reports an epsilon)."""
 
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -129,11 +130,16 @@ def test_zero_noise_huge_clip_equals_uniform_mean_fedavg():
         )
 
 
+_TEST_SECRET = 0xDEADBEEF_CAFEBABE_0123456789ABCDEF  # 125-bit repro secret
+
+
 def test_noise_is_applied_and_seeded():
     data, model = _data_model()
     mk = lambda: DPFedAvgAPI(
         _cfg(rounds=1), data, model,
-        dp=DpConfig(clip_norm=0.5, noise_multiplier=1.0),
+        dp=DpConfig(
+            clip_norm=0.5, noise_multiplier=1.0, sample_secret=_TEST_SECRET
+        ),
     )
     a, b = mk(), mk()
     a.train_round(0)
@@ -147,7 +153,9 @@ def test_noise_is_applied_and_seeded():
     # and it differs from the noiseless run
     c = DPFedAvgAPI(
         _cfg(rounds=1), data, model,
-        dp=DpConfig(clip_norm=0.5, noise_multiplier=1e-12),
+        dp=DpConfig(
+            clip_norm=0.5, noise_multiplier=1e-12, sample_secret=_TEST_SECRET
+        ),
     )
     c.train_round(0)
     diffs = [
@@ -190,6 +198,39 @@ def test_ledger_survives_checkpoint_roundtrip():
     b.restore_state(state)
     assert b.accountant.rounds == 6
     assert b.privacy_spent()["DP/epsilon"] == a.privacy_spent()["DP/epsilon"]
+    # the sampling secret rides with the ledger: the resumed run continues
+    # the SAME participation stream (a re-draw would fork the mechanism
+    # away from the accounted one mid-run)
+    assert b._sample_secret == a._sample_secret
+    for r in range(6, 10):
+        assert b._sample_clients(r).tolist() == a._sample_clients(r).tolist()
+
+
+def test_dp_sampling_secret_is_os_entropy_not_config_seed():
+    """Advisor r4 (medium): config.seed defaults to 0 and is public/reused
+    (data shuffling, broadcast init), so the participation stream must
+    come from OS entropy by default — two default-constructed APIs at the
+    same config.seed draw DIFFERENT cohorts — and an explicit low-entropy
+    secret must warn that amplification is void."""
+    data, model = _data_model()
+    a = DPFedAvgAPI(_cfg(), data, model)
+    b = DPFedAvgAPI(_cfg(), data, model)
+    assert a._sample_secret != b._sample_secret
+    assert a._sample_secret.bit_length() > 64  # 128-bit draw
+    cohorts_a = [a._sample_clients(r).tolist() for r in range(30)]
+    cohorts_b = [b._sample_clients(r).tolist() for r in range(30)]
+    assert cohorts_a != cohorts_b
+    with pytest.warns(UserWarning, match="entropy"):
+        DPFedAvgAPI(
+            _cfg(), data, model,
+            dp=DpConfig(sample_secret=0),  # the old config.seed default
+        )
+    # a high-entropy explicit secret (tests/repro/resume) does not warn
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        DPFedAvgAPI(
+            _cfg(), data, model, dp=DpConfig(sample_secret=_TEST_SECRET)
+        )
 
 
 def test_cli_rejects_degenerate_dp_flags():
@@ -242,7 +283,12 @@ def test_mesh_dp_poisson_cohort_matches_vmap():
     from fedml_tpu.parallel import DistributedDPFedAvgAPI
 
     data, model = _data_model()
-    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.7)
+    # the two runtimes must draw the SAME Poisson cohorts to be comparable
+    # — share an explicit repro secret (each would otherwise draw its own
+    # OS-entropy stream)
+    dp = DpConfig(
+        clip_norm=0.5, noise_multiplier=0.7, sample_secret=_TEST_SECRET
+    )
     sim = DPFedAvgAPI(_cfg(rounds=4, per_round=5), data, model, dp=dp)
     mesh = DistributedDPFedAvgAPI(
         _cfg(rounds=4, per_round=5), data, model, dp=dp
@@ -321,7 +367,9 @@ def test_dp_padding_invariance():
     import fedml_tpu.privacy.dp_fedavg as dpmod
 
     data, model = _data_model()
-    dp = DpConfig(clip_norm=0.5, noise_multiplier=0.9)
+    dp = DpConfig(
+        clip_norm=0.5, noise_multiplier=0.9, sample_secret=_TEST_SECRET
+    )
     a = DPFedAvgAPI(_cfg(rounds=2), data, model, dp=dp)
     b = DPFedAvgAPI(_cfg(rounds=2), data, model, dp=dp)
     orig = dpmod.bucket_cohort
@@ -381,3 +429,42 @@ def test_cli_dp_fedavg_reachable():
     assert result.exit_code == 0, result.output
     row = json.loads(result.output.strip().splitlines()[-1])
     assert row["DP/epsilon"] > 0 and row["DP/delta"] == 1e-5
+
+
+def test_dp_secret_validation_and_legacy_checkpoint_warning():
+    data, model = _data_model()
+    with pytest.raises(ValueError, match="non-negative"):
+        DPFedAvgAPI(_cfg(), data, model, dp=DpConfig(sample_secret=-1))
+    # a legacy checkpoint (no dp_sample_secret) resumes with a loud
+    # warning that the participation stream forks here
+    api = DPFedAvgAPI(_cfg(), data, model, dp=DpConfig(sample_secret=_TEST_SECRET))
+    api.train_round(0)
+    state = api.checkpoint_state()
+    state.pop("dp_sample_secret")
+    b = DPFedAvgAPI(_cfg(), data, model)
+    with pytest.warns(UserWarning, match="forks"):
+        b.restore_state(state)
+    assert b.accountant.rounds == 1
+
+
+def test_secret_word_encoding_roundtrips_and_is_jax_safe():
+    """The secret<->words encoding must survive a pass through jnp (the
+    multi-host broadcast path): uint32 words are immune to the silent
+    64->32-bit truncation jax applies with x64 disabled."""
+    from fedml_tpu.privacy.dp_fedavg import (
+        _secret_to_words,
+        _words_to_secret,
+    )
+
+    for sec in (0, 1, _TEST_SECRET, (1 << 128) - 1):
+        words = _secret_to_words(sec)
+        assert words.dtype == np.uint32
+        assert _words_to_secret(words) == sec
+        # through jnp and back (broadcast_one_to_all's transport)
+        assert _words_to_secret(np.asarray(jnp.asarray(words))) == sec
+    # decode follows the array's actual word width (defensive tolerance
+    # for checkpoints touched by other tooling)
+    wide = np.asarray([0xDEADBEEF_CAFEBABE, 0x1234], np.uint64)
+    assert _words_to_secret(wide) == (0x1234 << 64) | 0xDEADBEEF_CAFEBABE
+    with pytest.raises(ValueError, match="exceeds"):
+        _secret_to_words(1 << 300)

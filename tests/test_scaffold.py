@@ -266,3 +266,52 @@ def test_rejects_momentum_and_spills_oversize_store():
     api = ScaffoldAPI(tiny_budget, data, model)
     assert api._state_mode == "mmap" and api.c_stack is None
     api.train_round(0)  # and it trains
+
+
+def test_cohort_body_ignores_padding_rows():
+    """Advisor r4: the shared cohort body must derive |S| and the Delta-c
+    mean from the inclusion mask (num_samples > 0), not the array axis —
+    padding the cohort with pad_clients_to dummy rows must leave the
+    round's outputs exactly unchanged."""
+    from fedml_tpu.algorithms.scaffold import _make_scaffold_cohort_body
+    from fedml_tpu.data.base import pad_clients_to
+
+    data = _data()
+    cfg = _cfg(rounds=1)
+    model = create_model("lr", "synthetic", (FEAT,), N_CLASSES)
+    api = ScaffoldAPI(cfg, data, model)
+    sampled, _, _ = api._round_plan(0)
+    batch = api._round_batch(sampled, 0)
+    rng = jax.random.fold_in(api.rng, 1)
+    body = jax.jit(
+        _make_scaffold_cohort_body(
+            model, api.config, "classification", api._client_mode
+        )
+    )
+    c_rows = jax.tree_util.tree_map(
+        lambda a: a[np.asarray(sampled)], api.c_stack
+    )
+    ref = body(
+        api.global_vars, api.c_server, c_rows, *api._place_batch(batch, rng)
+    )
+
+    extra = 3
+    padded = pad_clients_to(batch, batch.num_clients + extra)
+    c_rows_pad = jax.tree_util.tree_map(
+        lambda a: jnp.pad(a, [(0, extra)] + [(0, 0)] * (a.ndim - 1)), c_rows
+    )
+    got = body(
+        api.global_vars, api.c_server, c_rows_pad,
+        *api._place_batch(padded, rng),
+    )
+    labels = ("global_vars", "c_server", "c_rows", "metrics")
+    for name, a, b in zip(labels, ref, got):
+        if name == "c_rows":
+            b = jax.tree_util.tree_map(lambda x: x[: batch.num_clients], b)
+        for x, y in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-7,
+                err_msg=name,
+            )
