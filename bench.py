@@ -428,6 +428,13 @@ def _hard_synthetic11():
     from fedml_tpu.data.synthetic import synthetic_fedprox
     from fedml_tpu.models import create_model
 
+    # expected-outcome PINS (VERDICT r3 #7 / r4 Next #3): the regime is
+    # BUILT so FedAvg misses (drift) and the drift-correcting algorithms
+    # reach — any deviation (either direction) exits the bench nonzero
+    expected = {
+        "fedavg": "miss", "fedprox": "reach", "fedopt": "reach",
+        "scaffold": "reach",
+    }
     rows = []
     for algo in ("fedavg", "fedprox", "fedopt", "scaffold"):
         data = synthetic_fedprox(alpha=1.0, beta=1.0, seed=0)
@@ -437,7 +444,10 @@ def _hard_synthetic11():
             comm_round=100, prox_mu=1.0,
         )
         row = _run_to_target(api, target=0.60, max_rounds=100, eval_every=20)
-        row.update({"regime": "synthetic(1,1) E=20", "algo": algo})
+        row.update({
+            "regime": "synthetic(1,1) E=20", "algo": algo,
+            "expected": expected[algo],
+        })
         rows.append(row)
     by = {r["algo"]: r for r in rows}
     # drift-correction algorithms must beat plain FedAvg on the regime
@@ -462,6 +472,17 @@ def _hard_femnist_lda():
     from fedml_tpu.data.femnist_synth import femnist_synthetic_lda
     from fedml_tpu.models import create_model
 
+    # expected-outcome PINS from the last captured record (BENCH_r03):
+    # fedavg/fedprox reach at both alphas; fedopt at alpha=0.1 MISSED
+    # (0.7981@150 — adam server-lr sensitivity under severe skew) and is
+    # pinned as a miss: if it ever reaches, that's a behavior change the
+    # bench flags loudly (update the pin with the cause, don't shrug)
+    expected = {
+        (0.1, "fedavg"): "reach", (0.1, "fedprox"): "reach",
+        (0.1, "fedopt"): "miss",
+        (0.5, "fedavg"): "reach", (0.5, "fedprox"): "reach",
+        (0.5, "fedopt"): "reach",
+    }
     rows = []
     for alpha in (0.1, 0.5):
         for algo in ("fedavg", "fedprox", "fedopt"):
@@ -476,7 +497,10 @@ def _hard_femnist_lda():
                 comm_round=150, prox_mu=0.1, server=("adam", 0.005),
             )
             row = _run_to_target(api, target=0.80, max_rounds=150, eval_every=25)
-            row.update({"regime": f"femnist_lda alpha={alpha}", "algo": algo})
+            row.update({
+                "regime": f"femnist_lda alpha={alpha}", "algo": algo,
+                "expected": expected[(alpha, algo)],
+            })
             rows.append(row)
     # bf16 parity on the rising part of the alpha=0.1 fedavg curve
     parity = {}
@@ -505,6 +529,7 @@ def _hard_femnist_lda():
         "max_gap": round(max(gaps), 4),
         "parity_on_rising_curve": bool(max(gaps) < 0.02),
         "note": "curve still rising at these rounds (plateau ~0.81 at 125+)",
+        "expected": "reach",  # pin: bf16 tracks fp32 within 0.02 while rising
     }
     return rows, parity_row
 
@@ -723,7 +748,7 @@ def _scale_100k_stateful(num_clients=100_000, timed_rounds=15):
     }
 
 
-def _fedbuff_async(workers=4, straggle_ms=1500.0, sync_rounds=8, async_steps=24):
+def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=5, async_steps=15):
     """Async (FedBuff) vs sync (barrier) under compute heterogeneity —
     VERDICT r3 Next #3: async's pitch, quantified. Both arms run as REAL
     OS processes over gRPC on localhost (1 server + ``workers`` workers;
@@ -757,7 +782,7 @@ def _fedbuff_async(workers=4, straggle_ms=1500.0, sync_rounds=8, async_steps=24)
             "--client_num_per_round", str(workers),
             "--comm_round", str(comm_round),
             "--batch_size", "20", "--lr", "0.1", "--seed", "0",
-            "--frequency_of_the_test", "4",
+            "--frequency_of_the_test", "3",
             "--base_port", str(port),
         ] + extra
         procs = []
@@ -775,7 +800,11 @@ def _fedbuff_async(workers=4, straggle_ms=1500.0, sync_rounds=8, async_steps=24)
         outs = []
         try:
             for p in procs:
-                out, _ = p.communicate(timeout=420)
+                # r4's 420 s/process ceiling made the section's worst case
+                # exceed its own 300 s budget estimate (VERDICT r4 Weak
+                # #3); the shrunk arms (5 sync rounds / 15 async steps,
+                # 800 ms straggle) finish in ~30-60 s — 150 s is generous
+                out, _ = p.communicate(timeout=150)
                 outs.append(out)
                 if p.returncode != 0:
                     raise RuntimeError(
@@ -836,6 +865,148 @@ def _fedbuff_async(workers=4, straggle_ms=1500.0, sync_rounds=8, async_steps=24)
     }
 
 
+def _flagship_bf16(comm_round=100, target=None, eval_every=10):
+    """The accuracy-GATED flagship bf16 row (VERDICT r3 Next #1 / r4 Next
+    #2): the production FedAvg round on the transformer LM (4L/8H/512d,
+    vocab 1024, seq 256 — MXU-friendly 512-wide matmuls), bf16, Adam
+    clients, synthetic-shakespeare geometry. Reports device MFU AND an
+    accuracy target/horizon with an ``expected: reach`` pin, so the
+    "matching-or-beating" claim rides a workload that exercises the MXU at
+    >=35% utilization instead of an fp32 small-CNN headline. Calibration:
+    examples/probe_flagship_lm2.py (curve + per-round cost recorded in
+    docs/PERF_R5.md). Ref regime: /root/reference/benchmark/README.md:55-57
+    (accuracy-to-target as the benchmark currency)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_shakespeare
+    from fedml_tpu.models import create_model
+
+    target = target if target is not None else _FLAGSHIP_TARGET
+    vocab = 1024
+    data = synthetic_shakespeare(
+        num_clients=8, samples_per_client=512, seq_len=256, vocab_size=vocab,
+        seed=0, seq_targets=True,
+    )
+    model = create_model(
+        "transformer", "shakespeare_synth", (256,), vocab,
+        num_layers=4, num_heads=8, embed_dim=512,
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=16, pad_bucket=1),
+        fed=FedConfig(
+            client_num_in_total=8, client_num_per_round=8,
+            comm_round=comm_round, epochs=1, frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(
+            client_optimizer="adam", lr=1e-3, compute_dtype="bfloat16"
+        ),
+        seed=0,
+    )
+    api = FedAvgAPI(cfg, data, model, task="nwp")
+    perf = _throughput_row(api, warmup=1, timed=3, label="flagship_lm_bf16")
+    _reset(api)
+    gate = _run_to_target(
+        api, target=target, max_rounds=comm_round, eval_every=eval_every
+    )
+    gate.update(
+        {
+            "regime": "flagship transformer LM vocab=1024 bf16 adam",
+            "algo": "fedavg",
+            "expected": "reach",
+        }
+    )
+    return {
+        **perf,
+        "accuracy_gate": gate,
+        "mfu_floor": 0.35,
+        "mfu_ok": bool(perf.get("mfu_device", 0) >= 0.35),
+        "note": (
+            "the flagship row: device MFU >= 0.35 AND the accuracy target "
+            "reached within the horizon, on the same production round "
+            "runtime as every other row"
+        ),
+    }
+
+
+def _flash_attention_row(S=8192, H=8, D=64, cycles=4):
+    """Pallas flash-attention TRAINING-step win at long sequence
+    (VERDICT r3 Next #6 / r4 Next #7): grad of causal attention at
+    S=8192, kernel vs plain-XLA jnp attention, INTERLEAVED best-of —
+    under reverse-mode AD the jnp path saves the S x S probabilities as a
+    residual (H*S^2*2 bytes) while the kernel's custom VJP recomputes P
+    blockwise (ops/flash_attention.py:27-34). Wall times through the
+    tunnel are RTT-inflated for both arms; the ratio is the signal, and
+    the device-side scan slope is reported for the kernel arm."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops.flash_attention import flash_attention
+    from fedml_tpu.utils import profiling
+
+    k0 = jax.random.PRNGKey(0)
+    q = jax.random.normal(k0, (H, S, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(k0, 1), (H, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(k0, 2), (H, S, D), jnp.bfloat16)
+
+    def xla_attn(q, k, v):
+        scale = 1.0 / np.sqrt(D)
+        s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("...qk,...kd->...qd", p, v)
+
+    def flash_causal(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    loss = lambda fn: lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32))
+    fns = {
+        "flash": jax.jit(jax.grad(loss(flash_causal), argnums=(0, 1, 2))),
+        "xla": jax.jit(jax.grad(loss(xla_attn), argnums=(0, 1, 2))),
+    }
+
+    def run(f):
+        t0 = time.perf_counter()
+        out = f(q, k, v)
+        np.asarray(out[0][0, 0, 0])  # host fetch drains the queue
+        return time.perf_counter() - t0
+
+    for f in fns.values():  # compile + warm
+        run(f)
+        run(f)
+    best = {n: float("inf") for n in fns}
+    for _ in range(cycles):  # interleaved: tunnel drift hits both arms
+        for n, f in fns.items():
+            best[n] = min(best[n], run(f))
+    # device-only time for the kernel arm (scan slope cancels the tunnel)
+    dev_s = profiling.scan_slope_seconds(
+        lambda qq: fns["flash"](qq, k, v)[0], q, k1=1, k2=3
+    )
+    # causal attention fwd+bwd FLOPs: fwd 2 matmuls ~ 4*H*S^2*D/2 (causal
+    # half), bwd ~ 2x fwd, + the VJP's blockwise P recompute (~1x fwd's
+    # first matmul) — the standard flash-attn2 accounting
+    flops = 3.5 * 4 * H * S * S * D / 2
+    return {
+        "seq_len": S,
+        "heads": H,
+        "head_dim": D,
+        "dtype": "bfloat16",
+        "train_step": "grad of causal attention (argnums 0,1,2)",
+        "flash_ms_wall": round(best["flash"] * 1e3, 1),
+        "xla_ms_wall": round(best["xla"] * 1e3, 1),
+        "flash_ms_device": round(dev_s * 1e3, 1),
+        "flash_over_xla_speedup": round(best["xla"] / best["flash"], 2),
+        "flash_mfu_device": round(
+            profiling.mfu(flops, 1.0 / dev_s, "bfloat16") or 0, 4
+        ),
+        "timing": f"interleaved best-of-{cycles}; ratio is the signal",
+        # the PIN (not derived from this run): the kernel must beat plain
+        # XLA by >= 1.5x on the S=8192 training step; probe measured ~3x
+        "expected_speedup_at_least": 1.5,
+        "expected": "reach",
+    }
+
+
 def _backend_alive(timeout_s: float = 300.0):
     """Probe jax backend init in a SUBPROCESS with a hard timeout.
     Observed failure mode (round 3): when the remote TPU tunnel is down,
@@ -878,169 +1049,131 @@ def _backend_alive(timeout_s: float = 300.0):
     return False, "backend init failed: " + ("; ".join(tail[-2:]) or "no stderr")[-300:]
 
 
-def main():
-    t0 = time.perf_counter()  # the probe below counts against the budget
-    alive, why = _backend_alive()
-    if not alive:
-        print(
-            json.dumps(
-                {
-                    "metric": "femnist_cnn_fedavg_rounds_per_sec",
-                    "value": None,
-                    "unit": "rounds/sec",
-                    "error": (
-                        f"no measurements possible this run: {why}. Last "
-                        "recorded full pass: BENCH_r02.json / "
-                        "docs/ROUND3.md headline."
-                    ),
-                }
-            )
-        )
-        return
+# ---------------------------------------------------------------------------
+# loss-proof record emission (VERDICT r4 Next #1)
+#
+# Round 4's record died whole: bench.py printed ONE JSON line at the very
+# end, the driver's timeout killed the process first, and every completed
+# section's evidence vanished (BENCH_r04.json: rc=124, parsed=null).
+# Forensics on rounds 1-3 pin the driver's parse contract: it keeps the
+# LAST ~2000 chars of output and parses the last line — round 1's 258-char
+# record parsed, rounds 2-3's ~8 KB single line was truncated mid-line and
+# did not. Three consequences drive this design:
+#   1. the final stdout line must be COMPACT (< ~1500 chars) — the full
+#      evidence lives in BENCH_DETAIL.json, atomically rewritten after
+#      every section;
+#   2. emission is INCREMENTAL: a fresh compact line (flush=True) after
+#      every section, so whatever kills the process, the last flushed
+#      line is a parseable record of everything completed so far;
+#   3. nothing may print to stdout after the record line.
+# A watchdog thread hard-finalizes at 92% of the budget (os._exit — it
+# fires even when the main thread is wedged in an uninterruptible tunnel
+# call), SIGTERM/SIGINT finalize early (the driver's `timeout` sends TERM
+# before KILL), and each section runs under a SIGALRM wall cap so one
+# hung section can't starve the rest. Pinned by tests/test_bench_resilience.py,
+# including a mid-run SIGKILL.
+# ---------------------------------------------------------------------------
 
-    import jax
+_FLAGSHIP_TARGET = 0.55  # pinned from examples/probe_flagship_lm2.py
 
-    # The driver gives one shot at this script and a timeout loses the
-    # whole record, so the optional sections check the remaining wall
-    # budget BEFORE starting and degrade to a self-describing skipped row.
-    # This is a pre-start heuristic, not a hard guarantee: the mandatory
-    # rows (north-star, cross-silo) are unguarded, and a section that
-    # stalls mid-flight can still overrun — the per-section estimates and
-    # the accuracy-run early stop are the mitigation, the budget default
-    # leaves headroom under the observed ~45-min full pass. t0 was set
-    # before the backend probe, so the probe's cost is inside the budget.
-    budget_s = float(os.environ.get("FEDML_TPU_BENCH_BUDGET_S", 2100))
 
-    def _with_budget(name, fn, fallback, min_remaining_s):
-        """Budget gate + failure isolation. A section that raises must not
-        lose the whole one-shot record (observed: a transient tunnel error
-        'response body closed before all bytes were read' mid-section
-        killed an entire pass) — it gets ONE retry, then degrades to a
-        self-describing failure row. Used for the mandatory rows too
-        (min_remaining_s=0 ⇒ always attempted)."""
-        if time.perf_counter() - t0 > budget_s - min_remaining_s:
-            return fallback(
-                f"skipped {name}: {round(time.perf_counter() - t0)}s elapsed "
-                f"of {round(budget_s)}s budget, section needs "
-                f"~{min_remaining_s}s"
-            )
-        for attempt in (1, 2):
-            try:
-                return fn()
-            except Exception as e:  # noqa: BLE001 — record, don't die
-                err = f"{type(e).__name__}: {str(e)[:300]}"
-                out_of_time = (
-                    time.perf_counter() - t0 > budget_s - min_remaining_s
-                )
-                if attempt == 2 or out_of_time:
-                    return fallback(
-                        f"section {name} failed "
-                        f"(attempt {attempt}): {err}"
-                    )
+class _SectionTimeout(Exception):
+    pass
 
-    # Section order = judge-priority order: the mandatory throughput rows,
-    # then the hard-accuracy gates (VERDICT r2 Missing #1 — these must
-    # never be the rows a slow pass starves), then the fused/scale/MXU
-    # evidence rows, which degrade to self-describing skips first.
-    fail_row = lambda why: {"skipped": why}
-    north_fp32 = _with_budget(
-        "north_star_fp32",
-        lambda: _throughput_row(_north_star_api("float32"), 3, 40, "north_star"),
-        fail_row, 0,
-    )
-    north_bf16 = _with_budget(
-        "north_star_bf16",
-        lambda: _throughput_row(_north_star_api("bfloat16"), 3, 40, "north_star"),
-        fail_row, 0,
-    )
-    bf16 = _with_budget("bf16_cross_silo", _bf16_cross_silo, fail_row, 0)
-    syn_rows, separated = _with_budget(
-        "synthetic11", _hard_synthetic11,
-        lambda why: ([{"skipped": why}], None), 600,
-    )
-    lda_rows, parity_row = _with_budget(
-        "femnist_lda", _hard_femnist_lda,
-        lambda why: ([{"skipped": why}], {"skipped": why}), 700,
-    )
-    eager_loop, fused_loop = _with_budget(
-        "trainloop", lambda: _trainloop_rows("bfloat16"),
-        lambda why: ({"skipped": why}, None), 240,
-    )
-    scale = _with_budget(
-        "scale", _scale_100k, lambda why: {"skipped": why}, 180,
-    )
-    scale_state = _with_budget(
-        "scale_stateful", _scale_100k_stateful,
-        lambda why: {"skipped": why}, 150,
-    )
-    fedbuff = _with_budget(
-        "fedbuff_async", _fedbuff_async, lambda why: {"skipped": why}, 300,
-    )
-    mxu = _with_budget(
-        "mxu_validation", _mxu_validation, lambda why: {"skipped": why}, 240,
+
+class _Emitter:
+    """Owns the record; every mutation atomically rewrites the detail file
+    and prints a fresh compact stdout line."""
+
+    _SECTION_SLOTS = (
+        "north_star", "north_star_bf16", "flagship_lm_bf16",
+        "north_star_eager_trainloop", "north_star_fused",
+        "bf16_cross_silo_resnet56", "flash_attention_s8192",
+        "mxu_validation", "scale_100k_clients", "scale_100k_stateful",
+        "fedbuff_async",
     )
 
-    rows = {
-        "eager_fp32": north_fp32,
-        "eager_bf16": north_bf16,
-        "trainloop_eager_bf16": eager_loop,
-        "trainloop_fused_bf16": fused_loop,
-    }
-    # ONE record dict for both outcomes — the degraded (all-throughput-
-    # failed) record must carry exactly the same completed-section evidence
-    # as the success record, so the sections live in one literal
-    record = {
-        "metric": "femnist_cnn_fedavg_rounds_per_sec",
-        "unit": "rounds/sec",
-        "sync": "host-fetch; device times via scan-slope (tunnel-proof)",
-        "mfu_note": "MFU from analytic jaxpr FLOPs (utils/flops.py); XLA cost_analysis undercounts 8-24x and is reported alongside",
-        "north_star": north_fp32,
-        "north_star_bf16": north_bf16,
-        "north_star_eager_trainloop": eager_loop,
-        "north_star_fused": fused_loop,
-        "fused_vs_eager_trainloop": (
-            round(fused_loop["rounds_per_sec"] / eager_loop["rounds_per_sec"], 3)
-            if fused_loop
-            and "rounds_per_sec" in fused_loop
-            and "rounds_per_sec" in (eager_loop or {})
-            else None
-        ),
-        "fused_note": None if not (
-            fused_loop and "rounds_per_sec" in fused_loop
-        ) else (
-            "r2's 13% fused regression (chunk-max step padding) is "
-            "eliminated: across interleaved best-of-4 passes the "
-            "fused/eager ratio measures 1.00-1.29, never below "
-            "parity (both paths are device-compute-bound at "
-            "identical shapes; the tunnel's bimodal throughput "
-            "bounds resolution above that). The fused path's 16x "
-            "fewer dispatches win outright when dispatch latency "
-            "is not hidden by an async queue."
-        ),
-        "bf16_cross_silo_resnet56": bf16,
-        "mxu_validation": mxu,
-        "scale_100k_clients": scale,
-        "scale_100k_stateful": scale_state,
-        "hard_accuracy": {
-            "synthetic11": syn_rows,
-            "algorithms_separated": separated,
-            "femnist_lda": lda_rows,
-            "bf16_parity": parity_row,
-        },
-        "data_note": "synthetic stand-ins with real dataset geometry; real downloads unavailable",
-    }
-    candidates = [
-        (k, v) for k, v in rows.items() if v and "rounds_per_sec" in v
-    ]
-    if not candidates:
-        record.update({"value": None, "error": "all throughput sections failed"})
-    else:
+    def __init__(self, t0: float, detail_path: str):
+        import threading
+
+        self.t0 = t0
+        self.detail_path = detail_path
+        self.lock = threading.Lock()
+        self.finalized = False
+        self._exit_code = 0
+        self.record = {
+            "metric": "femnist_cnn_fedavg_rounds_per_sec",
+            "unit": "rounds/sec",
+            "sync": "host-fetch; device times via scan-slope (tunnel-proof)",
+            "mfu_note": (
+                "MFU from analytic jaxpr FLOPs (utils/flops.py); XLA "
+                "cost_analysis undercounts 8-24x and is reported alongside"
+            ),
+            "data_note": (
+                "synthetic stand-ins with real dataset geometry; real "
+                "downloads unavailable"
+            ),
+            "detail_file": os.path.basename(detail_path),
+            "section_seconds": {},
+            "hard_accuracy": {
+                "synthetic11": [{"skipped": "never started"}],
+                "algorithms_separated": None,
+                "femnist_lda": [{"skipped": "never started"}],
+                "bf16_parity": {"skipped": "never started"},
+            },
+        }
+        for k in self._SECTION_SLOTS:
+            self.record[k] = {"skipped": "never started"}
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.t0
+
+    def update(self, updates: dict):
+        with self.lock:
+            self.record.update(updates)
+            self._assemble_headline()
+            self._emit(partial=True)
+
+    def finalize(self, partial: bool, why: str = "") -> int:
+        """Last emission; returns the intended exit code (nonzero iff an
+        expected-outcome pin deviated — VERDICT r4 Next #3)."""
+        with self.lock:
+            if self.finalized:
+                return self._exit_code
+            self.finalized = True
+            if why:
+                self.record["finalize_note"] = why
+            self._assemble_headline()
+            dev = _expected_deviations(self.record)
+            self.record["expected_deviations"] = dev
+            self._emit(partial=partial)
+            self._exit_code = 3 if dev else 0
+            return self._exit_code
+
+    # -- internals (call under lock) --
+    def _assemble_headline(self):
+        rec = self.record
+        rows = {
+            "eager_fp32": rec.get("north_star"),
+            "eager_bf16": rec.get("north_star_bf16"),
+            "trainloop_eager_bf16": rec.get("north_star_eager_trainloop"),
+            "trainloop_fused_bf16": rec.get("north_star_fused"),
+        }
+        candidates = [
+            (k, v) for k, v in rows.items()
+            if isinstance(v, dict) and "rounds_per_sec" in v
+        ]
+        if not candidates:
+            rec["value"] = None
+            rec["error"] = "all throughput sections failed"
+            return
+        rec.pop("error", None)
         best_name, best = max(
             candidates, key=lambda kv: kv[1]["rounds_per_sec"]
         )
         headline = best["rounds_per_sec"]
         ref_rps, ref_is_estimate, ref_how = _ref_baseline()
-        record.update(
+        rec.update(
             {
                 "value": headline,
                 "headline_config": best_name,
@@ -1050,7 +1183,400 @@ def main():
                 "baseline_how": ref_how,
             }
         )
-    print(json.dumps(record))
+
+    def _emit(self, partial: bool):
+        tmp = self.detail_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.record, f, indent=1)
+        os.replace(tmp, self.detail_path)
+        print(json.dumps(_compact_record(self.record, self.elapsed(), partial)),
+              flush=True)
+
+
+def _sec_digest(key: str, v) -> str:
+    """One short human string per section for the compact line."""
+    if not isinstance(v, dict):
+        return "?" if v is None else str(v)[:38]
+    if "skipped" in v:
+        return ("skip:" + str(v["skipped"]))[:38]
+    if "rounds_per_sec" in v and "accuracy_gate" in v:  # flagship
+        g = v["accuracy_gate"]
+        return (
+            f"mfu={v.get('mfu_device')} "
+            f"{'reach@' + str(g.get('rounds_to_target')) if g.get('reached') else 'MISS'}"
+        )
+    if "rounds_per_sec" in v:
+        return f"{v['rounds_per_sec']} r/s"
+    if "flash_over_xla_speedup" in v:
+        return f"{v['flash_over_xla_speedup']}x vs xla"
+    if "async_over_sync_update_throughput" in v:
+        return f"{v['async_over_sync_update_throughput']}x updates"
+    if "mmap_over_ram_slowdown" in v:
+        return f"mmap {v['mmap_over_ram_slowdown']}x"
+    if "spill_over_hbm_slowdown" in v:
+        return f"spill {v['spill_over_hbm_slowdown']}x"
+    if "speedup_bf16_over_fp32_device" in v:
+        return f"bf16 {v['speedup_bf16_over_fp32_device']}x dev"
+    return "ok"
+
+
+def _compact_record(rec: dict, elapsed_s: float, partial: bool) -> dict:
+    """The <1500-char stdout record: driver-contract keys + a per-section
+    digest + a pointer to the full detail file."""
+    gates = {}
+    for row in rec["hard_accuracy"]["synthetic11"] + rec["hard_accuracy"]["femnist_lda"]:
+        if "algo" in row:
+            # compress regimes WITHOUT truncating away the distinguishing
+            # suffix (alpha=0.1 vs 0.5 must stay distinct keys)
+            regime = (
+                str(row.get("regime", "?"))
+                .replace("synthetic(1,1) E=20", "syn11")
+                .replace("femnist_lda alpha=", "lda")
+            )[:16]
+            gates[f"{row['algo']}@{regime}"] = (
+                "reach" if row.get("reached") else "miss"
+            )
+    out = {
+        "metric": rec["metric"],
+        "value": rec.get("value"),
+        "unit": rec["unit"],
+        "vs_baseline": rec.get("vs_baseline"),
+        "headline_config": rec.get("headline_config"),
+        "baseline_rounds_per_sec": rec.get("baseline_rounds_per_sec"),
+        "partial": partial,
+        "elapsed_s": round(elapsed_s),
+        "sections": {
+            k: _sec_digest(k, rec.get(k)) for k in _Emitter._SECTION_SLOTS
+        },
+        "hard_gates": gates or "never started",
+        "separated": rec["hard_accuracy"].get("algorithms_separated"),
+        "expected_deviations": rec.get("expected_deviations", "pending"),
+        "detail": rec["detail_file"],
+    }
+    if "error" in rec:
+        out["error"] = rec["error"]
+    if "error_backend" in rec:
+        out["error_backend"] = rec["error_backend"][:300]
+    if "finalize_note" in rec:
+        out["finalize_note"] = rec["finalize_note"]
+    # hard ceiling: the driver parses the last line out of a ~2000-char
+    # tail — degrade the digest before ever risking the whole record
+    if len(json.dumps(out)) > 1800:
+        out["sections"] = {
+            "completed": sum(
+                1 for k in _Emitter._SECTION_SLOTS
+                if isinstance(rec.get(k), dict) and "skipped" not in rec[k]
+            ),
+            "total": len(_Emitter._SECTION_SLOTS),
+        }
+    return out
+
+
+def _expected_deviations(rec: dict) -> list:
+    """Compare every pinned expectation against the outcome. A deviation
+    in EITHER direction is loud: a surprise reach means the pin (and the
+    claim it encodes) is stale, a surprise miss is a regression."""
+    dev = []
+    for row in rec["hard_accuracy"]["synthetic11"] + rec["hard_accuracy"]["femnist_lda"]:
+        if "expected" in row and "reached" in row:
+            got = "reach" if row["reached"] else "miss"
+            if got != row["expected"]:
+                dev.append(
+                    f"{row.get('regime')}/{row.get('algo')}: "
+                    f"expected {row['expected']}, got {got}"
+                )
+    sep = rec["hard_accuracy"].get("algorithms_separated")
+    if sep is False:  # None => section never ran (not a deviation)
+        dev.append("synthetic11: algorithms not separated (expected True)")
+    par = rec["hard_accuracy"].get("bf16_parity")
+    if isinstance(par, dict) and "parity_on_rising_curve" in par:
+        if not par["parity_on_rising_curve"]:
+            dev.append("bf16_parity: expected parity on rising curve")
+    flag = rec.get("flagship_lm_bf16")
+    if isinstance(flag, dict) and "accuracy_gate" in flag:
+        if not flag["accuracy_gate"].get("reached"):
+            dev.append("flagship_lm_bf16: accuracy gate expected reach, missed")
+        if not flag.get("mfu_ok"):
+            dev.append(
+                f"flagship_lm_bf16: device MFU {flag.get('mfu_device')} "
+                f"below the 0.35 floor"
+            )
+    fl = rec.get("flash_attention_s8192")
+    if isinstance(fl, dict) and "flash_over_xla_speedup" in fl:
+        if fl["flash_over_xla_speedup"] < fl["expected_speedup_at_least"]:
+            dev.append(
+                f"flash_attention: {fl['flash_over_xla_speedup']}x below "
+                f"the pinned {fl['expected_speedup_at_least']}x floor"
+            )
+    return dev
+
+
+def main():
+    import signal
+    import sys
+    import threading
+
+    t0 = time.perf_counter()  # the probe below counts against the budget
+    budget_s = float(os.environ.get("FEDML_TPU_BENCH_BUDGET_S", 2100))
+    tiny = os.environ.get("FEDML_TPU_BENCH_TINY") == "1"
+    detail_path = os.environ.get(
+        "FEDML_TPU_BENCH_DETAIL",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+        ),
+    )
+    emitter = _Emitter(t0, detail_path)
+
+    # --- the three kill-proofing layers (module comment above) ---
+    def _finalize_and_exit(why):
+        code = emitter.finalize(partial=True, why=why)
+        os._exit(code)
+
+    def _signal_finalize(why):
+        """Signal handlers must NOT finalize on the main thread: the
+        handler interrupts arbitrary code — possibly inside emitter.lock
+        (self-deadlock on the non-reentrant lock) or inside print()
+        (reentrant BufferedWriter RuntimeError). A fresh thread serializes
+        with the interrupted emission through the lock instead."""
+        import threading as _t
+
+        _t.Thread(target=_finalize_and_exit, args=(why,), daemon=True).start()
+        # if the main thread was idle this returns instantly; the exit
+        # happens on the helper thread either way
+
+    # 0.92 leaves ~8% of the budget for the driver to harvest the output
+    # before ITS timeout; tests override the fraction to pin behaviors
+    # without real-length budgets
+    wd_frac = float(os.environ.get("FEDML_TPU_BENCH_WATCHDOG_FRAC", 0.92))
+    watchdog = threading.Timer(
+        budget_s * wd_frac, _finalize_and_exit,
+        args=(f"watchdog: {wd_frac:.0%} of budget",),
+    )
+    watchdog.daemon = True
+    watchdog.start()
+    signal.signal(signal.SIGTERM, lambda *_: _signal_finalize("SIGTERM"))
+    signal.signal(signal.SIGINT, lambda *_: _signal_finalize("SIGINT"))
+    signal.signal(
+        signal.SIGALRM, lambda *_: (_ for _ in ()).throw(_SectionTimeout())
+    )
+    emitter.update({})  # first heartbeat: a parseable line exists from t~0
+
+    alive, why = _backend_alive(timeout_s=240.0 if not tiny else 60.0)
+    if not alive:
+        emitter.update(
+            {
+                "error_backend": (
+                    f"no measurements possible this run: {why}. Last "
+                    "recorded full pass: BENCH_r03.json tail / "
+                    "docs/PERF_R5.md."
+                )
+            }
+        )
+        watchdog.cancel()
+        sys.exit(emitter.finalize(partial=False, why="backend dead"))
+
+    import jax  # noqa: F401 — device init after the probe said it's safe
+
+    # a skipped/failed section must stamp the SAME record slots its body
+    # would have filled — the degraded record self-describes per slot
+    slot_map = {
+        "trainloop": ("north_star_eager_trainloop", "north_star_fused"),
+        "bf16_cross_silo": ("bf16_cross_silo_resnet56",),
+        "flash_attention": ("flash_attention_s8192",),
+        "scale": ("scale_100k_clients",),
+        "scale_stateful": ("scale_100k_stateful",),
+        "sleeper": ("north_star_bf16",),
+    }
+
+    def _section_done(name):
+        """True iff the section's real result is already in the record —
+        a late alarm/exception (after fn()'s final emit, before
+        run_section regains control) must not overwrite measurements
+        with a skip row."""
+        ha = emitter.record["hard_accuracy"]
+        if name == "synthetic11":
+            return any("algo" in r for r in ha["synthetic11"])
+        if name == "femnist_lda":
+            return any("algo" in r for r in ha["femnist_lda"])
+        # any-slot: a section that filled one slot then died keeps that
+        # evidence rather than having it clobbered by a skip row
+        slots = slot_map.get(name, (name,))
+        return any(
+            isinstance(emitter.record.get(s), dict)
+            and "skipped" not in emitter.record[s]
+            for s in slots
+        )
+
+    def _fallbacked(name, why):
+        if name == "synthetic11":
+            return {"hard_accuracy": {
+                **emitter.record["hard_accuracy"],
+                "synthetic11": [{"skipped": why}],
+                "algorithms_separated": None,
+            }}
+        if name == "femnist_lda":
+            return {"hard_accuracy": {
+                **emitter.record["hard_accuracy"],
+                "femnist_lda": [{"skipped": why}],
+                "bf16_parity": {"skipped": why},
+            }}
+        return {s: {"skipped": why} for s in slot_map.get(name, (name,))}
+
+    def run_section(name, fn, est_s, max_s, retry=True):
+        """Budget gate + SIGALRM wall cap + failure isolation. A section
+        that raises gets ONE retry (observed transient tunnel errors);
+        a section that trips its wall cap does NOT retry (a hang that ate
+        max_s once will eat it again). Every outcome lands in the record
+        via emitter.update inside ``fn`` or the fallback here."""
+        if emitter.elapsed() > budget_s * 0.85 - est_s:
+            emitter.update(_fallbacked(name, (
+                f"{round(emitter.elapsed())}s elapsed of "
+                f"{round(budget_s)}s budget; section needs ~{est_s}s"
+            )))
+            return
+        attempts = 2 if retry else 1
+        for attempt in range(1, attempts + 1):
+            # the timer is disarmed BEFORE any fallback bookkeeping runs —
+            # a late alarm raising inside the except-branch would escape
+            # run_section and kill the whole pass
+            err = timed_out = None
+            signal.setitimer(signal.ITIMER_REAL, max_s)
+            try:
+                fn()
+                return
+            except _SectionTimeout:
+                timed_out = True
+            except Exception as e:  # noqa: BLE001 — record, don't die
+                err = f"{type(e).__name__}: {str(e)[:300]}"
+            finally:
+                signal.setitimer(signal.ITIMER_REAL, 0)
+            if _section_done(name):
+                return  # fn() recorded its result before the late signal
+            if timed_out:
+                emitter.update(
+                    _fallbacked(name, f"hit its {max_s}s wall cap")
+                )
+                return
+            if attempt == attempts or emitter.elapsed() > budget_s * 0.85:
+                emitter.update(_fallbacked(
+                    name, f"failed (attempt {attempt}): {err}"
+                ))
+                return
+
+    # --- section bodies: each writes its own slot via emitter.update ---
+    def s_north_fp32():
+        row = _throughput_row(_north_star_api("float32"), 3, 40, "north_star")
+        emitter.update({"north_star": row})
+
+    def s_north_bf16():
+        row = _throughput_row(_north_star_api("bfloat16"), 3, 40, "north_star")
+        emitter.update({"north_star_bf16": row})
+
+    def s_flagship():
+        emitter.update({"flagship_lm_bf16": _flagship_bf16()})
+
+    def s_synthetic11():
+        syn_rows, separated = _hard_synthetic11()
+        emitter.update({"hard_accuracy": {
+            **emitter.record["hard_accuracy"],
+            "synthetic11": syn_rows, "algorithms_separated": separated,
+        }})
+
+    def s_femnist_lda():
+        lda_rows, parity_row = _hard_femnist_lda()
+        emitter.update({"hard_accuracy": {
+            **emitter.record["hard_accuracy"],
+            "femnist_lda": lda_rows, "bf16_parity": parity_row,
+        }})
+
+    def s_trainloop():
+        eager_loop, fused_loop = _trainloop_rows("bfloat16")
+        updates = {
+            "north_star_eager_trainloop": eager_loop,
+            "north_star_fused": fused_loop,
+            "fused_vs_eager_trainloop": (
+                round(
+                    fused_loop["rounds_per_sec"] / eager_loop["rounds_per_sec"],
+                    3,
+                )
+                if fused_loop
+                and "rounds_per_sec" in fused_loop
+                and "rounds_per_sec" in (eager_loop or {})
+                else None
+            ),
+        }
+        updates["fused_note"] = None if not (
+            fused_loop and "rounds_per_sec" in fused_loop
+        ) else (
+            "r2's 13% fused regression (chunk-max step padding) is "
+            "eliminated: across interleaved best-of passes the fused/eager "
+            "ratio measures 1.00-1.29, never below parity (both paths are "
+            "device-compute-bound at identical shapes; the tunnel's "
+            "bimodal throughput bounds resolution above that)."
+        )
+        emitter.update(updates)
+
+    def s_bf16_cross_silo():
+        emitter.update({"bf16_cross_silo_resnet56": _bf16_cross_silo()})
+
+    def s_flash():
+        emitter.update({"flash_attention_s8192": _flash_attention_row()})
+
+    def s_fedbuff():
+        emitter.update({"fedbuff_async": _fedbuff_async()})
+
+    def s_scale():
+        emitter.update({"scale_100k_clients": _scale_100k()})
+
+    def s_scale_state():
+        emitter.update({"scale_100k_stateful": _scale_100k_stateful()})
+
+    def s_mxu():
+        emitter.update({"mxu_validation": _mxu_validation()})
+
+    if tiny:
+        # CI mode (tests/test_bench_resilience.py): a fast real section,
+        # then a sleeper the kill-test murders mid-flight. Proves the
+        # incremental record survives SIGKILL with zero TPU time.
+        def s_tiny():
+            row = _throughput_row(_north_star_api("float32"), 1, 2, "north_star")
+            emitter.update({"north_star": row})
+
+        def s_sleep():
+            time.sleep(float(os.environ.get("FEDML_TPU_BENCH_TINY_SLEEP", 120)))
+            emitter.update({"north_star_bf16": {"skipped": "tiny mode"}})
+
+        sections = [
+            ("north_star", s_tiny, 0, 300),
+            ("sleeper", s_sleep, 0, 300),
+        ]
+    else:
+        # Order = judge priority. est_s gates section START against 85% of
+        # the budget; max_s is the SIGALRM wall cap. Measured section costs
+        # land in section_seconds for the next re-budget.
+        sections = [
+            ("north_star", s_north_fp32, 0, 420),
+            ("north_star_bf16", s_north_bf16, 0, 300),
+            ("flagship_lm_bf16", s_flagship, 240, 480),
+            ("synthetic11", s_synthetic11, 300, 600),
+            ("femnist_lda", s_femnist_lda, 500, 800),
+            ("trainloop", s_trainloop, 200, 360),
+            ("bf16_cross_silo", s_bf16_cross_silo, 200, 360),
+            ("flash_attention", s_flash, 120, 300),
+            ("fedbuff_async", s_fedbuff, 180, 360),
+            ("scale", s_scale, 150, 300),
+            ("scale_stateful", s_scale_state, 150, 300),
+            ("mxu_validation", s_mxu, 120, 300),
+        ]
+    prev = time.perf_counter()
+    for name, fn, est_s, max_s in sections:
+        run_section(name, fn, est_s, max_s)
+        now = time.perf_counter()
+        with emitter.lock:
+            emitter.record["section_seconds"][name] = round(now - prev, 1)
+        prev = now
+    watchdog.cancel()
+    sys.exit(emitter.finalize(partial=False))
 
 
 if __name__ == "__main__":

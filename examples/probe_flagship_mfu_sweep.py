@@ -1,0 +1,39 @@
+"""Flagship MFU sweep: find the (batch, vocab, width) that clears the
+0.35 device-MFU floor with margin on the production FedAvg round."""
+import json
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_shakespeare
+from fedml_tpu.models import create_model
+
+import bench
+
+for batch, vocab, embed, layers in (
+    (32, 1024, 512, 4),
+    (64, 1024, 512, 4),
+    (32, 4096, 512, 4),
+    (32, 1024, 768, 6),
+):
+    data = synthetic_shakespeare(
+        num_clients=8, samples_per_client=512, seq_len=256, vocab_size=vocab,
+        seed=0, seq_targets=True,
+    )
+    model = create_model(
+        "transformer", "shakespeare_synth", (256,), vocab,
+        num_layers=layers, num_heads=8, embed_dim=embed,
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=batch, pad_bucket=1),
+        fed=FedConfig(client_num_in_total=8, client_num_per_round=8,
+                      comm_round=4, epochs=1, frequency_of_the_test=10_000),
+        train=TrainConfig(client_optimizer="adam", lr=1e-3,
+                          compute_dtype="bfloat16"),
+        seed=0,
+    )
+    api = FedAvgAPI(cfg, data, model, task="nwp")
+    row = bench._throughput_row(api, warmup=1, timed=2, label=f"b{batch}_v{vocab}_d{embed}_L{layers}")
+    print(json.dumps({k: row[k] for k in ("label", "rounds_per_sec", "round_ms_device", "mfu_device", "mfu_wall")}), flush=True)

@@ -243,6 +243,14 @@ class DPFedAvgAPI(FedAvgAPI):
                     f"(got {self._sample_secret}); SeedSequence rejects "
                     "negative entropy"
                 )
+            if self._sample_secret.bit_length() > 256:
+                # checkpoint_state serializes the secret into 8 uint32
+                # words — reject at construction, not mid-run at the
+                # first checkpoint
+                raise ValueError(
+                    "DpConfig.sample_secret wider than 256 bits cannot be "
+                    "checkpointed; 128 bits is already full strength"
+                )
             self._secret_provenance = (
                 f"explicit DpConfig.sample_secret "
                 f"({self._sample_secret.bit_length()} bits — amplification "

@@ -435,6 +435,8 @@ def test_dp_secret_validation_and_legacy_checkpoint_warning():
     data, model = _data_model()
     with pytest.raises(ValueError, match="non-negative"):
         DPFedAvgAPI(_cfg(), data, model, dp=DpConfig(sample_secret=-1))
+    with pytest.raises(ValueError, match="256 bits"):
+        DPFedAvgAPI(_cfg(), data, model, dp=DpConfig(sample_secret=1 << 300))
     # a legacy checkpoint (no dp_sample_secret) resumes with a loud
     # warning that the participation stream forks here
     api = DPFedAvgAPI(_cfg(), data, model, dp=DpConfig(sample_secret=_TEST_SECRET))
