@@ -571,6 +571,18 @@ class FedAvgAPI:
             fn, self.global_vars, *self._place_batch(batch, rng)
         )
 
+    def _spill_pad_ids(self, sampled):
+        """(store-gather ids, real count) for the stateful algorithms'
+        SPILLED state tier. Defined on the common root so the mesh
+        runtime's override (DistributedFedAvgAPI: pad to the shard count,
+        dummy id 0) wins in every Distributed* MRO."""
+        return np.asarray(sampled, np.int64), len(sampled)
+
+    def _place_cohort_rows(self, rows):
+        """Spilled-store cohort rows -> device (mesh override shards them
+        over the client axis)."""
+        return jax.tree_util.tree_map(jnp.asarray, rows)
+
     def _place_batch(self, batch, round_rng):
         """Device placement hook — the sharded subclass pads the client axis
         to the mesh and shards these arrays over it."""

@@ -168,6 +168,81 @@ class MmapClientState:
         return int(np.count_nonzero(self._init_mask))
 
 
+class CohortPrefetcher:
+    """Overlap the next round's cohort gather (disk read) with the current
+    round's device compute.
+
+    Correctness contract: rows the caller is about to scatter THIS round
+    must be passed in ``exclude`` — the background thread never reads
+    them, and :meth:`take` re-fetches them synchronously after the scatter
+    has landed, so a prefetched cohort can never contain torn or stale
+    rows. A take() whose (round, ids) doesn't match the pending prefetch
+    falls back to a plain synchronous gather."""
+
+    def __init__(self, store: MmapClientState):
+        self.store = store
+        self._pending = None  # (round_idx, ids_bytes, safe_mask, result)
+        self._thread = None
+
+    def launch(self, round_idx: int, ids, exclude=()) -> None:
+        import threading
+
+        self.cancel()
+        ids = np.asarray(ids, np.int64)
+        excl = set(int(i) for i in exclude)
+        safe_mask = np.fromiter(
+            (int(i) not in excl for i in ids), bool, count=len(ids)
+        )
+        safe_ids = ids[safe_mask]
+        result = {}
+
+        def work():
+            try:
+                result["rows"] = self.store.gather(safe_ids)
+            except Exception as e:  # noqa: BLE001 — surface at take()
+                result["err"] = e
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        self._pending = (int(round_idx), ids.tobytes(), safe_mask, result)
+        self._thread = t
+
+    def take(self, round_idx: int, ids):
+        ids = np.asarray(ids, np.int64)
+        if (
+            self._pending is None
+            or self._pending[0] != int(round_idx)
+            or self._pending[1] != ids.tobytes()
+        ):
+            self.cancel()
+            return self.store.gather(ids)
+        _, _, safe_mask, result = self._pending
+        self._thread.join()
+        self._pending, self._thread = None, None
+        if "rows" not in result:
+            # the background gather died (disk error, dir removed): retry
+            # synchronously — a persistent failure re-raises HERE with the
+            # true error, attributed to the caller's round
+            return self.store.gather(ids)
+        pre = result["rows"]
+        if safe_mask.all():
+            return pre
+        missing = self.store.gather(ids[~safe_mask])
+
+        def merge(p, m):
+            out = np.empty((len(ids),) + p.shape[1:], p.dtype)
+            out[safe_mask] = p
+            out[~safe_mask] = m
+            return out
+
+        return jax.tree_util.tree_map(merge, pre, missing)
+
+    def cancel(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+        self._pending, self._thread = None, None
+
+
 def resolve_state_store(
     config_fed, state_bytes: int
 ) -> str:

@@ -180,12 +180,24 @@ class DistributedFedAvgAPI(FedAvgAPI):
         but train on all-zero masks, so their state deltas are EXACT zeros
         (the local-train step where-gates its whole update on has_data;
         pinned by tests) and the scatter-add ignores them."""
+        ids, _ = self._spill_pad_ids(sampled)
+        return jax.device_put(ids.astype(np.int32), self._data_sharding)
+
+    def _spill_pad_ids(self, sampled):
+        """(host ids padded to the shard count, real count) — ONE place
+        owns the pad-to-mesh/dummy-id-0 contract, shared by the in-HBM
+        index vector above and the spilled-store host gather/scatter
+        (only the real prefix is ever scattered back)."""
         n = len(sampled)
-        rem = n % self.n_shards
-        padded = n + (self.n_shards - rem if rem else 0)
-        idx = np.zeros((padded,), np.int32)
-        idx[:n] = np.asarray(sampled, np.int32)
-        return jax.device_put(idx, self._data_sharding)
+        pad = (self.n_shards - n % self.n_shards) % self.n_shards
+        ids = np.zeros((n + pad,), np.int64)
+        ids[:n] = np.asarray(sampled, np.int64)
+        return ids, n
+
+    def _place_cohort_rows(self, rows):
+        """Spilled-store cohort rows -> device, sharded over the client
+        axis (stateful-algorithm spill x mesh composition)."""
+        return jax.device_put(rows, self._data_sharding)
 
     def _place_batch(self, batch: ClientBatch, round_rng):
         """Pad the client axis to the mesh size and shard everything over it.
@@ -330,8 +342,18 @@ class DistributedScaffoldAPI(ScaffoldAPI, DistributedFedAvgAPI):
             self.model, self.config, self.mesh, task=self.task
         )
 
+    def _build_scaffold_cohort_round(self):
+        from fedml_tpu.algorithms.scaffold import (
+            make_sharded_scaffold_cohort_round,
+        )
+
+        return make_sharded_scaffold_cohort_round(
+            self.model, self.config, self.mesh, task=self.task
+        )
+
     def _place_client_indices(self, sampled):
         return self._pad_shard_indices(sampled)
+
 
 
 class DistributedDittoAPI(DittoAPI, DistributedFedAvgAPI):
@@ -351,8 +373,16 @@ class DistributedDittoAPI(DittoAPI, DistributedFedAvgAPI):
             donate=self._donate,
         )
 
+    def _build_ditto_cohort_round(self):
+        from fedml_tpu.algorithms.ditto import make_sharded_ditto_cohort_round
+
+        return make_sharded_ditto_cohort_round(
+            self.model, self.config, self.mesh, self.lam, task=self.task
+        )
+
     def _place_client_indices(self, sampled):
         return self._pad_shard_indices(sampled)
+
 
 
 class DistributedFedOptAPI(FedOptAPI, DistributedFedAvgAPI):
