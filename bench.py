@@ -748,7 +748,7 @@ def _scale_100k_stateful(num_clients=100_000, timed_rounds=15):
     }
 
 
-def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=5, async_steps=15):
+def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=6, async_steps=18):
     """Async (FedBuff) vs sync (barrier) under compute heterogeneity —
     VERDICT r3 Next #3: async's pitch, quantified. Both arms run as REAL
     OS processes over gRPC on localhost (1 server + ``workers`` workers;
@@ -772,24 +772,46 @@ def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=5, async_steps=15):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    # persistent compile cache (same dir the test conftest uses): ten
+    # cold per-process CNN compiles under host contention were the
+    # section's real cost — with the cache only the first arm's first
+    # process pays it
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/fedml_tpu_jax_cache")
+
+    import tempfile
 
     def run_arm(algo, comm_round, port, extra):
+        # synthetic+LR, homogeneous shards: ONE tiny XLA compile per
+        # process. The earlier femnist-CNN arms never fit any budget —
+        # each ragged shape class cost a 40-90 s conv compile in every
+        # one of the 5 contended CPU subprocesses (the r4 'never
+        # executed' root cause). The section's subject is PROTOCOL
+        # behavior under heterogeneity — with ~ms train steps the
+        # injected 800 ms straggle IS the heterogeneity, undiluted.
         base = [
             sys.executable, "-m", "fedml_tpu",
             "--algorithm", algo, "--runtime", "grpc",
-            "--dataset", "femnist_synth", "--model", "cnn",
+            "--dataset", "synthetic", "--model", "lr",
             "--client_num_in_total", "128",
             "--client_num_per_round", str(workers),
             "--comm_round", str(comm_round),
-            "--batch_size", "20", "--lr", "0.1", "--seed", "0",
+            "--batch_size", "8", "--lr", "0.02", "--seed", "0",
+            "--partition_alpha", "0.3",
             "--frequency_of_the_test", "3",
             "--base_port", str(port),
         ] + extra
+        # per-row metrics go to the SERVER's metrics.jsonl (MetricsLogger
+        # only writes rows to --log_dir; stdout carries just the final
+        # summary — the r4 section parsed stdout and therefore could
+        # never have seen its staleness/t_s rows)
+        log_dir = tempfile.mkdtemp(prefix=f"fedml_tpu_fb_{algo}_")
         procs = []
         for rank in list(range(1, workers + 1)) + [0]:
             cmd = base + ["--rank", str(rank)]
             if rank == workers:  # one straggler
                 cmd += ["--straggle_ms", str(straggle_ms)]
+            if rank == 0:
+                cmd += ["--log_dir", log_dir]
             procs.append(
                 subprocess.Popen(
                     cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -797,15 +819,13 @@ def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=5, async_steps=15):
                     cwd=os.path.dirname(os.path.abspath(__file__)),
                 )
             )
-        outs = []
         try:
             for p in procs:
                 # r4's 420 s/process ceiling made the section's worst case
                 # exceed its own 300 s budget estimate (VERDICT r4 Weak
-                # #3); the shrunk arms (5 sync rounds / 15 async steps,
-                # 800 ms straggle) finish in ~30-60 s — 150 s is generous
-                out, _ = p.communicate(timeout=150)
-                outs.append(out)
+                # #3); the LR arms finish in well under a minute — 180 s
+                # is generous
+                out, _ = p.communicate(timeout=180)
                 if p.returncode != 0:
                     raise RuntimeError(
                         f"{algo} arm rank exited {p.returncode}: {out[-800:]}"
@@ -814,12 +834,13 @@ def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=5, async_steps=15):
             for p in procs:
                 if p.poll() is None:
                     p.kill()
-        rows = [
-            json.loads(l)
-            for l in outs[-1].splitlines()
-            if l.startswith("{")
-        ]
-        return rows
+        try:
+            with open(os.path.join(log_dir, "metrics.jsonl")) as f:
+                return [json.loads(l) for l in f if l.strip()]
+        finally:
+            import shutil
+
+            shutil.rmtree(log_dir, ignore_errors=True)
 
     sync_rows = run_arm("fedavg", sync_rounds, 9410, [])
     sync_t = max(r.get("t_s", 0.0) for r in sync_rows)
@@ -838,8 +859,9 @@ def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=5, async_steps=15):
     return {
         "setup": (
             f"{workers} gRPC worker processes, one straggling "
-            f"{straggle_ms:.0f} ms/train; femnist-synth CNN (north-star "
-            "workload); CPU subprocesses (protocol benchmark)"
+            f"{straggle_ms:.0f} ms/train; synthetic LR (ms train steps — "
+            "the injected straggle IS the heterogeneity); CPU "
+            "subprocesses (protocol benchmark, not a chip benchmark)"
         ),
         "sync": {
             "rounds": sync_rounds,
@@ -861,6 +883,14 @@ def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=5, async_steps=15):
         },
         "async_over_sync_update_throughput": round(
             updates_async / updates_sync, 2
+        ),
+        "acc_note": (
+            "LR-on-synthetic saturates to 1.0 within both arms' horizons, "
+            "so the matched-wall accuracy race is a tie at ceiling; the "
+            "section's currency is client-updates/sec under a straggler — "
+            "the sync arm's barrier waits for the straggler every round "
+            "(the reference's semantics, FedAVGAggregator.py:43-49), "
+            "FedBuff's k-of-n buffer does not"
         ),
     }
 
@@ -983,10 +1013,6 @@ def _flash_attention_row(S=8192, H=8, D=64, cycles=4):
     dev_s = profiling.scan_slope_seconds(
         lambda qq: fns["flash"](qq, k, v)[0], q, k1=1, k2=3
     )
-    # causal attention fwd+bwd FLOPs: fwd 2 matmuls ~ 4*H*S^2*D/2 (causal
-    # half), bwd ~ 2x fwd, + the VJP's blockwise P recompute (~1x fwd's
-    # first matmul) — the standard flash-attn2 accounting
-    flops = 3.5 * 4 * H * S * S * D / 2
     return {
         "seq_len": S,
         "heads": H,
@@ -997,8 +1023,11 @@ def _flash_attention_row(S=8192, H=8, D=64, cycles=4):
         "xla_ms_wall": round(best["xla"] * 1e3, 1),
         "flash_ms_device": round(dev_s * 1e3, 1),
         "flash_over_xla_speedup": round(best["xla"] / best["flash"], 2),
-        "flash_mfu_device": round(
-            profiling.mfu(flops, 1.0 / dev_s, "bfloat16") or 0, 4
+        "win_mechanism": (
+            "reverse-mode AD of plain attention saves the S x S "
+            "probabilities as a residual (H*S^2*2 bytes = 1.1 GB here); "
+            "the kernel's custom VJP recomputes P blockwise — the win is "
+            "HBM traffic, so MFU is not the currency of this row"
         ),
         "timing": f"interleaved best-of-{cycles}; ratio is the signal",
         # the PIN (not derived from this run): the kernel must beat plain
@@ -1570,7 +1599,7 @@ def main():
             ("trainloop", s_trainloop, 200, 360),
             ("bf16_cross_silo", s_bf16_cross_silo, 200, 360),
             ("flash_attention", s_flash, 120, 300),
-            ("fedbuff_async", s_fedbuff, 180, 360),
+            ("fedbuff_async", s_fedbuff, 100, 240),
             ("scale", s_scale, 150, 300),
             ("scale_stateful", s_scale_state, 150, 300),
             ("mxu_validation", s_mxu, 120, 300),

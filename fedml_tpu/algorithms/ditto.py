@@ -472,6 +472,11 @@ class DittoAPI(FedAvgAPI):
                 *self._place_batch(batch, rng),
             )
             return sampled, metrics
+        # NOTE: this take/launch/device_get/scatter choreography is the
+        # same contract as ScaffoldAPI.train_round's spilled path (exclude
+        # this round's ids from the background read; scatter only
+        # rows[:n_real]) — tests/test_state_spill.py pins both against
+        # their in-HBM twins, so a divergence fails loudly
         ids, n_real = self._spill_pad_ids(sampled)
         v_rows = self._place_cohort_rows(self._v_prefetch.take(round_idx, ids))
         self.global_vars, new_rows, metrics = self._ditto_round(

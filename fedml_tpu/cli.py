@@ -72,6 +72,10 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--client_num_in_total", type=int, default=10)
 @click.option("--client_num_per_round", type=int, default=10)
 @click.option("--batch_size", type=int, default=32, help="-1 = full batch")
+@click.option("--pad_bucket", type=int, default=1,
+              help="round per-client step counts up to multiples of this "
+                   "(shape-class bucketing: fewer XLA compiles on ragged "
+                   "shards at the cost of a little padded compute)")
 @click.option("--client_optimizer", type=click.Choice(("sgd", "adam")), default="sgd")
 @click.option("--lr", type=float, default=0.03)
 @click.option("--wd", type=float, default=0.0)
@@ -252,6 +256,7 @@ def build_config(opt) -> RunConfig:
             partition_method=opt["partition_method"],
             partition_alpha=opt["partition_alpha"],
             batch_size=opt["batch_size"],
+            pad_bucket=opt["pad_bucket"],
             device_cache=not opt.get("no_device_cache", False),
         ),
         fed=FedConfig(
