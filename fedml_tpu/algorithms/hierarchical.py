@@ -58,8 +58,63 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
         )
         self._avg = jax.jit(weighted_average)
 
+    def _group_round(self, round_idx: int, gi: int, members, sampled_set):
+        """One group's ``group_comm_round`` sub-rounds from the current
+        global model: ``(w_group | None, weight, metrics | None)``. THE
+        group-level math, shared by the in-process loop below and the
+        cross-process gRPC bridge (parallel/hierarchical_bridge.py) so an
+        edge-server process computes exactly what the simulator computes
+        for its group — their equality is a test contract
+        (tests/test_multihost_bridge.py)."""
+        cfg = self.config
+        g_clients = [int(c) for c in members if int(c) in sampled_set]
+        if not g_clients:
+            return None, 0, None
+        w_group = self.global_vars
+        metrics_acc = None
+        for sub in range(cfg.fed.group_comm_round):
+            batch = self._stack(
+                g_clients,
+                cfg.seed * 1_000_003 + round_idx * 131 + gi * 17 + sub,
+            )
+            rng = jax.random.fold_in(
+                self.rng, (round_idx + 1) * 1009 + gi * 31 + sub
+            )
+            w_group, m = self.round_fn(
+                w_group, *self._place_batch(batch, rng)
+            )
+            metrics_acc = (
+                m
+                if metrics_acc is None
+                else jax.tree_util.tree_map(
+                    lambda a, b: a + b, metrics_acc, m
+                )
+            )
+        weight = sum(len(self.data.client_y[c]) for c in g_clients)
+        return w_group, weight, metrics_acc
+
+    def _cloud_average(self, group_vars, group_weights):
+        """Cloud step: weighted average of group models; an all-empty
+        round (every group missed the cohort — possible with explicit
+        partial ``groups``) keeps the current global model. THE cloud
+        math, shared with the cross-process bridge
+        (parallel/hierarchical_bridge.py) like :meth:`_group_round` —
+        bridge == simulator is an equality contract."""
+        if not group_vars:
+            return self.global_vars
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jax.numpy.stack(
+                [jax.numpy.asarray(l) for l in leaves]
+            ),
+            *group_vars,
+        )
+        return self._avg(
+            stacked,
+            jax.numpy.asarray(group_weights, dtype=jax.numpy.float32),
+        )
+
     def train_round(self, round_idx: int):
-        from fedml_tpu.algorithms.fedavg import client_sampling, round_client_rngs
+        from fedml_tpu.algorithms.fedavg import client_sampling
 
         cfg = self.config
         sampled = client_sampling(
@@ -67,38 +122,18 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
         )
         sampled_set = set(int(i) for i in sampled)
         group_vars, group_weights, metrics_acc = [], [], None
-        w_global = self.global_vars
         for gi, members in enumerate(self.groups):
-            g_clients = [int(c) for c in members if int(c) in sampled_set]
-            if not g_clients:
-                continue
-            w_group = w_global
-            for sub in range(cfg.fed.group_comm_round):
-                batch = self._stack(
-                    g_clients,
-                    cfg.seed * 1_000_003 + round_idx * 131 + gi * 17 + sub,
-                )
-                rng = jax.random.fold_in(
-                    self.rng, (round_idx + 1) * 1009 + gi * 31 + sub
-                )
-                w_group, m = self.round_fn(
-                    w_group, *self._place_batch(batch, rng)
-                )
-                metrics_acc = (
-                    m
-                    if metrics_acc is None
-                    else jax.tree_util.tree_map(
-                        lambda a, b: a + b, metrics_acc, m
-                    )
-                )
-            group_vars.append(w_group)
-            group_weights.append(
-                sum(len(self.data.client_y[c]) for c in g_clients)
+            w_group, weight, m = self._group_round(
+                round_idx, gi, members, sampled_set
             )
-        stacked = jax.tree_util.tree_map(
-            lambda *leaves: jax.numpy.stack(leaves), *group_vars
-        )
-        self.global_vars = self._avg(
-            stacked, jax.numpy.asarray(group_weights, dtype=jax.numpy.float32)
-        )
+            if w_group is None:
+                continue
+            group_vars.append(w_group)
+            group_weights.append(weight)
+            metrics_acc = (
+                m
+                if metrics_acc is None
+                else jax.tree_util.tree_map(lambda a, b: a + b, metrics_acc, m)
+            )
+        self.global_vars = self._cloud_average(group_vars, group_weights)
         return sampled, metrics_acc
