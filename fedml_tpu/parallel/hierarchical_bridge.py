@@ -25,7 +25,7 @@ Protocol (per global round r):
   every rank g computes its group's ``group_comm_round`` sub-rounds via
   HierarchicalFedAvgAPI._group_round — the SAME method the in-process
   simulator runs, so bridged == simulated is an equality, not an analogy;
-  rank g>0 sends (leaves, weight, r) to rank 0; rank 0 stacks its own and
+  rank g>0 sends (model, weight, r) to rank 0; rank 0 stacks its own and
   all received group models, weighted-averages (groups with no sampled
   members contribute weight 0 and no model), and broadcasts the new
   global. Messages ride the binary envelope (core/message.py — dtype
@@ -59,13 +59,11 @@ class _Inbox(Observer):
         self.q.put(msg)
 
 
-def _leaves(tree):
-    return [np.asarray(l) for l in jax.tree_util.tree_leaves(jax.device_get(tree))]
-
-
-def _unleaves(template, leaves):
-    treedef = jax.tree_util.tree_structure(template)
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+def _host_tree(tree):
+    """Device pytree -> host pytree; the Message envelope serializes param
+    pytrees directly (dtype-exact), same as fedavg_transport's model
+    broadcasts — no hand-rolled flatten/unflatten layer."""
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
 
 
 def run_hierarchical_grpc_group(
@@ -99,7 +97,14 @@ def run_hierarchical_grpc_group(
 
     def recv(expect_type: str, expect_round: int) -> Message:
         while True:
-            msg = inbox.q.get(timeout=recv_timeout_s)
+            try:
+                msg = inbox.q.get(timeout=recv_timeout_s)
+            except queue.Empty:
+                raise RuntimeError(
+                    f"rank {rank}: timed out after {recv_timeout_s:.0f}s "
+                    f"waiting for {expect_type} round {expect_round} — a "
+                    "peer process likely died"
+                ) from None
             if (
                 msg.get_type() == expect_type
                 and int(msg.get("round")) == expect_round
@@ -123,31 +128,37 @@ def run_hierarchical_grpc_group(
                 r, rank, api.groups[rank], sampled_set
             )
             if rank == 0:
-                stacked_vars = [] if w_group is None else [w_group]
-                weights = [] if w_group is None else [weight]
+                # keyed by sender rank, then averaged in GROUP-INDEX order
+                # — message-arrival order is nondeterministic for G>2 and
+                # would reorder the float32 weighted sum away from the
+                # simulator's fixed group order (the equality contract)
+                by_rank = {0: (w_group, weight)} if w_group is not None else {}
                 for _ in range(G - 1):
                     msg = recv(MT_GROUP, r)
                     if float(msg.get("weight")) > 0:
-                        stacked_vars.append(
-                            _unleaves(api.global_vars, msg.get("leaves"))
+                        by_rank[msg.get_sender_id()] = (
+                            msg.get("model"),
+                            float(msg.get("weight")),
                         )
-                        weights.append(float(msg.get("weight")))
-                api.global_vars = api._cloud_average(stacked_vars, weights)
-                global_leaves = _leaves(api.global_vars)
+                in_order = [by_rank[g] for g in sorted(by_rank)]
+                api.global_vars = api._cloud_average(
+                    [w for w, _ in in_order], [wt for _, wt in in_order]
+                )
+                global_host = _host_tree(api.global_vars)
                 for peer in range(1, G):
                     out = Message(MT_GLOBAL, 0, peer)
                     out.add_params("round", r)
-                    out.add_params("leaves", global_leaves)
+                    out.add_params("model", global_host)
                     comm.send_message(out)
             else:
                 out = Message(MT_GROUP, rank, 0)
                 out.add_params("round", r)
                 out.add_params("weight", float(weight))
                 if w_group is not None:
-                    out.add_params("leaves", _leaves(w_group))
+                    out.add_params("model", _host_tree(w_group))
                 comm.send_message(out)
                 msg = recv(MT_GLOBAL, r)
-                api.global_vars = _unleaves(api.global_vars, msg.get("leaves"))
+                api.global_vars = msg.get("model")
             if log_fn is not None and metrics is not None:
                 row = {
                     "round": r,

@@ -25,15 +25,30 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_port() -> int:
-    """OS-assigned free port (close-then-reuse race is acceptable for CI;
-    hardcoded ports collide with lingering subprocesses of a previous
-    run, which is worse)."""
+def _free_port(span: int = 1) -> int:
+    """A port N with N..N+span-1 all currently bindable (GrpcCommManager
+    binds base_port + rank, so the bridge needs a free PAIR). Close-then-
+    reuse race is acceptable for CI; hardcoded ports collide with
+    lingering subprocesses of a previous run, which is worse."""
     import socket
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    for _ in range(64):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+        try:
+            socks = []
+            for off in range(span):
+                t = socket.socket()
+                t.bind(("127.0.0.1", base + off))
+                socks.append(t)
+            return base
+        except OSError:
+            continue
+        finally:
+            for t in socks:
+                t.close()
+    raise RuntimeError("no free port span found")
 
 
 @pytest.mark.slow
@@ -71,12 +86,18 @@ def test_jax_distributed_cpu_blocker_is_pinned(tmp_path):
         for rank in (0, 1)
     ]
     rows = []
-    for p in procs:
-        out, _ = p.communicate(timeout=120)
-        assert p.returncode == 0, out[-500:]
-        rows.append(json.loads(
-            [l for l in out.splitlines() if l.startswith("{")][-1]
-        ))
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, out[-500:]
+            rows.append(json.loads(
+                [l for l in out.splitlines() if l.startswith("{")][-1]
+            ))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     for row in rows:
         # the coordination layer DOES form the 2-process group…
         assert row["coord_np"] == 2, row
@@ -140,9 +161,7 @@ def test_two_process_grpc_bridged_hierarchical_equals_simulator(tmp_path):
     # subprocess would differ from the 8-device simulator at ~1e-4 —
     # the equality contract below needs identical backend config
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    # base_port + rank must BOTH be free — GrpcCommManager binds
-    # base_port + own rank
-    port = str(_free_port())
+    port = str(_free_port(span=2))  # base_port + rank for ranks 0 and 1
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(rank), port, str(tmp_path)],
@@ -151,10 +170,16 @@ def test_two_process_grpc_bridged_hierarchical_equals_simulator(tmp_path):
         )
         for rank in (1, 0)
     ]
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        assert p.returncode == 0, out[-1500:]
-        assert "DONE" in out
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, out[-1500:]
+            assert "DONE" in out
+    finally:
+        for p in procs:  # a hung rank must not outlive the test
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     finals = [
         np.load(tmp_path / f"final_{rank}.npz") for rank in (0, 1)
     ]
@@ -163,7 +188,9 @@ def test_two_process_grpc_bridged_hierarchical_equals_simulator(tmp_path):
         np.testing.assert_array_equal(finals[0][k], finals[1][k])
 
     # …and that model equals the in-process simulator's (same seed, same
-    # _group_round math — equality, not similarity)
+    # _group_round math — equality, not similarity). NOTE: this config
+    # block must mirror _DRIVER's verbatim — drift here shows up as a
+    # bridge/simulator mismatch, so check both when touching either.
     from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
     from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
     from fedml_tpu.data.synthetic import synthetic_classification
