@@ -231,7 +231,7 @@ def _north_star_api(compute_dtype="float32", comm_round=1, fused_rounds=1):
     return FedAvgAPI(config, data, model)
 
 
-def _trainloop_rows(compute_dtype, total=64, chunk=16, repeats=4):
+def _trainloop_rows(compute_dtype, total=64, chunk=16, repeats=3):
     """Eager vs fused through the production train() loop (incl. logging),
     timed as INTERLEAVED passes (E,F,E,F,...) with best-of per config —
     tunnel throughput drifts several percent over minutes, more than the
@@ -598,7 +598,7 @@ def _mxu_validation():
     return rows
 
 
-def _scale_100k(num_clients=100_000, timed_rounds=20):
+def _scale_100k(num_clients=100_000, timed_rounds=15):
     """100k-client StackOverflow-geometry run off the mmap store
     (VERDICT r2 Next #4; ref benchmark/README.md:57 = 342,477 clients).
     Clients live on disk; each round reads only the sampled cohort. The
@@ -1458,13 +1458,20 @@ def main():
             }}
         return {s: {"skipped": why} for s in slot_map.get(name, (name,))}
 
+    # a section may START only if its estimate finishes BEFORE the
+    # watchdog would hard-finalize (60 s margin) — admitting work into
+    # the watchdog's kill zone trades a graceful per-section skip row
+    # for a partial record. The 0.95 term keeps the tiny-budget tests'
+    # semantics when wd_frac is overridden upward.
+    start_deadline = min(budget_s * 0.95, budget_s * wd_frac - 60)
+
     def run_section(name, fn, est_s, max_s, retry=True):
         """Budget gate + SIGALRM wall cap + failure isolation. A section
         that raises gets ONE retry (observed transient tunnel errors);
         a section that trips its wall cap does NOT retry (a hang that ate
         max_s once will eat it again). Every outcome lands in the record
         via emitter.update inside ``fn`` or the fallback here."""
-        if emitter.elapsed() > budget_s * 0.85 - est_s:
+        if emitter.elapsed() > start_deadline - est_s:
             emitter.update(_fallbacked(name, (
                 f"{round(emitter.elapsed())}s elapsed of "
                 f"{round(budget_s)}s budget; section needs ~{est_s}s"
@@ -1493,7 +1500,7 @@ def main():
                     _fallbacked(name, f"hit its {max_s}s wall cap")
                 )
                 return
-            if attempt == attempts or emitter.elapsed() > budget_s * 0.85:
+            if attempt == attempts or emitter.elapsed() > start_deadline:
                 emitter.update(_fallbacked(
                     name, f"failed (attempt {attempt}): {err}"
                 ))
@@ -1567,9 +1574,6 @@ def main():
     def s_scale_state():
         emitter.update({"scale_100k_stateful": _scale_100k_stateful()})
 
-    def s_mxu():
-        emitter.update({"mxu_validation": _mxu_validation()})
-
     if tiny:
         # CI mode (tests/test_bench_resilience.py): a fast real section,
         # then a sleeper the kill-test murders mid-flight. Proves the
@@ -1586,23 +1590,40 @@ def main():
             ("north_star", s_tiny, 0, 300),
             ("sleeper", s_sleep, 0, 300),
         ]
+        if os.environ.get("FEDML_TPU_BENCH_TINY_SLEEP_ONLY") == "1":
+            # watchdog test: the sleeper must start INSIDE the gate
+            # window deterministically (the real first section's compile
+            # time straddles it depending on cache warmth)
+            sections = sections[1:]
     else:
         # Order = judge priority. est_s gates section START against 85% of
         # the budget; max_s is the SIGALRM wall cap. Measured section costs
         # land in section_seconds for the next re-budget.
+        # est_s values are the r5 full-pass MEASUREMENTS (BENCH_DETAIL
+        # section_seconds) + ~10% headroom, gated against start_deadline
+        # (the watchdog minus margin); the unpredictable compile-heavy
+        # resnet56 section runs LAST so an overrun only ever costs itself.
+        # mxu_validation is retired from the schedule: the flagship row
+        # now carries the accuracy-GATED MXU story (0.42 device MFU) and
+        # the r3 side evidence stands in BENCH_r03/docs/PERF_R3.md.
+        emitter.update({"mxu_validation": {"skipped": (
+            "retired after r5: the flagship row carries the gated MXU "
+            "story; resnet18_gn/transformer evidence in BENCH_r03 + "
+            "docs/PERF_R5.md (bench._mxu_validation stays importable "
+            "for manual runs)"
+        )}})
         sections = [
             ("north_star", s_north_fp32, 0, 420),
             ("north_star_bf16", s_north_bf16, 0, 300),
-            ("flagship_lm_bf16", s_flagship, 320, 540),
-            ("synthetic11", s_synthetic11, 300, 600),
-            ("femnist_lda", s_femnist_lda, 500, 800),
-            ("trainloop", s_trainloop, 200, 360),
-            ("bf16_cross_silo", s_bf16_cross_silo, 200, 360),
-            ("flash_attention", s_flash, 120, 300),
-            ("fedbuff_async", s_fedbuff, 100, 240),
-            ("scale", s_scale, 150, 300),
-            ("scale_stateful", s_scale_state, 150, 300),
-            ("mxu_validation", s_mxu, 120, 300),
+            ("flagship_lm_bf16", s_flagship, 520, 700),
+            ("synthetic11", s_synthetic11, 70, 300),
+            ("femnist_lda", s_femnist_lda, 160, 500),
+            ("trainloop", s_trainloop, 95, 300),
+            ("fedbuff_async", s_fedbuff, 60, 240),
+            ("flash_attention", s_flash, 80, 240),
+            ("scale", s_scale, 105, 300),
+            ("scale_stateful", s_scale_state, 160, 300),
+            ("bf16_cross_silo", s_bf16_cross_silo, 430, 600),
         ]
     prev = time.perf_counter()
     for name, fn, est_s, max_s in sections:

@@ -19,7 +19,8 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _env(budget, tiny=None, sleep=None, detail=None, wd_frac=None):
+def _env(budget, tiny=None, sleep=None, detail=None, wd_frac=None,
+         sleep_only=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # inherited by the backend-alive probe
     env["FEDML_TPU_BENCH_BUDGET_S"] = str(budget)
@@ -31,6 +32,8 @@ def _env(budget, tiny=None, sleep=None, detail=None, wd_frac=None):
         env["FEDML_TPU_BENCH_DETAIL"] = detail
     if wd_frac is not None:
         env["FEDML_TPU_BENCH_WATCHDOG_FRAC"] = str(wd_frac)
+    if sleep_only:
+        env["FEDML_TPU_BENCH_TINY_SLEEP_ONLY"] = "1"
     return env
 
 
@@ -140,10 +143,14 @@ def test_bench_watchdog_fires_before_driver_timeout(tmp_path):
     os._exit's — even though the main thread is still asleep."""
     detail = str(tmp_path / "detail.json")
     t0 = time.time()
+    # budget 120: the section gate admits the sleeper (start_deadline =
+    # 0.92*120-60 = 50s > probe time) and the watchdog fires at 110s,
+    # mid-sleep — the exact hang-past-the-budget scenario
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True, text=True, timeout=280,
-        env=_env(budget=40, tiny=True, sleep=600, detail=detail), cwd=REPO,
+        env=_env(budget=120, tiny=True, sleep=600, detail=detail,
+                 sleep_only=True), cwd=REPO,
     )
     # exited on its own (well before the sleeper's 600 s), record intact
     assert time.time() - t0 < 240
