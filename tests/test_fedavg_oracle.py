@@ -121,3 +121,53 @@ def test_client_sampling_parity():
     assert np.array_equal(got, expect)
     # full participation returns all clients
     assert np.array_equal(client_sampling(0, 5, 5), np.arange(5))
+
+
+def test_scan_and_vmap_client_schedules_agree():
+    """The two client schedules are THE SAME math executed in different
+    orders (scan: one client's full local run at a time, full-size
+    matmuls; vmap: all clients batched). The flagship bench row rides the
+    scan schedule for its MXU tiling (docs/PERF_R5.md §1 — 0.77 vs 0.42
+    device MFU on the transformer LM), so their numerical agreement is a
+    load-bearing contract, not an implementation detail."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    data = synthetic_classification(
+        num_clients=8, num_classes=3, feat_shape=(6,), samples_per_client=16,
+        partition_method="hetero", ragged=False, seed=0,
+    )
+    model = create_model("lr", "synthetic", (6,), 3)
+    base = RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=8, client_num_per_round=5, comm_round=3,
+            epochs=2, frequency_of_the_test=10_000,
+        ),
+        train=TrainConfig(client_optimizer="adam", lr=0.05),
+        seed=0,
+    )
+    apis = {}
+    for sched in ("vmap", "scan"):
+        cfg = dataclasses.replace(
+            base, fed=dataclasses.replace(base.fed, client_parallelism=sched)
+        )
+        api = FedAvgAPI(cfg, data, model)
+        assert api._client_mode == sched
+        for r in range(3):
+            api.train_round(r)
+        apis[sched] = api
+    for a, b in zip(
+        jax.tree_util.tree_leaves(apis["vmap"].global_vars),
+        jax.tree_util.tree_leaves(apis["scan"].global_vars),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
