@@ -1495,7 +1495,13 @@ def main():
             # a late alarm raising inside the except-branch would escape
             # run_section and kill the whole pass
             err = timed_out = None
-            signal.setitimer(signal.ITIMER_REAL, max_s)
+            # cap also clamps to the time left before the watchdog (20 s
+            # margin): a late-admitted section must trip ITS OWN wall cap
+            # (self-describing skip row) before the watchdog's os._exit
+            # turns the record partial
+            wd_deadline = budget_s * wd_frac
+            cap = max(5.0, min(max_s, wd_deadline - emitter.elapsed() - 20))
+            signal.setitimer(signal.ITIMER_REAL, cap)
             try:
                 fn()
                 return
@@ -1509,7 +1515,7 @@ def main():
                 return  # fn() recorded its result before the late signal
             if timed_out:
                 emitter.update(
-                    _fallbacked(name, f"hit its {max_s}s wall cap")
+                    _fallbacked(name, f"hit its {cap:.0f}s wall cap")
                 )
                 return
             if attempt == attempts or emitter.elapsed() > start_deadline:
@@ -1595,7 +1601,19 @@ def main():
             emitter.update({"north_star": row})
 
         def s_sleep():
-            time.sleep(float(os.environ.get("FEDML_TPU_BENCH_TINY_SLEEP", 120)))
+            dur = float(os.environ.get("FEDML_TPU_BENCH_TINY_SLEEP", 120))
+            if os.environ.get("FEDML_TPU_BENCH_TINY_SLEEP_ONLY") == "1":
+                # the watchdog test's subject: a hang SIGALRM cannot
+                # interrupt (real analog: a wedged uninterruptible tunnel
+                # call) — swallow the alarm so only the watchdog can end it
+                t_end = time.time() + dur
+                while time.time() < t_end:
+                    try:
+                        time.sleep(min(5.0, t_end - time.time()))
+                    except BaseException:  # noqa: BLE001 — deliberate
+                        pass
+            else:
+                time.sleep(dur)
             emitter.update({"north_star_bf16": {"skipped": "tiny mode"}})
 
         sections = [
@@ -1630,12 +1648,12 @@ def main():
             ("north_star_bf16", s_north_bf16, 0, 300),
             ("flagship_lm_bf16", s_flagship, 400, 700),
             ("synthetic11", s_synthetic11, 70, 300),
-            ("femnist_lda", s_femnist_lda, 160, 500),
-            ("trainloop", s_trainloop, 95, 300),
+            ("femnist_lda", s_femnist_lda, 170, 500),
+            ("trainloop", s_trainloop, 125, 300),
             ("fedbuff_async", s_fedbuff, 60, 240),
             ("flash_attention", s_flash, 80, 240),
-            ("scale", s_scale, 105, 300),
-            ("scale_stateful", s_scale_state, 160, 300),
+            ("scale", s_scale, 140, 480),
+            ("scale_stateful", s_scale_state, 60, 300),
             ("bf16_cross_silo", s_bf16_cross_silo, 430, 600),
         ]
     prev = time.perf_counter()
