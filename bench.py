@@ -164,8 +164,12 @@ def _device_row_flops_only(api, round_idx: int):
     return fn_flops(_round_step_closure(api, round_idx), api.global_vars)
 
 
-def _throughput_row(api, warmup: int, timed: int, label: str):
-    """Wall + device timing and MFU for one workload/dtype."""
+def _throughput_row(api, warmup: int, timed: int, label: str,
+                    wall_only: bool = False):
+    """Wall + device timing and MFU for one workload/dtype. ``wall_only``
+    skips the scan-slope device row and FLOPs counting — each is another
+    full XLA compile, which the quick in-pass resnet56 form can't
+    afford."""
     from fedml_tpu.utils import profiling
 
     m = None
@@ -173,6 +177,13 @@ def _throughput_row(api, warmup: int, timed: int, label: str):
         _, m = api.train_round(r)
     _sync(m)
     wall_s = _timed_rounds(api, warmup, timed)
+    if wall_only:
+        return {
+            "label": label,
+            "compute_dtype": api.config.train.compute_dtype,
+            "rounds_per_sec": round(1.0 / wall_s, 4),
+            "round_ms_wall": round(wall_s * 1e3, 2),
+        }
     dev_s, analytic_rep, xla = _device_row(api, round_idx=warmup)
 
     def rep_flops(r):
@@ -277,9 +288,16 @@ def _trainloop_rows(compute_dtype, total=64, chunk=16, repeats=3):
     )
 
 
-def _bf16_cross_silo():
+def _bf16_cross_silo(quick: bool = False):
     """resnet56 @ CIFAR cross-silo shapes (benchmark/README.md:105):
-    fp32 vs bf16, wall + device + analytic MFU + accuracy parity."""
+    fp32 vs bf16, wall + device + analytic MFU + accuracy parity.
+
+    ``quick=True`` (the in-pass schedule) skips the scan-slope device row
+    and the 30-round accuracy runs: each is another ~100-130 s remote
+    resnet56 compile through the tunnel, putting the FULL section at
+    ~850 s — it cannot fit after the other sections at the 2100 s budget
+    (measured r5: two passes tripped its cap). The full form stays for
+    standalone capture; the committed BENCH_DETAIL_r05.json carries it."""
     import jax
 
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
@@ -312,19 +330,35 @@ def _bf16_cross_silo():
             model="resnet56",
         )
         api = FedAvgAPI(cfg, data, model)
-        row = _throughput_row(api, warmup=1, timed=5, label=f"resnet56_{dt}")
-        # accuracy parity at matched rounds from a fresh init, judged on
-        # the pooled train shards (the 80-sample synthetic test set is
-        # noise at this scale)
-        _reset(api)
-        for r in range(30):
-            api.train_round(r)
-        pool = api.local_test_on_all_clients(0)
-        row["acc_after_30_rounds"] = round(float(pool["Train/Acc"]), 4)
-        out[dt] = row
+        if quick:
+            out[dt] = _throughput_row(
+                api, warmup=1, timed=5, label=f"resnet56_{dt}",
+                wall_only=True,
+            )
+        else:
+            row = _throughput_row(api, warmup=1, timed=5, label=f"resnet56_{dt}")
+            # accuracy parity at matched rounds from a fresh init, judged
+            # on the pooled train shards (the 80-sample synthetic test
+            # set is noise at this scale)
+            _reset(api)
+            for r in range(30):
+                api.train_round(r)
+            pool = api.local_test_on_all_clients(0)
+            row["acc_after_30_rounds"] = round(float(pool["Train/Acc"]), 4)
+            out[dt] = row
     out["speedup_bf16_over_fp32_wall"] = round(
         out["float32"]["round_ms_wall"] / out["bfloat16"]["round_ms_wall"], 2
     )
+    if quick:
+        out["note"] = (
+            "quick in-pass form: wall-only dtype ratio (device-slope MFU, "
+            "accuracy-at-30 and parity are in the committed full capture "
+            "— BENCH_DETAIL_r05.json bf16_cross_silo_resnet56 / "
+            "PERF_R5.md §8; "
+            "bf16-vs-fp32 training parity is also pinned per-pass by the "
+            "femnist bf16_parity gate)"
+        )
+        return out
     out["speedup_bf16_over_fp32_device"] = round(
         out["float32"]["round_ms_device"] / out["bfloat16"]["round_ms_device"], 2
     )
@@ -1265,6 +1299,8 @@ def _sec_digest(key: str, v) -> str:
         return f"spill {v['spill_over_hbm_slowdown']}x"
     if "speedup_bf16_over_fp32_device" in v:
         return f"bf16 {v['speedup_bf16_over_fp32_device']}x dev"
+    if "speedup_bf16_over_fp32_wall" in v:
+        return f"bf16 {v['speedup_bf16_over_fp32_wall']}x wall"
     return "ok"
 
 
@@ -1578,7 +1614,7 @@ def main():
         emitter.update(updates)
 
     def s_bf16_cross_silo():
-        emitter.update({"bf16_cross_silo_resnet56": _bf16_cross_silo()})
+        emitter.update({"bf16_cross_silo_resnet56": _bf16_cross_silo(quick=True)})
 
     def s_flash():
         emitter.update({"flash_attention_s8192": _flash_attention_row()})
@@ -1654,7 +1690,7 @@ def main():
             ("flash_attention", s_flash, 80, 240),
             ("scale", s_scale, 140, 480),
             ("scale_stateful", s_scale_state, 60, 300),
-            ("bf16_cross_silo", s_bf16_cross_silo, 430, 600),
+            ("bf16_cross_silo", s_bf16_cross_silo, 380, 600),
         ]
     prev = time.perf_counter()
     for name, fn, est_s, max_s in sections:
