@@ -59,6 +59,32 @@ for rt in loopback shm; do
   echo "  fedbuff/$rt ok"
 done
 
+echo "== telemetry smoke: 3-round loopback federation with --telemetry_dir =="
+TELDIR=$(mktemp -d)
+python -m fedml_tpu --algorithm fedavg --runtime loopback --model lr \
+  --dataset synthetic --client_num_in_total 4 --client_num_per_round 4 \
+  --comm_round 3 --batch_size 8 --telemetry_dir "$TELDIR" \
+  --log_dir "$TELDIR/logs" > /dev/null
+python - "$TELDIR" <<'PY'
+import json, sys
+tdir = sys.argv[1]
+doc = json.load(open(f"{tdir}/trace.json"))  # must parse as Chrome trace
+spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+rounds = lambda n: sorted(e["args"]["round"] for e in spans if e["name"] == n)
+assert rounds("round") == rounds("broadcast") == rounds("aggregate") == [0, 1, 2], \
+    {n: rounds(n) for n in ("round", "broadcast", "aggregate")}
+health = json.load(open(f"{tdir}/health.json"))
+assert sorted(health) == ["0", "1", "2", "3"], health  # all clients seen
+assert all(rec["rounds_participated"] == 3 for rec in health.values())
+summary = json.load(open(f"{tdir}/logs/summary.json"))
+assert summary["telemetry/comm_bytes_sent"] > 0
+assert summary["telemetry/comm_bytes_received"] == summary["telemetry/comm_bytes_sent"]
+print(f"  telemetry ok: {len(spans)} spans, "
+      f"{int(summary['telemetry/comm_messages_sent'])} messages, "
+      f"{int(summary['telemetry/comm_bytes_sent'])} bytes")
+PY
+rm -rf "$TELDIR"
+
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 

@@ -27,3 +27,9 @@ Subpackages
 """
 
 __version__ = "0.2.0"
+
+# NOTE: this file deliberately imports nothing. `import fedml_tpu` (and in
+# particular `import fedml_tpu.telemetry`, which is jax-free by contract)
+# must not pay the jax import. The jax API-compat shims for older jaxlib
+# live in fedml_tpu/_jax_compat.py and are installed by the modules that
+# actually call the newer APIs (parallel/, the sharded algorithm variants).

@@ -1,0 +1,38 @@
+"""jax API compat shims for older jaxlib builds.
+
+The mesh runtime is written against the stable ``jax.shard_map`` API: the
+``check_vma=`` argument and ``jax.lax.pcast`` varying-axes marks. Older
+jaxlib builds (this container ships 0.4.x) predate both: shard_map lives
+at ``jax.experimental.shard_map.shard_map`` with the pre-rename
+``check_rep=`` spelling, and there is no VMA type system at all — so
+``pcast(..., to="varying")`` is semantically the identity there.
+
+``install()`` adds forwarding shims so every call site keeps the
+forward-looking spelling and the package still runs on the older runtime.
+It is a no-op on jax with the stable API, idempotent, and called at import
+time by the modules whose code paths reach ``jax.shard_map`` /
+``jax.lax.pcast`` (parallel/, scaffold, ditto, fednova) — NOT by the
+package ``__init__``, which stays import-free so jax-less consumers (e.g.
+a telemetry scrape sidecar) can ``import fedml_tpu.telemetry``."""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        import functools
+
+        from jax.experimental.shard_map import shard_map as _expm_shard_map
+
+        @functools.wraps(_expm_shard_map)
+        def _shard_map_compat(f, *args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _expm_shard_map(f, *args, **kwargs)
+
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax.lax, "pcast"):
+        # no VMA typing on this jax — a replicated->varying cast is a no-op
+        jax.lax.pcast = lambda x, axes=None, *, to=None: x

@@ -36,6 +36,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedml_tpu import _jax_compat
+
+_jax_compat.install()  # jax.shard_map / jax.lax.pcast on older jaxlib
+
 from fedml_tpu.algorithms.fedavg import (
     FedAvgAPI,
     client_axis_map,

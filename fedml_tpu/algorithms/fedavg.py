@@ -27,6 +27,7 @@ import numpy as np
 from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import FederatedDataset, stack_clients
 from fedml_tpu.models import ModelDef
+from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
 from fedml_tpu.train.client import make_local_train
 from fedml_tpu.train.evaluate import make_eval_fn
 
@@ -387,6 +388,14 @@ class FedAvgAPI:
         # round-seeded sampling makes the continuation identical to the
         # uninterrupted run).
         self.start_round = 0
+        # Telemetry: round-lifecycle spans (round → broadcast/local_train/
+        # eval) on the global tracer, and a client health registry updated
+        # per round. The vmap/mesh runtimes run the whole cohort as ONE
+        # jitted program, so per-client "train time" here is the cohort's
+        # shared round wall time — participation/last-seen stay exact, and
+        # the transport runtimes refine timing per client.
+        self._tracer = get_tracer()
+        self.health = ClientHealthRegistry()
         self._store = None
         if self._use_device_store and config.data.device_cache:
             from fedml_tpu.data.device_store import DeviceDataStore, fits_on_device
@@ -427,14 +436,27 @@ class FedAvgAPI:
         # _round_plan is the one derivation of "this round's cohort" —
         # memoized, shared with the fused chunk planner and _round_may_pad
         sampled, _steps, _bs = self._round_plan(round_idx)
-        batch = self._round_batch(sampled, round_idx)
-        rng = jax.random.fold_in(self.rng, round_idx + 1)
+        # "broadcast" = ship the global model + cohort batch to the device
+        # (the simulator's analog of the transport path's model broadcast)
+        with self._tracer.span(
+            "broadcast", round=round_idx, clients=len(sampled)
+        ):
+            batch = self._round_batch(sampled, round_idx)
+            rng = jax.random.fold_in(self.rng, round_idx + 1)
+            placed = self._place_batch(batch, rng)
         kw = {}
         if getattr(self.round_fn, "supports_may_pad", False):
             kw["may_pad"] = self._round_may_pad(round_idx)
-        self.global_vars, metrics = self.round_fn(
-            self.global_vars, *self._place_batch(batch, rng), **kw
-        )
+        # local train + weighted aggregate run fused in ONE jitted program;
+        # dispatch is async, so this span's wall time is the host-side
+        # dispatch cost, not device time (the device half lives in the
+        # --profile_dir jax trace)
+        with self._tracer.span(
+            "local_train", round=round_idx, clients=len(sampled), fused_aggregate=True
+        ):
+            self.global_vars, metrics = self.round_fn(
+                self.global_vars, *placed, **kw
+            )
         return sampled, metrics
 
     def _client_counts(self, sampled):
@@ -771,15 +793,16 @@ class FedAvgAPI:
             "round_time_s": round_time_s,
         }
         if self._is_eval_round(round_idx):
-            if cfg.fed.eval_on_clients:
-                local = self.local_test_on_all_clients(round_idx)
-                # local-train metrics describe ALL clients (not just this
-                # round's cohort) — override the cohort sums, ref schema
-                row.update(
-                    {k: v for k, v in local.items() if k != "round"}
-                )
-            else:
-                row["Test/Loss"], row["Test/Acc"] = self.evaluate_global()
+            with self._tracer.span("eval", round=round_idx):
+                if cfg.fed.eval_on_clients:
+                    local = self.local_test_on_all_clients(round_idx)
+                    # local-train metrics describe ALL clients (not just this
+                    # round's cohort) — override the cohort sums, ref schema
+                    row.update(
+                        {k: v for k, v in local.items() if k != "round"}
+                    )
+                else:
+                    row["Test/Loss"], row["Test/Acc"] = self.evaluate_global()
         self.history.append(row)
         self.log_fn(row)
         return row
@@ -837,22 +860,30 @@ class FedAvgAPI:
             L = self._fused_chunk_len(round_idx)
             t0 = time.perf_counter()
             if L > 1:
-                metrics = self.train_rounds_fused(round_idx, L)
+                with self._tracer.span(
+                    "round", round=round_idx, fused_rounds=L
+                ):
+                    metrics = self.train_rounds_fused(round_idx, L)
                 dt = (time.perf_counter() - t0) / L
                 pending.append((round_idx, self._pack_metrics(metrics), dt))
-                last_round = round_idx + L - 1
+                first_round, last_round = round_idx, round_idx + L - 1
                 round_idx += L
             else:
-                _, metrics = self.train_round(round_idx)
+                with self._tracer.span("round", round=round_idx):
+                    _, metrics = self.train_round(round_idx)
+                dt = time.perf_counter() - t0
                 pending.append(
-                    (
-                        round_idx,
-                        self._pack_metrics(metrics),
-                        time.perf_counter() - t0,
-                    )
+                    (round_idx, self._pack_metrics(metrics), dt)
                 )
-                last_round = round_idx
+                first_round = last_round = round_idx
                 round_idx += 1
+            # health: the cohort trained as one program — every sampled
+            # client shares the round's wall time; participation/last-seen
+            # are exact per client (_round_plan is memoized, so this costs
+            # no re-sampling)
+            for r in range(first_round, last_round + 1):
+                for cid in self._round_plan(r)[0]:
+                    self.health.observe_train(int(cid), r, dt)
             # Flush when the LAST executed round is an eval round — eval
             # must read global_vars exactly as of that round, and
             # _fused_chunk_len guarantees eval rounds terminate their

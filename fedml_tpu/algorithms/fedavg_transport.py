@@ -25,6 +25,7 @@ import numpy as np
 
 from fedml_tpu.algorithms.fedavg import client_sampling, weighted_average
 from fedml_tpu.config import RunConfig
+from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
 from fedml_tpu.core.comm import BaseCommManager
 from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
 from fedml_tpu.core.managers import ClientManager, ServerManager
@@ -103,6 +104,12 @@ class LocalTrainer:
         self.client_index = int(client_index)
 
     def train(self, round_idx: int, variables: dict):
+        with get_tracer().span(
+            "local_train", client=int(self.client_index), round=int(round_idx)
+        ):
+            return self._train(round_idx, variables)
+
+    def _train(self, round_idx: int, variables: dict):
         cfg = self.config
         batch = stack_clients(
             self.data,
@@ -201,6 +208,24 @@ class FedAvgServerManager(ServerManager):
         from fedml_tpu.train.evaluate import make_eval_fn
 
         self._eval_fn = make_eval_fn(model, task) if data is not None else None
+        # Telemetry: the client health registry feeds on the span stream
+        # (in-process federations record true local_train wall time) and on
+        # this server's broadcast→upload round-trips (the only timing a
+        # cross-process gRPC server can see); (client, round) dedupe keeps
+        # the two sources from double counting. Round-lifecycle spans begin
+        # at broadcast and end at round completion (possibly on another
+        # thread), so they use explicit handles, not context managers.
+        self._tracer = get_tracer()
+        self.health = ClientHealthRegistry().attach(self._tracer)
+        self._round_span = None
+        self._assigned: Dict[int, tuple] = {}  # worker -> (client_idx, t_bcast)
+
+    def finish(self):
+        # stop feeding the health registry from the global span stream —
+        # sequential federations in one process (tests, sweeps) must not
+        # accumulate listeners; queries on self.health keep working
+        self.health.detach()
+        super().finish()
 
     def _broadcast(self, msg: Message) -> bool:
         """Send a server->client message, tolerating a dead peer: a client
@@ -237,12 +262,15 @@ class FedAvgServerManager(ServerManager):
         sampled = client_sampling(
             0, self.config.fed.client_num_in_total, self.worker_num
         )
-        for worker, client_idx in enumerate(sampled, start=1):
-            msg = Message(MT.S2C_INIT_CONFIG, 0, worker)
-            msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
-            msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
-            msg.add_params(MT.ARG_ROUND_IDX, 0)
-            self._broadcast(msg)
+        self._round_span = self._tracer.start_span("round", round=0)
+        with self._tracer.span("broadcast", round=0):
+            for worker, client_idx in enumerate(sampled, start=1):
+                msg = Message(MT.S2C_INIT_CONFIG, 0, worker)
+                msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
+                msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
+                msg.add_params(MT.ARG_ROUND_IDX, 0)
+                self._assigned[worker] = (int(client_idx), time.monotonic())
+                self._broadcast(msg)
         self._arm_deadline()
 
     def register_message_receive_handlers(self):
@@ -411,6 +439,13 @@ class FedAvgServerManager(ServerManager):
                 # straggler reporting for an already-closed round
                 self.dropped_uploads += 1
                 return
+            # health: broadcast→upload round-trip for this worker's client
+            # (no-op when the span stream already recorded the round)
+            assigned = self._assigned.get(msg.get_sender_id())
+            if assigned is not None:
+                self.health.observe_train(
+                    assigned[0], upload_round, time.monotonic() - assigned[1]
+                )
             worker = msg.get_sender_id() - 1
             if self.config.comm.secure_agg:
                 # store the masked vector; unmasking happens once at round
@@ -516,11 +551,19 @@ class FedAvgServerManager(ServerManager):
                 return  # waiting on recovery vecs (timer bounds the wait)
             srv = ServerAggregator(tree_dim(self.global_vars))
             if self._masked_uploads:
-                total = srv.masked_sum(self._masked_uploads)
-                if dropped:
-                    total = srv.remove_dropout_masks(total, self._recovery_vecs)
-                ns = {p: self._masked_ns[p] for p in self._masked_uploads}
-                avg = srv.decode_average(total, ns, self.global_vars)
+                with self._tracer.span(
+                    "aggregate",
+                    round=self.round_idx,
+                    n_uploads=len(self._masked_uploads),
+                    secure_agg=True,
+                ):
+                    total = srv.masked_sum(self._masked_uploads)
+                    if dropped:
+                        total = srv.remove_dropout_masks(
+                            total, self._recovery_vecs
+                        )
+                    ns = {p: self._masked_ns[p] for p in self._masked_uploads}
+                    avg = srv.decode_average(total, ns, self.global_vars)
             else:
                 # every party died mid-protocol: keep the current model
                 logging.warning(
@@ -534,7 +577,12 @@ class FedAvgServerManager(ServerManager):
             self._recovery_requested_for = None
             self._registry_sent = False
         else:
-            avg = self.aggregator.aggregate()
+            with self._tracer.span(
+                "aggregate",
+                round=self.round_idx,
+                n_uploads=self.aggregator.received_count(),
+            ):
+                avg = self.aggregator.aggregate()
         if self._server_step is not None:
             if self._server_opt_state is None:
                 self._server_opt_state = self._server_optimizer.init(
@@ -556,17 +604,21 @@ class FedAvgServerManager(ServerManager):
             or self.round_idx == self.config.fed.comm_round - 1
         )
         if eval_now:
-            loss, acc = evaluate(
-                self.model,
-                self.global_vars,
-                self.data.test_x,
-                self.data.test_y,
-                task=self.task,
-                eval_fn=self._eval_fn,
-            )
+            with self._tracer.span("eval", round=self.round_idx):
+                loss, acc = evaluate(
+                    self.model,
+                    self.global_vars,
+                    self.data.test_x,
+                    self.data.test_y,
+                    task=self.task,
+                    eval_fn=self._eval_fn,
+                )
             row["Test/Loss"], row["Test/Acc"] = loss, acc
         self.history.append(row)
         self.log_fn(row)
+        if self._round_span is not None:
+            self._round_span.end()
+            self._round_span = None
         self.round_idx += 1
         if self.round_idx >= self.config.fed.comm_round:
             for worker in range(1, self.worker_num + 1):
@@ -576,12 +628,15 @@ class FedAvgServerManager(ServerManager):
         sampled = client_sampling(
             self.round_idx, self.config.fed.client_num_in_total, self.worker_num
         )
-        for worker, client_idx in enumerate(sampled, start=1):
-            msg = Message(MT.S2C_SYNC_MODEL, 0, worker)
-            msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
-            msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
-            msg.add_params(MT.ARG_ROUND_IDX, self.round_idx)
-            self._broadcast(msg)
+        self._round_span = self._tracer.start_span("round", round=self.round_idx)
+        with self._tracer.span("broadcast", round=self.round_idx):
+            for worker, client_idx in enumerate(sampled, start=1):
+                msg = Message(MT.S2C_SYNC_MODEL, 0, worker)
+                msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
+                msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
+                msg.add_params(MT.ARG_ROUND_IDX, self.round_idx)
+                self._assigned[worker] = (int(client_idx), time.monotonic())
+                self._broadcast(msg)
         self._arm_deadline()
 
 

@@ -56,6 +56,7 @@ from fedml_tpu.core.message import Message, MessageType as MT
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.models import ModelDef
 from fedml_tpu.algorithms.fedavg_transport import LocalTrainer
+from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
 from fedml_tpu.train.client import make_local_train
 from fedml_tpu.train.evaluate import evaluate, make_eval_fn
 
@@ -130,6 +131,14 @@ class FedBuffServerManager(ServerManager):
         )
         self.history: List[dict] = []
         self._eval_fn = make_eval_fn(model, task) if data is not None else None
+        # Telemetry: per-client health from the span stream (in-process
+        # workers) or the dispatch→upload round-trip (cross-process). The
+        # straggler flag is the hook staleness-aware scheduling needs: a
+        # flagged client's next delta can be discounted before it is even
+        # buffered. Rounds here are model VERSIONS (there is no barrier).
+        self._tracer = get_tracer()
+        self.health = ClientHealthRegistry().attach(self._tracer)
+        self._dispatch_times: Dict[int, tuple] = {}  # worker -> (cid, tag, t)
 
     # -- dispatch --
     def _next_client_index(self) -> int:
@@ -164,6 +173,10 @@ class FedBuffServerManager(ServerManager):
         msg.add_params(MT.ARG_BASE_VERSION, self.version)
         # ARG_ROUND_IDX doubles as the batch-shuffle seed on the client
         msg.add_params(MT.ARG_ROUND_IDX, tag)
+        # health: the tag is the dedupe key the client's local_train span
+        # also carries (its "round"), so span- and server-side observations
+        # of one assignment collapse to one record
+        self._dispatch_times[worker] = (client_index, tag, time.monotonic())
         try:
             self.send_message(msg)
         except Exception as e:  # noqa: BLE001 — transport errors vary by backend
@@ -179,6 +192,10 @@ class FedBuffServerManager(ServerManager):
         self.register_message_receive_handler(
             MT.C2S_SEND_MODEL, self._on_delta_from_client
         )
+
+    def finish(self):
+        self.health.detach()  # see FedAvgServerManager.finish
+        super().finish()
 
     # -- aggregation --
     def _on_delta_from_client(self, msg: Message):
@@ -215,6 +232,11 @@ class FedBuffServerManager(ServerManager):
                     self._dispatch(sender, reuse=True)
                 return
             self._last_upload_tag[sender] = tag
+            disp = self._dispatch_times.get(sender)
+            if disp is not None and disp[1] == tag:
+                self.health.observe_train(
+                    disp[0], tag, time.monotonic() - disp[2]
+                )
             tau = self.version - int(base)
             self._buffer.append(delta)
             self._buffer_taus.append(tau)
@@ -228,15 +250,21 @@ class FedBuffServerManager(ServerManager):
         """Apply one buffered server step; caller holds _lock."""
         fed = self.config.fed
         taus = list(self._buffer_taus)
-        self.global_vars = jax.device_get(
-            apply_buffered_update(
-                self.global_vars,
-                self._buffer,
-                taus,
-                fed.async_server_lr,
-                fed.async_staleness_exp,
+        with self._tracer.span(
+            "server_step",
+            version=self.version,
+            n_deltas=len(self._buffer),
+            staleness_max=int(max(taus)),
+        ):
+            self.global_vars = jax.device_get(
+                apply_buffered_update(
+                    self.global_vars,
+                    self._buffer,
+                    taus,
+                    fed.async_server_lr,
+                    fed.async_staleness_exp,
+                )
             )
-        )
         self._buffer, self._buffer_taus = [], []
         self.version += 1
         self.server_steps += 1

@@ -132,6 +132,18 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="Restore from --checkpoint_path and continue from the saved round")
 @click.option("--profile_dir", type=click.Path(path_type=Path), default=None,
               help="Capture a jax.profiler device trace of the run into this dir")
+@click.option("--telemetry_dir", type=click.Path(path_type=Path), default=None,
+              help="Write host-side telemetry here: trace.json (Chrome "
+                   "trace events — round/broadcast/local_train/aggregate/"
+                   "eval spans, viewable in Perfetto next to the "
+                   "--profile_dir device trace) and health.json (per-client "
+                   "participation/train-time/straggler registry)")
+@click.option("--prom_port", type=int, default=None,
+              help="Serve Prometheus text exposition on "
+                   "http://127.0.0.1:PORT/metrics for the duration of the "
+                   "run (comm byte/message counters, latency histograms, "
+                   "client health gauges); 0 picks an ephemeral port "
+                   "(printed to stderr). Off by default.")
 @click.option("--no_device_cache", is_flag=True, default=False,
               help="Disable the HBM-resident data store (data/device_store.py)")
 @click.option("--fused_rounds", type=int, default=1,
@@ -306,6 +318,78 @@ def build_config(opt) -> RunConfig:
     )
 
 
+def _telemetry_start(opt):
+    """Start run-scoped telemetry sinks (the tracer itself is always on —
+    spans cost microseconds; these flags decide whether anything is
+    EXPORTED). Returns an opaque state for _telemetry_finish, or None when
+    no telemetry flag is set."""
+    if opt.get("prom_port") is None and opt.get("telemetry_dir") is None:
+        return None
+    from fedml_tpu.telemetry import get_comm_meter, get_tracer
+
+    # run-scoped trace + comm totals: the exported trace.json and the
+    # summary.json telemetry row describe THIS run, not whatever earlier
+    # runs happened in the same process (CliRunner tests, notebook sweeps)
+    get_tracer().reset()
+    state = {"exporter": None, "comm_baseline": get_comm_meter().snapshot()}
+    if opt.get("prom_port") is not None:
+        from fedml_tpu.telemetry import PrometheusExporter
+
+        state["exporter"] = PrometheusExporter(port=opt["prom_port"]).start()
+        click.echo(
+            f"telemetry: prometheus metrics on "
+            f"http://127.0.0.1:{state['exporter'].port}/metrics",
+            err=True,
+        )
+    return state
+
+
+def _telemetry_finish(state, opt, logger, health=None):
+    """Flush run telemetry: forward comm totals into MetricsLogger (so
+    summary.json stays the single CI oracle), write the Chrome trace +
+    health registry snapshot into --telemetry_dir, stop the exporter.
+    Idempotent — the run paths call it on success (with the runtime's
+    health registry) and again from their exception backstop (a crashed
+    run must still flush its trace: that is exactly when you want it)."""
+    if state is None or state.get("done"):
+        return
+    state["done"] = True
+    from fedml_tpu.telemetry import get_tracer, telemetry_summary
+
+    logger.log(telemetry_summary(baseline=state.get("comm_baseline")))
+    tdir = opt.get("telemetry_dir")
+    if tdir:
+        tdir = Path(tdir)
+        tdir.mkdir(parents=True, exist_ok=True)
+        suffix = _telemetry_suffix(opt)
+        trace_path = tdir / f"trace{suffix}.json"
+        get_tracer().write_chrome_trace(str(trace_path))
+        if health is not None:
+            with open(tdir / f"health{suffix}.json", "w") as f:
+                json.dump(health.snapshot(), f, indent=2)
+        click.echo(f"telemetry: wrote {trace_path}", err=True)
+    if state.get("exporter") is not None:
+        state["exporter"].stop()
+
+
+def _telemetry_suffix(opt) -> str:
+    """Disambiguate telemetry files when several processes share one
+    --telemetry_dir: gRPC ranks get .rankN, multi-host SPMD processes get
+    .hostK (each then merges cleanly in Perfetto — the tracks are already
+    labeled per host). Single-process runs keep the bare names."""
+    rank = opt.get("rank")
+    if rank is not None:
+        return f".rank{rank}"
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return f".host{jax.process_index()}"
+    except Exception:  # noqa: BLE001 — backend-less finalize must not fail
+        pass
+    return ""
+
+
 def _apply_platform_env():
     """Honor JAX_PLATFORMS for CLI runs. This container's sitecustomize
     pins a TPU backend at interpreter startup, so the env var alone never
@@ -442,6 +526,7 @@ def run(**opt):
         str(opt["log_dir"]) if opt["log_dir"] else None,
         use_wandb=opt.get("enable_wandb", False),
     )
+    telemetry = _telemetry_start(opt)
     api_cell = []
 
     def log_fn(row):
@@ -471,7 +556,13 @@ def run(**opt):
             raise click.UsageError(
                 "runtime=grpc supports fedavg/fedprox/fedopt/fedbuff"
             )
-        final = _run_grpc_process(config, data, model, task, log_fn, opt)
+        try:
+            final, grpc_health = _run_grpc_process(
+                config, data, model, task, log_fn, opt
+            )
+            _telemetry_finish(telemetry, opt, logger, health=grpc_health)
+        finally:
+            _telemetry_finish(telemetry, opt, logger)
         logger.close()
         click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
         return None
@@ -497,8 +588,13 @@ def run(**opt):
                 f"--checkpoint_path is not supported for algorithm="
                 f"{opt['algorithm']} (supported: the FedAvg family and fedseg)"
             )
-        with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
-            final = builder(config, data, model, task, log_fn, opt)
+        try:
+            with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
+                final = builder(config, data, model, task, log_fn, opt)
+        finally:
+            # long-tail drivers have no per-client health registry; the
+            # trace/comm totals still flush (on success AND on a crash)
+            _telemetry_finish(telemetry, opt, logger)
         logger.close()
         click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
         return None
@@ -524,28 +620,36 @@ def run(**opt):
             )
         _restore(api, opt)
 
-    with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
-        final = api.train()
-    if poison_spec is not None:
-        from fedml_tpu.data.edge_cases import attack_success_rate
+    try:
+        with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
+            final = api.train()
+        if poison_spec is not None:
+            from fedml_tpu.data.edge_cases import attack_success_rate
 
-        final = dict(final or {})
-        final["Backdoor/ASR"] = attack_success_rate(
-            model, api.global_vars, data, poison_spec, eval_fn=api.eval_fn
+            final = dict(final or {})
+            final["Backdoor/ASR"] = attack_success_rate(
+                model, api.global_vars, data, poison_spec, eval_fn=api.eval_fn
+            )
+            # persist the attack metric alongside the per-round rows
+            log_fn({
+                "round": config.fed.comm_round - 1,
+                "Backdoor/ASR": final["Backdoor/ASR"],
+            })
+        if opt["checkpoint_path"]:
+            save_checkpoint(
+                str(opt["checkpoint_path"]),
+                getattr(api, "global_vars"),
+                round_idx=config.fed.comm_round,
+                server_opt_state=getattr(api, "server_opt_state", None),
+                algo_state=getattr(api, "checkpoint_state", lambda: None)(),
+            )
+        _telemetry_finish(
+            telemetry, opt, logger, health=getattr(api, "health", None)
         )
-        # persist the attack metric alongside the per-round rows
-        log_fn({
-            "round": config.fed.comm_round - 1,
-            "Backdoor/ASR": final["Backdoor/ASR"],
-        })
-    if opt["checkpoint_path"]:
-        save_checkpoint(
-            str(opt["checkpoint_path"]),
-            getattr(api, "global_vars"),
-            round_idx=config.fed.comm_round,
-            server_opt_state=getattr(api, "server_opt_state", None),
-            algo_state=getattr(api, "checkpoint_state", lambda: None)(),
-        )
+    finally:
+        # exception backstop: flush the trace and stop the exporter even
+        # when the run crashed mid-train (idempotent after the call above)
+        _telemetry_finish(telemetry, opt, logger)
     logger.close()
     click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
     return api
@@ -641,12 +745,14 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                 global_vars = None
                 server_opt_state = None
                 start_round = 0
+                health = None
 
                 def train(self):
                     server = runner_fn(
                         config, data, model, task=task, log_fn=log_fn,
                     )
                     self.global_vars = server.global_vars
+                    self.health = server.health
                     return server.history[-1] if server.history else {}
 
             return _AsyncRunner()
@@ -670,6 +776,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
             global_vars = None
             server_opt_state = None
             start_round = 0
+            health = None
 
             def train(self):
                 server = runner_fn(
@@ -680,6 +787,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                 # expose the FedOpt moments so --checkpoint_path persists
                 # them (the vmap --resume path restores from this slot)
                 self.server_opt_state = server._server_opt_state
+                self.health = server.health
                 return server.history[-1] if server.history else {}
 
         return _Runner()
@@ -1013,7 +1121,9 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
     """One federation participant over gRPC: rank 0 = server FSM, rank 1..K
     = client actor. Every process loads the same config/data (deterministic
     partition from the shared seed), mirroring the reference's
-    one-process-per-worker model (FedAvgAPI.py:14-27)."""
+    one-process-per-worker model (FedAvgAPI.py:14-27). Returns
+    ``(final_row, health)`` — health is the server's client registry on
+    rank 0 (fed by broadcast→upload round-trips), None on client ranks."""
     from fedml_tpu.algorithms.fedavg_transport import (
         FedAvgClientManager,
         FedAvgServerManager,
@@ -1043,7 +1153,9 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
             )
             server.send_init_msg()
             server.run()
-            return server.history[-1] if server.history else {}
+            return (
+                server.history[-1] if server.history else {}
+            ), server.health
         client = FedBuffClientManager(
             config, comm, rank,
             LocalTrainer(
@@ -1057,7 +1169,7 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
                 f"async worker rank {rank} orphaned: server unreachable "
                 "and no FINISH within its deadline"
             )
-        return {"rank": rank, "finished": True}
+        return {"rank": rank, "finished": True}, None
     if rank == 0:
         server = FedAvgServerManager(
             config, comm, model, data=data, task=task, worker_num=K,
@@ -1078,7 +1190,7 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
             raise RuntimeError(
                 "server deadline path failed"
             ) from server.deadline_error
-        return server.history[-1] if server.history else {}
+        return (server.history[-1] if server.history else {}), server.health
     client = FedAvgClientManager(
         config, comm, rank,
         LocalTrainer(
@@ -1087,7 +1199,7 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
         ),
     )
     client.run()
-    return {"rank": rank, "finished": True}
+    return {"rank": rank, "finished": True}, None
 
 
 _LONGTAIL = {

@@ -1,15 +1,25 @@
 """Backend-agnostic communication interface (ref:
 fedml_core/distributed/communication/base_com_manager.py:7-27 +
 observer.py:4-7). Same Observer contract so every backend — loopback
-(core/loopback.py), gRPC (core/grpc_comm.py), MQTT (core/mqtt_comm.py) —
-slots in identically."""
+(core/loopback.py), gRPC (core/grpc_comm.py), MQTT (core/mqtt_comm.py),
+shared memory (core/shm_comm.py) — slots in identically.
+
+Telemetry is wired HERE, once, instead of per backend: ``send_message`` is
+a template method (accounting + delegate to the backend's ``_send``) and
+``notify`` times the observer dispatch — so every transport gets
+per-message-type message/byte counters and latency histograms for free
+(fedml_tpu/telemetry/comm.py). Wire sizes come from the envelope itself:
+``Message.to_wire_parts``/``from_bytes`` stamp the serialized size on the
+message, so accounting costs no extra serialization pass."""
 
 from __future__ import annotations
 
 import abc
+import time
 from typing import List
 
 from fedml_tpu.core.message import Message
+from fedml_tpu.telemetry.comm import get_comm_meter
 
 
 class Observer(abc.ABC):
@@ -20,6 +30,7 @@ class Observer(abc.ABC):
 class BaseCommManager(abc.ABC):
     def __init__(self):
         self._observers: List[Observer] = []
+        self._meter = get_comm_meter()
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -28,11 +39,35 @@ class BaseCommManager(abc.ABC):
         self._observers.remove(observer)
 
     def notify(self, msg: Message) -> None:
-        for obs in list(self._observers):
-            obs.receive_message(msg.get_type(), msg)
+        t0 = time.perf_counter()
+        try:
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+        finally:
+            # received accounting even when a handler raises — the bytes DID
+            # arrive, and the latency of the failing handler is exactly the
+            # kind of outlier the histogram exists to surface
+            self._meter.on_received(
+                msg.get_type(),
+                getattr(msg, "_wire_nbytes", None),
+                time.perf_counter() - t0,
+            )
+
+    def send_message(self, msg: Message, **kwargs) -> None:
+        """Template method: delegate to the backend ``_send``, then account
+        (messages/bytes sent + send-call latency) — a failed send raises
+        through and is NOT counted as sent."""
+        t0 = time.perf_counter()
+        self._send(msg, **kwargs)
+        self._meter.on_sent(
+            msg.get_type(),
+            getattr(msg, "_wire_nbytes", None),
+            time.perf_counter() - t0,
+        )
 
     @abc.abstractmethod
-    def send_message(self, msg: Message) -> None: ...
+    def _send(self, msg: Message, **kwargs) -> None:
+        """Backend send path (serialize + put on the wire)."""
 
     @abc.abstractmethod
     def handle_receive_message(self) -> None:

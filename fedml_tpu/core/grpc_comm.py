@@ -104,7 +104,7 @@ class GrpcCommManager(BaseCommManager):
             _METHOD, request_serializer=None, response_deserializer=None
         )
 
-    def send_message(self, msg: Message, timeout: Optional[float] = 30.0) -> None:
+    def _send(self, msg: Message, timeout: Optional[float] = 30.0) -> None:
         # wait_for_ready on the FIRST send per peer only: multi-process
         # federation has no startup-order guarantee (ref run_*.sh scripts
         # just background processes), so the handshake send blocks until the

@@ -43,7 +43,7 @@ class LoopbackCommManager(BaseCommManager):
         self.rank = rank
         self._inbox = hub.inbox(rank)
 
-    def send_message(self, msg: Message) -> None:
+    def _send(self, msg: Message) -> None:
         self.hub.deliver(msg)
 
     def handle_receive_message(self) -> None:

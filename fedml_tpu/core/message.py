@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -77,6 +77,9 @@ class Message:
         self.sender_id = int(sender_id)
         self.receiver_id = int(receiver_id)
         self.params: Dict[str, Any] = {}
+        # serialized wire size, stamped by to_wire_parts/from_bytes — None
+        # until the envelope has crossed a serialization boundary
+        self._wire_nbytes: Optional[int] = None
 
     # -- envelope API (ref message.py:20-74) --
     def add_params(self, key: str, value: Any) -> "Message":
@@ -114,6 +117,10 @@ class Message:
             }
         ).encode("utf-8")
         header = _MAGIC + struct.pack("<Q", len(meta)) + meta
+        # stamp the serialized size on the envelope: the comm layer's
+        # telemetry (core/comm.py) reads it so byte accounting never needs
+        # a second serialization pass
+        self._wire_nbytes = len(header) + sum(int(b.nbytes) for b in buffers)
         return header, buffers
 
     def wire_size(self) -> int:
@@ -160,6 +167,9 @@ class Message:
 
         for k, v in meta["params"].items():
             msg.params[k] = _decode_node(v, data, offsets, copy)
+        # received wire size (exact: header + meta + buffers, independent of
+        # any trailing slack in the caller's buffer) — comm telemetry reads it
+        msg._wire_nbytes = offset
         return msg
 
 

@@ -117,7 +117,7 @@ class MqttCommManager(BaseCommManager):
         client.loop_start()
         return client
 
-    def send_message(self, msg: Message) -> None:
+    def _send(self, msg: Message) -> None:
         topic = self._topic(msg.get_receiver_id())
         payload = msg.to_bytes()
         if self._broker is not None:

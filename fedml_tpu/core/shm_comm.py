@@ -55,12 +55,16 @@ class ShmCommManager(BaseCommManager):
         addr = _addr(sock_dir, self.rank)
         if os.path.exists(addr):  # stale socket from a crashed run
             os.unlink(addr)
-        self._listener = connection.Listener(addr, family=_FAMILY)
+        # backlog: the default (1) makes a K-client broadcast race the
+        # receive loop's accept — a sender connecting while the listener is
+        # busy decoding gets BlockingIOError(EAGAIN) and takes the whole
+        # federation down; size it to a realistic worker fan-in instead
+        self._listener = connection.Listener(addr, family=_FAMILY, backlog=64)
         self._stopped = threading.Event()
         self._loop_running = False
 
     # -- send: one copy (wire image → shared pages) --
-    def send_message(self, msg: Message) -> None:
+    def _send(self, msg: Message) -> None:
         # serialize exactly once: size and write come from the same parts
         header, buffers = msg.to_wire_parts()
         size = len(header) + sum(int(b.nbytes) for b in buffers)

@@ -1,0 +1,73 @@
+"""Unified telemetry subsystem — host-side spans, comm traffic accounting,
+client health, and a Prometheus exporter.
+
+The reference FedML has only ad-hoc ``time.perf_counter`` timers and rank-0
+wandb logging (SURVEY §5). This package is the framework-level answer to
+"where did round N spend its time, which client is the straggler, and how
+many bytes crossed each transport":
+
+- :mod:`fedml_tpu.telemetry.spans` — zero-dependency structured tracer.
+  ``span("round", round=n)`` context manager, thread-safe, nestable; emits
+  Chrome-trace-event JSON loadable in Perfetto side by side with the
+  ``jax.profiler`` device traces from ``utils/profiling.py``.
+- :mod:`fedml_tpu.telemetry.metrics` — counter/gauge/histogram primitives
+  plus a registry that renders Prometheus text exposition format.
+- :mod:`fedml_tpu.telemetry.comm` — per-message traffic accounting wired
+  once into the ``BaseCommManager`` send/notify path so every transport
+  (loopback, shm, gRPC, MQTT) gets byte/message/latency metrics for free.
+- :mod:`fedml_tpu.telemetry.health` — server-side per-client health
+  registry (last-seen round, participation, train-time percentiles,
+  straggler flag) fed from the span stream or explicit observations.
+- :mod:`fedml_tpu.telemetry.prometheus` — stdlib-only ``/metrics`` HTTP
+  endpoint (off by default; CLI flag ``--prom_port``).
+
+Everything here is stdlib-only on purpose: telemetry must be importable
+before (and without) jax, and must never add a hot-path dependency."""
+
+from fedml_tpu.telemetry.comm import CommMeter, get_comm_meter
+from fedml_tpu.telemetry.health import ClientHealthRegistry
+from fedml_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from fedml_tpu.telemetry.prometheus import PrometheusExporter
+from fedml_tpu.telemetry.spans import Span, SpanEvent, Tracer, get_tracer, span
+
+__all__ = [
+    "ClientHealthRegistry",
+    "CommMeter",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "PrometheusExporter",
+    "Span",
+    "SpanEvent",
+    "Tracer",
+    "get_comm_meter",
+    "get_registry",
+    "get_tracer",
+    "span",
+    "telemetry_summary",
+]
+
+
+def telemetry_summary(baseline: dict = None) -> dict:
+    """Flat ``{"telemetry/...": value}`` row of the process's comm totals,
+    shaped for :class:`fedml_tpu.utils.metrics.MetricsLogger` — forwarding
+    this through ``log_fn`` keeps summary.json the single CI oracle.
+
+    ``baseline``: an earlier ``get_comm_meter().snapshot()`` to subtract,
+    so a run embedded in a long-lived process (tests, notebook sweeps)
+    reports ITS traffic, not the process's lifetime totals."""
+    snap = get_comm_meter().snapshot()
+    row = {}
+    for key in ("messages_sent", "messages_received", "bytes_sent", "bytes_received"):
+        total = sum(snap[key].values())
+        if baseline:
+            total -= sum(baseline.get(key, {}).values())
+        row[f"telemetry/comm_{key}"] = total
+    return row

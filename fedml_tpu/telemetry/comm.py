@@ -1,0 +1,136 @@
+"""Comm-layer traffic accounting — wired ONCE into the BaseCommManager
+send/notify template (core/comm.py), so every transport backend (loopback,
+shm, gRPC, MQTT) gets per-message counters for free:
+
+- ``fedml_comm_messages_sent_total{msg_type}`` / ``..._received_total``
+- ``fedml_comm_bytes_sent_total{msg_type}`` / ``..._received_total``
+  (serialized wire bytes — header + meta JSON + raw array buffers, the
+  size :meth:`Message.to_wire_parts` stamps on the envelope)
+- ``fedml_comm_send_seconds{msg_type}`` — transport send-call latency
+- ``fedml_comm_handle_seconds{msg_type}`` — receive-side observer
+  (handler) latency per message type
+
+The reference's only analog is a JSON-size log line per message
+(message.py:77-78) and the TRPC latency sweep (trpc_comm_manager.py:146-211)
+— here the accounting is structural, not per-backend.
+
+The meter is deliberately decoupled from the instruments: ``snapshot()``
+returns plain dicts for tests and for the MetricsLogger summary
+forwarding, while the same observations feed the global registry the
+Prometheus exporter serves."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
+
+# send/handle latencies are sub-ms on loopback and seconds-scale through a
+# broker — reuse the default latency buckets from metrics.py
+
+
+class CommMeter:
+    """Per-message-type traffic counters + latency histograms."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or get_registry()
+        self._lock = threading.Lock()
+        # plain mirrors (msg_type -> value) so snapshot() needs no registry
+        # scraping and reset() cannot disturb other registry users
+        self._sent: Dict[str, int] = {}
+        self._received: Dict[str, int] = {}
+        self._bytes_sent: Dict[str, int] = {}
+        self._bytes_received: Dict[str, int] = {}
+        r = self.registry
+        self._c_sent = r.counter(
+            "fedml_comm_messages_sent_total",
+            "Messages handed to a transport send path",
+            ("msg_type",),
+        )
+        self._c_recv = r.counter(
+            "fedml_comm_messages_received_total",
+            "Messages dispatched to observers",
+            ("msg_type",),
+        )
+        self._c_bytes_sent = r.counter(
+            "fedml_comm_bytes_sent_total",
+            "Serialized wire bytes sent (header + meta + array buffers)",
+            ("msg_type",),
+        )
+        self._c_bytes_recv = r.counter(
+            "fedml_comm_bytes_received_total",
+            "Serialized wire bytes received",
+            ("msg_type",),
+        )
+        self._h_send = r.histogram(
+            "fedml_comm_send_seconds",
+            "Transport send-call latency",
+            ("msg_type",),
+        )
+        self._h_handle = r.histogram(
+            "fedml_comm_handle_seconds",
+            "Receive-side observer handling latency",
+            ("msg_type",),
+        )
+
+    # -- hot path (called from BaseCommManager) --
+    def on_sent(self, msg_type: str, nbytes: Optional[int], seconds: float) -> None:
+        with self._lock:
+            self._sent[msg_type] = self._sent.get(msg_type, 0) + 1
+            if nbytes:
+                self._bytes_sent[msg_type] = (
+                    self._bytes_sent.get(msg_type, 0) + int(nbytes)
+                )
+        self._c_sent.inc(1, msg_type=msg_type)
+        if nbytes:
+            self._c_bytes_sent.inc(int(nbytes), msg_type=msg_type)
+        self._h_send.observe(seconds, msg_type=msg_type)
+
+    def on_received(self, msg_type: str, nbytes: Optional[int], seconds: float) -> None:
+        with self._lock:
+            self._received[msg_type] = self._received.get(msg_type, 0) + 1
+            if nbytes:
+                self._bytes_received[msg_type] = (
+                    self._bytes_received.get(msg_type, 0) + int(nbytes)
+                )
+        self._c_recv.inc(1, msg_type=msg_type)
+        if nbytes:
+            self._c_bytes_recv.inc(int(nbytes), msg_type=msg_type)
+        self._h_handle.observe(seconds, msg_type=msg_type)
+
+    # -- queries --
+    def snapshot(self) -> dict:
+        """Plain-dict totals: {metric: {msg_type: value}} — what the
+        transport tests and the MetricsLogger summary row consume."""
+        with self._lock:
+            return {
+                "messages_sent": dict(self._sent),
+                "messages_received": dict(self._received),
+                "bytes_sent": dict(self._bytes_sent),
+                "bytes_received": dict(self._bytes_received),
+            }
+
+    def reset(self) -> None:
+        """Clear the plain mirrors (tests isolate on this; the registry
+        counters stay monotonic, as Prometheus counters must)."""
+        with self._lock:
+            self._sent.clear()
+            self._received.clear()
+            self._bytes_sent.clear()
+            self._bytes_received.clear()
+
+
+_GLOBAL: Optional[CommMeter] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_comm_meter() -> CommMeter:
+    """Process-wide meter every BaseCommManager reports into. Lazy so the
+    instruments only appear in the registry once comm is actually used."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = CommMeter()
+    return _GLOBAL

@@ -1,0 +1,225 @@
+"""Server-side client health registry — per-client participation and
+local-train wall-time statistics, with a straggler flag.
+
+The registry answers the operational questions the reference never could
+(SURVEY §5: "no straggler mitigation"): which clients has the server heard
+from, how slow is each one lately, and who sits in the slowest decile.
+It is fed two ways:
+
+- **span stream** (in-process runtimes): ``attach(tracer)`` subscribes to
+  finished ``local_train`` spans (``client=``/``round=`` attrs) — the
+  loopback/shm federations record true on-client train wall time.
+- **explicit observations** (cross-process runtimes): the server manager
+  calls ``observe_train(cid, round, wall_s)`` with its broadcast→upload
+  round-trip, the only timing a gRPC server can see.
+
+Both paths dedupe on (client, round): when the span stream already
+recorded a round, the transport-side round-trip observation is ignored
+(the span is the truer number — it excludes transit).
+
+Straggler flag: a client is a straggler when its sliding-window mean train
+time sits in the slowest decile across clients (>= 0.9 quantile of means)
+AND is materially slower than the fleet (> 1.2 × the median mean) — so a
+homogeneous fleet has no stragglers. This is the hook FedBuff needs for
+staleness-aware scheduling (a straggler's next assignment can be
+discounted up front).
+
+Prometheus exposure stays aggregate on purpose (client cardinality can be
+millions): clients-seen gauge, straggler-count gauge, and one train-time
+histogram across all clients."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
+from fedml_tpu.telemetry.spans import SpanEvent, Tracer
+
+_TRAIN_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 300.0, 1800.0,
+)
+
+
+class _ClientRecord:
+    __slots__ = ("last_seen_round", "rounds_participated", "times", "seen_rounds")
+
+    def __init__(self, window: int):
+        self.last_seen_round = -1
+        self.rounds_participated = 0
+        self.times: deque = deque(maxlen=window)
+        # bounded dedupe memory: only the most recent window of round ids
+        self.seen_rounds: deque = deque(maxlen=window)
+
+    def mean(self) -> Optional[float]:
+        if not self.times:
+            return None
+        return sum(self.times) / len(self.times)
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not self.times:
+            return None
+        xs = sorted(self.times)
+        idx = min(int(q * len(xs)), len(xs) - 1)
+        return xs[idx]
+
+
+class ClientHealthRegistry:
+    def __init__(
+        self,
+        window: int = 64,
+        straggler_quantile: float = 0.9,
+        straggler_margin: float = 1.2,
+        registry: Optional[MetricsRegistry] = None,
+        span_name: str = "local_train",
+    ):
+        self.window = int(window)
+        self.straggler_quantile = float(straggler_quantile)
+        self.straggler_margin = float(straggler_margin)
+        self.span_name = span_name
+        self._clients: Dict[int, _ClientRecord] = {}
+        self._lock = threading.Lock()
+        self._observations = 0
+        self._tracer: Optional[Tracer] = None
+        r = registry or get_registry()
+        self._g_seen = r.gauge(
+            "fedml_clients_seen", "Distinct clients the server has heard from"
+        )
+        self._g_stragglers = r.gauge(
+            "fedml_clients_straggler_count",
+            "Clients currently flagged slowest-decile",
+        )
+        self._h_train = r.histogram(
+            "fedml_client_train_seconds",
+            "Observed local-train wall time across all clients",
+            buckets=_TRAIN_BUCKETS,
+        )
+
+    # -- feeding --
+    def observe_train(
+        self, client_id: int, round_idx: int, wall_s: float
+    ) -> bool:
+        """Record one local-train observation. Returns False when the
+        (client, round) pair was already recorded (span-stream dedupe)."""
+        cid = int(client_id)
+        r = int(round_idx)
+        with self._lock:
+            rec = self._clients.get(cid)
+            if rec is None:
+                rec = self._clients[cid] = _ClientRecord(self.window)
+            if r in rec.seen_rounds:
+                return False
+            rec.seen_rounds.append(r)
+            rec.last_seen_round = max(rec.last_seen_round, r)
+            rec.rounds_participated += 1
+            rec.times.append(float(wall_s))
+            n_clients = len(self._clients)
+            self._observations += 1
+            n_obs = self._observations
+        self._g_seen.set(n_clients)
+        self._h_train.observe(float(wall_s))
+        # the straggler set costs a sort over all client means — refresh the
+        # gauge on a throttle, not per observation (hot round loops at
+        # production fleet sizes would otherwise pay O(N log N) per client);
+        # straggler_ids()/snapshot() always recompute fresh
+        if n_obs % 32 == 0 or n_clients <= 32:
+            self.straggler_ids()
+        return True
+
+    def _on_span(self, ev: SpanEvent) -> None:
+        if ev.name != self.span_name:
+            return
+        cid = ev.attrs.get("client")
+        rnd = ev.attrs.get("round")
+        if cid is None or rnd is None:
+            return
+        self.observe_train(int(cid), int(rnd), ev.dur_us / 1e6)
+
+    def attach(self, tracer: Tracer) -> "ClientHealthRegistry":
+        """Feed from the span stream. Idempotent per tracer; switching
+        tracers detaches from the previous one first (a listener left on
+        the old tracer would keep feeding this registry forever)."""
+        if self._tracer is tracer:
+            return self
+        self.detach()
+        tracer.add_listener(self._on_span)
+        self._tracer = tracer
+        return self
+
+    def detach(self) -> None:
+        if self._tracer is not None:
+            self._tracer.remove_listener(self._on_span)
+            self._tracer = None
+
+    # -- queries (the aggregator-facing API) --
+    def clients_seen(self) -> List[int]:
+        with self._lock:
+            return sorted(self._clients)
+
+    def last_seen_round(self, client_id: int) -> int:
+        with self._lock:
+            rec = self._clients.get(int(client_id))
+            return rec.last_seen_round if rec else -1
+
+    def rounds_participated(self, client_id: int) -> int:
+        with self._lock:
+            rec = self._clients.get(int(client_id))
+            return rec.rounds_participated if rec else 0
+
+    def mean_train_s(self, client_id: int) -> Optional[float]:
+        with self._lock:
+            rec = self._clients.get(int(client_id))
+            return rec.mean() if rec else None
+
+    def percentile_train_s(self, client_id: int, q: float = 0.5) -> Optional[float]:
+        with self._lock:
+            rec = self._clients.get(int(client_id))
+            return rec.percentile(q) if rec else None
+
+    def straggler_ids(self) -> List[int]:
+        """Clients whose sliding-window mean is in the slowest decile
+        (>= the straggler_quantile of all means) AND materially slower
+        than the fleet (> straggler_margin × the median mean). The margin
+        keeps a homogeneous fleet straggler-free: without it, scheduler
+        noise would always flag SOMEONE as "slowest decile"."""
+        with self._lock:
+            means = {
+                cid: rec.mean()
+                for cid, rec in self._clients.items()
+                if rec.times
+            }
+        if len(means) < 2:
+            self._g_stragglers.set(0)
+            return []
+        xs = sorted(means.values())
+        cut = xs[min(int(self.straggler_quantile * len(xs)), len(xs) - 1)]
+        median = xs[len(xs) // 2]
+        floor = self.straggler_margin * median
+        out = sorted(
+            cid for cid, m in means.items() if m >= cut and m > floor
+        )
+        self._g_stragglers.set(len(out))
+        return out
+
+    def is_straggler(self, client_id: int) -> bool:
+        return int(client_id) in self.straggler_ids()
+
+    def snapshot(self) -> dict:
+        """JSON-ready view: {client_id: {last_seen_round, rounds_participated,
+        mean_train_s, p50_train_s, p90_train_s, straggler}}."""
+        stragglers = set(self.straggler_ids())
+        out = {}
+        with self._lock:
+            items = list(self._clients.items())
+        for cid, rec in items:
+            out[str(cid)] = {
+                "last_seen_round": rec.last_seen_round,
+                "rounds_participated": rec.rounds_participated,
+                "mean_train_s": rec.mean(),
+                "p50_train_s": rec.percentile(0.5),
+                "p90_train_s": rec.percentile(0.9),
+                "straggler": cid in stragglers,
+            }
+        return out

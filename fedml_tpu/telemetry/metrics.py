@@ -1,0 +1,275 @@
+"""Counter/gauge/histogram primitives + a registry that renders Prometheus
+text exposition format (version 0.0.4) — stdlib only, no prometheus_client
+dependency (the container must not need one; see the no-new-deps rule).
+
+Semantics follow the Prometheus data model:
+
+- ``Counter``: monotonically increasing float, per label-set.
+- ``Gauge``: settable float, per label-set.
+- ``Histogram``: cumulative buckets + ``_sum``/``_count``, per label-set.
+
+All instruments are thread-safe (one lock per instrument — the comm hot
+path touches at most two instruments per message) and registered in a
+:class:`MetricsRegistry`; ``registry.render()`` is what the Prometheus
+exporter serves and what tests parse."""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Default latency buckets (seconds): federated rounds span sub-ms loopback
+# handling to minutes-long stragglers.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labelnames: Sequence[str], labelvalues: Sequence[str], extra: str = "") -> str:
+    parts = [
+        f'{n}="{_escape_label(v)}"' for n, v in zip(labelnames, labelvalues)
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got "
+                f"{tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def render(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def per_label(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            out.append(
+                f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(v)}"
+            )
+        return out
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            out.append(
+                f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(v)}"
+            )
+        return out
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, labelnames)
+        b = sorted(float(x) for x in buckets)
+        if not b or b[-1] != math.inf:
+            b.append(math.inf)
+        self.buckets = tuple(b)
+        # per label-set: [bucket counts...], sum, count
+        self._counts: Dict[Tuple[str, ...], List[float]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], float] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0.0] * len(self.buckets)
+                self._sums[key] = 0.0
+                self._totals[key] = 0.0
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+                    break
+            self._sums[key] += float(value)
+            self._totals[key] += 1
+
+    def count(self, **labels) -> float:
+        with self._lock:
+            return self._totals.get(self._key(labels), 0.0)
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            return self._sums.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        for key, counts in items:
+            cum = 0.0
+            for ub, c in zip(self.buckets, counts):
+                cum += c
+                le = "+Inf" if math.isinf(ub) else repr(ub)
+                le_label = 'le="%s"' % le
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.labelnames, key, le_label)} "
+                    f"{_fmt_value(cum)}"
+                )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(self.labelnames, key)} "
+                f"{_fmt_value(sums[key])}"
+            )
+            out.append(
+                f"{self.name}_count{_fmt_labels(self.labelnames, key)} "
+                f"{_fmt_value(totals[key])}"
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Name → instrument registry. ``counter/gauge/histogram`` are
+    idempotent by name (re-registration returns the existing instrument —
+    module-level meters and tests can both ask for the same metric)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw) -> _Instrument:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is not None:
+                if not isinstance(inst, cls) or inst.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{inst.kind}{inst.labelnames}"
+                    )
+                return inst
+            inst = cls(name, help, labelnames, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._instruments.get(name)
+
+    def instruments(self) -> Iterable[_Instrument]:
+        with self._lock:
+            return list(self._instruments.values())
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4 (trailing newline
+        included, as the spec requires)."""
+        lines: List[str] = []
+        for inst in sorted(self.instruments(), key=lambda i: i.name):
+            lines.extend(inst.render())
+        return "\n".join(lines) + "\n"
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._instruments.pop(name, None)
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry the Prometheus exporter serves."""
+    return _GLOBAL

@@ -1,0 +1,101 @@
+"""Stdlib-only Prometheus exporter — a ``/metrics`` text-exposition
+endpoint over ``http.server``, off by default (CLI flag ``--prom_port``).
+
+No prometheus_client dependency: the registry (telemetry/metrics.py)
+renders the text format itself. The server runs on a daemon thread and
+binds loopback by default — an experiment driver is not a public service;
+point a Prometheus scrape job (or ``curl``) at
+``http://127.0.0.1:<port>/metrics``. ``port=0`` binds an ephemeral port
+(tests read ``exporter.port`` after ``start()``)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry  # injected per-server subclass
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path.split("?", 1)[0] in ("/metrics", "/"):
+            body = self.registry.render().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/healthz":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.end_headers()
+            self.wfile.write(b"ok\n")
+        else:
+            self.send_error(404)
+
+    def log_message(self, fmt, *args):  # silence per-scrape stderr lines
+        pass
+
+
+class PrometheusExporter:
+    """``PrometheusExporter(port=9464).start()`` …  ``.stop()``."""
+
+    def __init__(
+        self,
+        port: int = 9464,
+        addr: str = "127.0.0.1",
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.addr = addr
+        self._requested_port = int(port)
+        self.registry = registry or get_registry()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        """The actually-bound port (meaningful after start(), esp. port=0)."""
+        if self._server is not None:
+            return self._server.server_address[1]
+        return self._requested_port
+
+    def start(self) -> "PrometheusExporter":
+        if self._server is not None:
+            return self
+        registry = self.registry
+
+        class Handler(_Handler):
+            pass
+
+        Handler.registry = registry
+        self._server = ThreadingHTTPServer(
+            (self.addr, self._requested_port), Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="fedml-prometheus-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "PrometheusExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

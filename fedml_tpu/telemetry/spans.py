@@ -1,0 +1,269 @@
+"""Zero-dependency structured tracer — the host-side half of the profiling
+story (the device half is ``jax.profiler`` via ``utils/profiling.trace``).
+
+A span is a named wall-clock interval with attributes::
+
+    with span("round", round=3):
+        with span("broadcast", round=3):
+            ...
+
+Spans are thread-safe and nestable; each thread keeps its own nesting stack
+(parent attribution), and the recording buffer is shared so one trace file
+covers the server FSM thread, the client actor threads, and timer threads.
+
+The export format is Chrome trace events (the ``traceEvents`` JSON that
+Perfetto / ``chrome://tracing`` load natively), with complete ("X") events
+in epoch-anchored microseconds — the same timebase the jax profiler uses,
+so a host trace from ``--telemetry_dir`` can be viewed side by side with a
+device trace from ``--profile_dir`` and correlated by wall clock.
+
+Cross-thread spans (a federated "round" begins on the broadcast path and
+ends in a receive handler on another thread) use the explicit handle API::
+
+    s = tracer.start_span("round", round=r)   # on the broadcast thread
+    ...
+    s.end()                                   # on the handler thread
+
+Listeners subscribe to finished spans (``tracer.add_listener``) — the
+client health registry feeds on ``local_train`` spans this way."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# Bounded recording: a month-long run must not grow the event buffer without
+# limit. Past the cap, new events are dropped and counted.
+DEFAULT_MAX_EVENTS = 1_000_000
+
+
+class SpanEvent:
+    """One finished span: name, epoch-anchored start (us), duration (us),
+    recording thread id, and user attributes."""
+
+    __slots__ = ("name", "ts_us", "dur_us", "pid", "tid", "attrs")
+
+    def __init__(self, name: str, ts_us: float, dur_us: float, pid: int, tid: int, attrs: Dict[str, Any]):
+        self.name = name
+        self.ts_us = ts_us
+        self.dur_us = dur_us
+        self.pid = pid
+        self.tid = tid
+        self.attrs = attrs
+
+    def to_chrome(self) -> dict:
+        return {
+            "name": self.name,
+            "ph": "X",
+            "ts": self.ts_us,
+            "dur": self.dur_us,
+            "pid": self.pid,
+            "tid": self.tid,
+            "cat": "fedml_tpu",
+            "args": self.attrs,
+        }
+
+    def __repr__(self):  # debugging aid, not part of the wire format
+        return (
+            f"SpanEvent({self.name!r}, dur={self.dur_us / 1e3:.3f}ms, "
+            f"attrs={self.attrs})"
+        )
+
+
+class Span:
+    """A live span handle. Created by ``Tracer.start_span`` / ``Tracer.span``;
+    ``end()`` is idempotent and may be called from any thread."""
+
+    __slots__ = (
+        "_tracer", "name", "attrs", "_t0_perf", "_ts_us", "_done", "_tid",
+        "_end_lock",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self._ts_us = tracer._now_us()
+        self._t0_perf = time.perf_counter_ns()
+        self._done = False
+        self._end_lock = threading.Lock()
+        self._tid = threading.get_ident()
+
+    def set_attr(self, key: str, value: Any) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def end(self) -> Optional[SpanEvent]:
+        # atomic test-and-set: end() may race from two threads (e.g. a
+        # timeout path vs the handler that completes the round) and must
+        # record exactly once
+        with self._end_lock:
+            if self._done:
+                return None
+            self._done = True
+        dur_us = (time.perf_counter_ns() - self._t0_perf) / 1e3
+        ev = SpanEvent(
+            self.name,
+            self._ts_us,
+            dur_us,
+            os.getpid(),
+            threading.get_ident(),
+            self.attrs,
+        )
+        self._tracer._record(ev)
+        return ev
+
+    def __enter__(self) -> "Span":
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tracer._pop(self)
+        self.end()
+
+
+class Tracer:
+    """Thread-safe span recorder with a bounded buffer and span listeners."""
+
+    def __init__(self, max_events: int = DEFAULT_MAX_EVENTS):
+        self._events: List[SpanEvent] = []
+        self._lock = threading.Lock()
+        self._listeners: List[Callable[[SpanEvent], None]] = []
+        self._local = threading.local()
+        self.max_events = int(max_events)
+        self.dropped = 0
+        # epoch anchor: ts = wall clock at init + monotonic delta since,
+        # so timestamps are comparable across processes (and with the jax
+        # device trace) but never jump with NTP adjustments mid-run
+        self._epoch_us = time.time() * 1e6
+        self._anchor_ns = time.perf_counter_ns()
+        self.process_label: Optional[str] = None
+
+    # -- time --
+    def _now_us(self) -> float:
+        return self._epoch_us + (time.perf_counter_ns() - self._anchor_ns) / 1e3
+
+    # -- nesting stack (per thread, parent attribution) --
+    def _stack(self) -> List[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _push(self, s: Span) -> None:
+        st = self._stack()
+        if st:
+            s.attrs.setdefault("parent", st[-1].name)
+        s.attrs.setdefault("depth", len(st))
+        st.append(s)
+
+    def _pop(self, s: Span) -> None:
+        st = self._stack()
+        if st and st[-1] is s:
+            st.pop()
+        elif s in st:  # mis-nested exit — drop it and everything above
+            del st[st.index(s):]
+
+    # -- recording --
+    def _record(self, ev: SpanEvent) -> None:
+        with self._lock:
+            if len(self._events) < self.max_events:
+                self._events.append(ev)
+            else:
+                self.dropped += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001 — a listener must never break training
+                import logging
+
+                logging.exception("telemetry span listener failed")
+
+    # -- public API --
+    def span(self, name: str, **attrs) -> Span:
+        """Context-manager span (nested via the calling thread's stack)."""
+        return Span(self, name, attrs)
+
+    def start_span(self, name: str, **attrs) -> Span:
+        """Explicit-handle span for intervals that end on another thread
+        (no nesting-stack participation)."""
+        return Span(self, name, attrs)
+
+    def add_listener(self, fn: Callable[[SpanEvent], None]) -> None:
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[SpanEvent], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def events(self) -> List[SpanEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    # -- export --
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON object (Perfetto / chrome://tracing)."""
+        events = [ev.to_chrome() for ev in self.events()]
+        # thread/process name metadata makes the Perfetto track labels human
+        meta = []
+        pid = os.getpid()
+        label = self.process_label or f"fedml_tpu pid {pid}"
+        meta.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": label},
+            }
+        )
+        for tid in sorted({e["tid"] for e in events}):
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": f"thread-{tid}"},
+                }
+            )
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": self.dropped},
+        }
+
+    def write_chrome_trace(self, path: str) -> str:
+        """Write the trace JSON; returns the path written. Creates parent
+        directories, so call sites can pass the CLI flag straight through."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer every subsystem records into by default."""
+    return _GLOBAL
+
+
+def span(name: str, **attrs) -> Span:
+    """``with span("round", round=n): ...`` on the global tracer."""
+    return _GLOBAL.span(name, **attrs)

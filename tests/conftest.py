@@ -18,11 +18,22 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
-# Persistent compilation cache: recompiles (not the math) dominate suite
-# latency (VERDICT r1 weak #6); repeated runs hit the disk cache instead.
-jax.config.update("jax_compilation_cache_dir", "/tmp/fedml_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+# Persistent compilation cache — CONSERVATIVE settings on purpose. The old
+# aggressive config (min_entry_size=-1, min_compile_time=0.3) cached every
+# tiny program and CORRUPTED THE HEAP on this container's jaxlib+CPU stack:
+# cold-cache suite runs flaked ~40% with wrong resume numerics (a restored
+# model evaluating at chance), `free(): invalid pointer` / segfaults at
+# exit, and fatal "Garbage-collecting" aborts mid-run (the DARTS unrolled
+# trace and the jax.profiler TF import were the usual victims — they are
+# just the next malloc-heavy phase after the corruption). With the cache
+# fully off the same repro loops ran clean 6/6 — but the fast tier then
+# recompiles everything and blows the tier-1 time budget. Caching only
+# slow-to-compile programs (>= 2 s) keeps the big wins (fused chunks,
+# second-order DARTS, attention stacks) with none of the tiny-entry churn
+# that reproduced the corruption; detector loops (the resume tests and the
+# abort-prone file combo) ran clean under this config.
+jax.config.update("jax_compilation_cache_dir", "/tmp/fedml_tpu_jax_cache_v2")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
 def pytest_configure(config):

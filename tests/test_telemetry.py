@@ -1,0 +1,407 @@
+"""Telemetry subsystem tests: span nesting/thread-safety, Chrome-trace
+validity, Prometheus exposition (scraped and parsed in-test), comm-layer
+byte/message accounting over the loopback and shm transports, the client
+health registry, and the CLI --telemetry_dir end-to-end contract."""
+
+import json
+import queue
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fedml_tpu.telemetry import (
+    ClientHealthRegistry,
+    PrometheusExporter,
+    get_comm_meter,
+    get_tracer,
+)
+from fedml_tpu.telemetry.metrics import MetricsRegistry
+from fedml_tpu.telemetry.spans import Tracer
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_records_parent_and_depth():
+    tr = Tracer()
+    with tr.span("round", round=0):
+        with tr.span("broadcast", round=0):
+            pass
+        with tr.span("local_train", client=1, round=0):
+            pass
+    evs = {e.name: e for e in tr.events()}
+    assert set(evs) == {"round", "broadcast", "local_train"}
+    assert evs["broadcast"].attrs["parent"] == "round"
+    assert evs["broadcast"].attrs["depth"] == 1
+    assert evs["round"].attrs["depth"] == 0
+    # children recorded before the parent finishes, and nested in time
+    assert evs["broadcast"].ts_us >= evs["round"].ts_us
+    assert evs["broadcast"].dur_us <= evs["round"].dur_us
+
+
+def test_span_thread_safety_no_cross_thread_nesting():
+    """N threads × M spans each: every span records, and nesting stacks are
+    thread-local (no thread sees another thread's span as its parent)."""
+    tr = Tracer()
+    N, M = 8, 50
+
+    def worker(tid):
+        for i in range(M):
+            with tr.span("outer", thread=tid, i=i):
+                with tr.span("inner", thread=tid, i=i):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = tr.events()
+    assert len(evs) == N * M * 2
+    for e in evs:
+        if e.name == "inner":
+            assert e.attrs["parent"] == "outer"
+
+
+def test_cross_thread_span_handle():
+    """A round span can begin on one thread and end on another (the server
+    FSM broadcast → receive-handler pattern)."""
+    tr = Tracer()
+    s = tr.start_span("round", round=7)
+    done = threading.Event()
+
+    def closer():
+        s.end()
+        done.set()
+
+    threading.Thread(target=closer).start()
+    assert done.wait(5)
+    (ev,) = tr.events()
+    assert ev.name == "round" and ev.attrs["round"] == 7
+    assert s.end() is None  # idempotent
+
+
+def test_chrome_trace_json_is_valid_and_loadable(tmp_path):
+    tr = Tracer()
+    with tr.span("round", round=0):
+        pass
+    path = str(tmp_path / "sub" / "trace.json")
+    tr.write_chrome_trace(path)
+    doc = json.load(open(path))
+    assert "traceEvents" in doc
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    (ev,) = xs
+    for key in ("name", "ts", "dur", "pid", "tid", "cat", "args"):
+        assert key in ev
+    assert ev["name"] == "round" and ev["args"]["round"] == 0
+    # metadata events label the process and every thread
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["name"] for m in metas} >= {"process_name", "thread_name"}
+
+
+def test_tracer_bounded_buffer_counts_drops():
+    tr = Tracer(max_events=3)
+    for i in range(5):
+        with tr.span("s", i=i):
+            pass
+    assert len(tr.events()) == 3
+    assert tr.dropped == 2
+    assert tr.to_chrome_trace()["otherData"]["dropped_events"] == 2
+
+
+def test_span_listener_sees_finished_spans_and_errors_are_contained():
+    tr = Tracer()
+    seen = []
+
+    def bad_listener(ev):
+        raise RuntimeError("listener bug")
+
+    tr.add_listener(bad_listener)
+    tr.add_listener(lambda ev: seen.append(ev.name))
+    with tr.span("local_train", client=0, round=0):
+        pass  # must not raise despite the broken listener
+    assert seen == ["local_train"]
+
+
+# ---------------------------------------------------------------------------
+# metrics + prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_exposition_scrape_and_parse():
+    reg = MetricsRegistry()
+    c = reg.counter("t_messages_total", "msgs", ("msg_type",))
+    g = reg.gauge("t_clients_seen", "clients")
+    h = reg.histogram("t_latency_seconds", "lat", buckets=(0.1, 1.0))
+    c.inc(3, msg_type="s2c_sync")
+    g.set(5)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(7.0)
+    with PrometheusExporter(port=0, registry=reg) as ex:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/metrics", timeout=10
+        ).read().decode()
+    lines = [l for l in body.splitlines() if l and not l.startswith("#")]
+    parsed = {}
+    for line in lines:
+        name_labels, value = line.rsplit(" ", 1)
+        parsed[name_labels] = float(value)
+    assert parsed['t_messages_total{msg_type="s2c_sync"}'] == 3.0
+    assert parsed["t_clients_seen"] == 5.0
+    # cumulative buckets: 0.1 holds 1, 1.0 holds 2, +Inf holds all 3
+    assert parsed['t_latency_seconds_bucket{le="0.1"}'] == 1.0
+    assert parsed['t_latency_seconds_bucket{le="1.0"}'] == 2.0
+    assert parsed['t_latency_seconds_bucket{le="+Inf"}'] == 3.0
+    assert parsed["t_latency_seconds_count"] == 3.0
+    assert abs(parsed["t_latency_seconds_sum"] - 7.55) < 1e-9
+    # TYPE lines present for every family
+    assert "# TYPE t_messages_total counter" in body
+    assert "# TYPE t_latency_seconds histogram" in body
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("t_neg_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        c.inc(-1, a="b")
+    with pytest.raises(ValueError):
+        c.inc(1, wrong="b")
+    # idempotent re-registration returns the same instrument
+    assert reg.counter("t_neg_total", "x", ("a",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("t_neg_total", "x", ("a",))
+
+
+# ---------------------------------------------------------------------------
+# comm accounting over real transports
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip_message():
+    """A model-carrying message with a deterministic wire size."""
+    from fedml_tpu.core.message import Message
+
+    msg = Message("s2c_sync", 0, 1)
+    msg.add_params(
+        "model_params", {"w": np.ones((64, 32), np.float32), "b": np.zeros(32, np.float32)}
+    )
+    msg.add_params("round_idx", 3)
+    return msg
+
+
+def _delta(before, after):
+    out = {}
+    for k in after:
+        d = {
+            t: after[k].get(t, 0) - before.get(k, {}).get(t, 0)
+            for t in after[k]
+        }
+        out[k] = {t: v for t, v in d.items() if v}
+    return out
+
+
+def _drain_one(comm):
+    """Run one receive loop until stopped; returns received messages."""
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    comm.add_observer(Obs())
+    th = threading.Thread(target=comm.handle_receive_message, daemon=True)
+    th.start()
+    return got, th
+
+
+def test_comm_counters_loopback():
+    from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+
+    meter = get_comm_meter()
+    before = meter.snapshot()
+    hub = LoopbackHub()
+    a, b = LoopbackCommManager(hub, 0), LoopbackCommManager(hub, 1)
+    got, th = _drain_one(b)
+    msg = _roundtrip_message()
+    a.send_message(msg)
+    deadline = time.monotonic() + 10
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    b.stop_receive_message()
+    th.join(timeout=10)
+    d = _delta(before, meter.snapshot())
+    assert d["messages_sent"]["s2c_sync"] == 1
+    assert d["messages_received"]["s2c_sync"] == 1
+    # bytes observed by the meter == the envelope's own serialized size,
+    # up and down (loopback ships the exact wire image)
+    assert d["bytes_sent"]["s2c_sync"] == msg.wire_size()
+    assert d["bytes_received"]["s2c_sync"] == msg.wire_size()
+
+
+def test_comm_counters_shm():
+    from fedml_tpu.core.shm_comm import ShmCommManager
+
+    meter = get_comm_meter()
+    before = meter.snapshot()
+    with tempfile.TemporaryDirectory(prefix="fedml_tel_shm_") as d:
+        a = ShmCommManager(0, d)
+        b = ShmCommManager(1, d)
+        got, th = _drain_one(b)
+        msg = _roundtrip_message()
+        a.send_message(msg)
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        b.stop_receive_message()
+        th.join(timeout=10)
+        a.stop_receive_message()
+    dd = _delta(before, meter.snapshot())
+    assert dd["messages_sent"]["s2c_sync"] == 1
+    assert dd["messages_received"]["s2c_sync"] == 1
+    assert dd["bytes_sent"]["s2c_sync"] == msg.wire_size()
+    assert dd["bytes_received"]["s2c_sync"] == msg.wire_size()
+
+
+# ---------------------------------------------------------------------------
+# client health registry
+# ---------------------------------------------------------------------------
+
+
+def test_health_registry_participation_and_straggler_decile():
+    reg = MetricsRegistry()
+    h = ClientHealthRegistry(registry=reg)
+    # 9 fast clients, 1 slow one, 5 rounds each
+    for r in range(5):
+        for cid in range(9):
+            h.observe_train(cid, r, 0.1)
+        h.observe_train(9, r, 2.0)
+    assert h.clients_seen() == list(range(10))
+    assert h.last_seen_round(9) == 4
+    assert h.rounds_participated(3) == 5
+    assert h.mean_train_s(9) == pytest.approx(2.0)
+    assert h.straggler_ids() == [9]
+    assert h.is_straggler(9) and not h.is_straggler(0)
+    snap = h.snapshot()
+    assert snap["9"]["straggler"] is True
+    assert snap["0"]["rounds_participated"] == 5
+    assert reg.get("fedml_clients_seen").value() == 10
+    assert reg.get("fedml_clients_straggler_count").value() == 1
+
+
+def test_health_registry_homogeneous_fleet_has_no_stragglers():
+    h = ClientHealthRegistry(registry=MetricsRegistry())
+    for r in range(4):
+        for cid in range(8):
+            # small jitter — someone is always "slowest", nobody straggles
+            h.observe_train(cid, r, 0.1 + 0.001 * cid)
+    assert h.straggler_ids() == []
+
+
+def test_health_registry_dedupes_span_and_server_observations():
+    h = ClientHealthRegistry(registry=MetricsRegistry())
+    assert h.observe_train(1, 0, 0.5) is True
+    # the server-side round-trip for the same (client, round) is ignored
+    assert h.observe_train(1, 0, 0.9) is False
+    assert h.rounds_participated(1) == 1
+    assert h.mean_train_s(1) == pytest.approx(0.5)
+
+
+def test_health_registry_feeds_on_local_train_spans():
+    tr = Tracer()
+    h = ClientHealthRegistry(registry=MetricsRegistry()).attach(tr)
+    with tr.span("local_train", client=4, round=2):
+        time.sleep(0.01)
+    with tr.span("unrelated", client=4, round=3):
+        pass
+    assert h.clients_seen() == [4]
+    assert h.last_seen_round(4) == 2
+    assert h.mean_train_s(4) >= 0.01
+    h.detach()
+    with tr.span("local_train", client=5, round=0):
+        pass
+    assert 5 not in h.clients_seen()
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_loopback_telemetry_dir_end_to_end(tmp_path):
+    """3-round loopback FedAvg with --telemetry_dir: the Chrome trace parses
+    and carries round/broadcast/aggregate spans for EVERY round, the health
+    registry saw every client, and summary.json carries the comm totals."""
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    tdir = tmp_path / "telemetry"
+    ldir = tmp_path / "logs"
+    result = CliRunner().invoke(
+        main,
+        [
+            "--algorithm", "fedavg", "--runtime", "loopback",
+            "--model", "lr", "--dataset", "synthetic",
+            "--client_num_in_total", "4", "--client_num_per_round", "4",
+            "--comm_round", "3", "--batch_size", "8",
+            "--telemetry_dir", str(tdir), "--log_dir", str(ldir),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    doc = json.load(open(tdir / "trace.json"))
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    rounds_of = lambda name: sorted(
+        e["args"]["round"] for e in spans if e["name"] == name
+    )
+    assert rounds_of("round") == [0, 1, 2]
+    assert rounds_of("broadcast") == [0, 1, 2]
+    assert rounds_of("aggregate") == [0, 1, 2]
+    # every client trained every round (full participation), visible both
+    # as local_train spans and in the health registry
+    health = json.load(open(tdir / "health.json"))
+    assert sorted(health) == ["0", "1", "2", "3"]
+    for rec in health.values():
+        assert rec["rounds_participated"] == 3
+        assert rec["last_seen_round"] == 2
+    summary = json.load(open(ldir / "summary.json"))
+    assert summary["telemetry/comm_messages_sent"] > 0
+    assert summary["telemetry/comm_bytes_sent"] > 0
+    # loopback delivers exactly what was sent
+    assert (
+        summary["telemetry/comm_bytes_received"]
+        == summary["telemetry/comm_bytes_sent"]
+    )
+
+
+def test_cli_vmap_telemetry_round_spans(tmp_path):
+    """The single-chip simulator runtime also records the round lifecycle
+    (round/broadcast/local_train/eval) and a health registry."""
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    tdir = tmp_path / "telemetry"
+    get_tracer().reset()
+    result = CliRunner().invoke(
+        main,
+        [
+            "--algorithm", "fedavg", "--model", "lr",
+            "--dataset", "synthetic",
+            "--client_num_in_total", "4", "--client_num_per_round", "2",
+            "--comm_round", "2", "--batch_size", "8",
+            "--telemetry_dir", str(tdir),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    doc = json.load(open(tdir / "trace.json"))
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert {"round", "broadcast", "local_train", "eval"} <= names
+    health = json.load(open(tdir / "health.json"))
+    assert len(health) >= 2  # round-seeded sampling picked cohorts
