@@ -85,6 +85,35 @@ print(f"  telemetry ok: {len(spans)} spans, "
 PY
 rm -rf "$TELDIR"
 
+echo "== scheduler smoke: power-of-choice + fault-injected quorum rounds =="
+SCHEDDIR=$(mktemp -d)
+python -m fedml_tpu --algorithm fedavg --runtime loopback --model lr \
+  --dataset synthetic --client_num_in_total 6 --client_num_per_round 3 \
+  --comm_round 3 --batch_size 8 --selection power_of_choice \
+  --deadline_s 2 --min_clients 2 \
+  --fault_plan '{"seed": 1, "clients": {"1": {"dropout_p": 1.0}}}' \
+  --log_dir "$SCHEDDIR/logs" --telemetry_dir "$SCHEDDIR" > /dev/null
+python - "$SCHEDDIR" <<'PY'
+import json, sys
+tdir = sys.argv[1]
+summary = json.load(open(f"{tdir}/logs/summary.json"))
+# summary.json records the selected-client set and the injected faults
+assert summary["scheduler/policy"] == "power_of_choice", summary
+sel = summary["scheduler/selected"]
+assert isinstance(sel, list) and len(sel) == 3, sel
+assert summary["faults/dropouts"] >= 1, summary
+assert summary["faults/total"] == summary["faults/dropouts"], summary
+health = json.load(open(f"{tdir}/health.json"))
+dropped = {c: r["faults"] for c, r in health.items() if r.get("faults")}
+assert dropped.get("1", {}).get("dropout", 0) >= 1, health
+doc = json.load(open(f"{tdir}/trace.json"))
+kinds = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+assert {"select", "fault"} <= kinds, kinds
+print(f"  scheduler ok: selected {sel}, "
+      f"{int(summary['faults/dropouts'])} injected dropouts survived via quorum")
+PY
+rm -rf "$SCHEDDIR"
+
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 

@@ -45,17 +45,14 @@ def weighted_average(stacked_tree, weights):
 def client_sampling(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> np.ndarray:
     """Round-seeded sampling for reproducibility — exact parity with
     FedAVGAggregator.py:80-88 (np.random.seed(round_idx) then choice without
-    replacement)."""
-    if client_num_per_round > client_num_in_total:
-        raise ValueError(
-            f"client_num_per_round={client_num_per_round} exceeds "
-            f"client_num_in_total={client_num_in_total}"
-        )
-    if client_num_in_total == client_num_per_round:
-        return np.arange(client_num_in_total)
-    np.random.seed(round_idx)
-    return np.random.choice(
-        range(client_num_in_total), client_num_per_round, replace=False
+    replacement). Back-compat shim: the implementation now lives in the
+    scheduler registry as the ``uniform`` policy
+    (fedml_tpu/scheduler/policies.py); this delegates so every historical
+    import keeps the exact reference semantics."""
+    from fedml_tpu.scheduler import select_clients
+
+    return select_clients(
+        round_idx, client_num_in_total, client_num_per_round, policy="uniform"
     )
 
 
@@ -396,6 +393,30 @@ class FedAvgAPI:
         # the transport runtimes refine timing per client.
         self._tracer = get_tracer()
         self.health = ClientHealthRegistry()
+        # Scheduler: policy-driven cohort selection (FedConfig.selection /
+        # .overprovision_factor, scheduler/policies.py). It shares this
+        # API's health registry (straggler_aware consults the straggler
+        # flags) and forwards every fresh decision into log_fn, so
+        # summary.json records the selected cohort (the CI oracle).
+        from fedml_tpu.scheduler import ClientScheduler, FaultInjector
+
+        self.scheduler = ClientScheduler.from_config(
+            config,
+            num_clients=data.num_clients,
+            data=data,
+            log_fn=self.log_fn,
+            health=self.health,
+            tracer=self._tracer,
+        )
+        # Fault injection (FedConfig.fault_plan): the vmap cohort trains
+        # as ONE jitted program, so only participation faults apply here —
+        # dropout/crash remove the client from the cohort at selection
+        # time (see _apply_participation_faults); timing faults are
+        # transport-only.
+        self.faults = FaultInjector.from_config(
+            config, health=self.health, tracer=self._tracer
+        )
+        self._fault_cache: dict = {}  # round -> post-fault survivors
         self._store = None
         if self._use_device_store and config.data.device_cache:
             from fedml_tpu.data.device_store import DeviceDataStore, fits_on_device
@@ -640,16 +661,53 @@ class FedAvgAPI:
         return plan
 
     def _sample_clients(self, round_idx: int) -> np.ndarray:
-        """This round's cohort draw. The default is the reference-parity
-        round-seeded fixed-size draw (:func:`client_sampling`) — deterministic
-        by design, so runs are reproducible and resumable. Algorithms whose
-        GUARANTEES depend on the randomness of participation override this
-        (DP-FedAvg draws Poisson cohorts from a run-seeded secret stream:
-        privacy amplification by subsampling is void if the adversary can
-        predict who participated — privacy/dp_fedavg.py)."""
-        return client_sampling(
-            round_idx, self.data.num_clients, self.config.fed.client_num_per_round
-        )
+        """This round's cohort draw, via the scheduler registry
+        (FedConfig.selection; the default ``uniform`` policy is the
+        reference-parity round-seeded fixed-size draw) — deterministic by
+        design, so runs are reproducible and resumable, minus any clients
+        the fault plan removes. Algorithms whose GUARANTEES depend on the
+        randomness of participation override this (DP-FedAvg draws Poisson
+        cohorts from a run-seeded secret stream: privacy amplification by
+        subsampling is void if the adversary can predict who participated
+        — privacy/dp_fedavg.py)."""
+        sel = self.scheduler.select(round_idx)
+        if self.faults is not None:
+            sel = self._apply_participation_faults(sel, round_idx)
+        return sel
+
+    def _apply_participation_faults(self, selected, round_idx: int) -> np.ndarray:
+        """Simulator fault semantics (scheduler/faults.py): dropout/crash
+        remove the client from the cohort before batching. Memoized per
+        round — the chunk planner, train loop, and metric flush all
+        re-derive the cohort, and the injector's counters must count each
+        fault once. At least one survivor is kept so the round's jitted
+        shapes stay well-formed."""
+        r = int(round_idx)
+        cached = self._fault_cache.get(r)
+        if cached is not None:
+            return cached
+        decisions = [(int(cid), self.faults.decide(int(cid), r)) for cid in selected]
+        survivors = [cid for cid, d in decisions if d.participates]
+        spared = None
+        if not survivors:
+            # every selected client faulted: spare the first one so the
+            # round stays well-formed — and do NOT record a fault for it
+            # (it actually trains; accounting must describe what ran)
+            spared = int(selected[0])
+            survivors = [spared]
+            import logging
+
+            logging.warning(
+                "fault plan removed the ENTIRE round-%d cohort; sparing "
+                "client %d so the round stays well-formed", r, spared,
+            )
+        for cid, d in decisions:
+            if cid == spared or d.participates:
+                continue
+            self.faults.record(cid, r, "crash" if d.crashed else "dropout")
+        out = np.asarray(survivors, np.int64)
+        self._fault_cache[r] = out
+        return out
 
     def _round_steps_class(self, round_idx: int):
         """(steps, bs) bucket of one round's sampled cohort — the jit-shape
@@ -677,6 +735,20 @@ class FedAvgAPI:
             # full-batch mode sets bs = max client size, which varies per
             # round — chunks can't share one (steps, bs) shape
             or cfg.data.batch_size == -1
+            # adaptive policies feed on per-round signals (reported losses,
+            # straggler flags): the chunk planner derives cohorts AHEAD of
+            # execution, which would freeze those signals at planning time
+            # and make selection depend on fused_rounds — eager rounds keep
+            # the feedback loop per-round (scheduler determinism contract)
+            or cfg.fed.selection in ("power_of_choice", "straggler_aware")
+            # participation faults shrink cohorts per round: rounds of size
+            # k and k-1 share a (steps, bs) class but not a client-axis
+            # size, and train_rounds_fused stacks per-round index matrices
+            # into one [T, C, cap] array — a ragged C would crash mid-run
+            or (
+                self.faults is not None
+                and self.faults.plan.has_participation_faults()
+            )
         ):
             return 1
         L = min(cfg.fed.fused_rounds, cfg.fed.comm_round - round_idx)
@@ -792,6 +864,12 @@ class FedAvgAPI:
             "Train/Acc": float(metrics["correct"]) / max(count, 1e-9),
             "round_time_s": round_time_s,
         }
+        # feed power_of_choice: the vmap cohort trains as ONE program, so
+        # the only per-round loss signal here is the cohort mean — report
+        # it to every participant (the transport runtimes report true
+        # per-client losses off the upload messages instead)
+        for cid in self._round_plan(round_idx)[0]:
+            self.scheduler.report_loss(int(cid), row["Train/Loss"])
         if self._is_eval_round(round_idx):
             with self._tracer.span("eval", round=round_idx):
                 if cfg.fed.eval_on_clients:

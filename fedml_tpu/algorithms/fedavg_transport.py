@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fedml_tpu.algorithms.fedavg import client_sampling, weighted_average
+from fedml_tpu.algorithms.fedavg import weighted_average
 from fedml_tpu.config import RunConfig
 from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
 from fedml_tpu.core.comm import BaseCommManager
@@ -99,6 +99,9 @@ class LocalTrainer:
         # local training (a slow phone among fast ones). Drives the
         # straggler/async benchmarks; 0 = off.
         self.straggle_s = float(straggle_s)
+        # last local mean train loss — attached to the upload message so
+        # the server can feed power_of_choice selection (scheduler/)
+        self.last_loss: Optional[float] = None
 
     def update_dataset(self, client_index: int):
         self.client_index = int(client_index)
@@ -123,7 +126,7 @@ class LocalTrainer:
         rng = jax.random.fold_in(
             jax.random.PRNGKey(cfg.seed), (round_idx + 1) * 7919 + self.client_index
         )
-        new_vars, _ = self.local_train(
+        new_vars, m = self.local_train(
             variables,
             jnp.asarray(batch.x[0]),
             jnp.asarray(batch.y[0]),
@@ -132,6 +135,12 @@ class LocalTrainer:
         )
         n = len(self.data.client_y[self.client_index])
         out = jax.device_get(new_vars)
+        try:
+            self.last_loss = float(
+                np.asarray(m["loss_sum"])
+            ) / max(float(np.asarray(m["count"])), 1e-9)
+        except (KeyError, TypeError):  # custom local_train_fn metric shape
+            self.last_loss = None
         if self.straggle_s:
             time.sleep(self.straggle_s)
         return out, n
@@ -198,6 +207,26 @@ class FedAvgServerManager(ServerManager):
         self._round_lock = threading.Lock()
         self._deadline_timer: Optional[threading.Timer] = None
         self._deadline_passed = False
+        # Stalled-round abandonment: a round can sit below quorum FOREVER
+        # when every sampled client crashed/dropped — reachable on purpose
+        # under a participation-fault plan, and ONLY then (without one,
+        # every sampled client eventually uploads, and the legacy
+        # semantics — close on the quorum-th upload whenever it arrives,
+        # however late past the deadline — must stay untouched: a cold
+        # first-round jit compile can outlast several deadline_s). With
+        # the valve armed, each below-quorum deadline re-arms the timer;
+        # after 3 consecutive firings with NO new upload the round is
+        # abandoned with whatever arrived (possibly nothing — the model
+        # then carries over unchanged), loudly, instead of hanging.
+        from fedml_tpu.scheduler import FaultPlan
+
+        _plan = FaultPlan.from_config(config)
+        self._stall_valve = (
+            _plan is not None and _plan.has_participation_faults()
+        )
+        self._stall_last_count = -1
+        self._stall_strikes = 0
+        self.abandoned_rounds = 0
         self.dropped_uploads = 0  # late round-tagged uploads discarded
         self._dead_workers: set = set()  # peers whose broadcasts failed
         self.deadline_error: Optional[BaseException] = None
@@ -219,6 +248,22 @@ class FedAvgServerManager(ServerManager):
         self.health = ClientHealthRegistry().attach(self._tracer)
         self._round_span = None
         self._assigned: Dict[int, tuple] = {}  # worker -> (client_idx, t_bcast)
+        # Scheduler: the SAME policy driver the vmap simulator uses
+        # (scheduler/policies.py), so both runtimes select byte-identical
+        # cohorts from one config — a test contract. The server passes its
+        # worker_num as the final k (run_federation already provisions one
+        # worker per overprovisioned slot); straggler_aware feeds on this
+        # health registry, power_of_choice on the uploads' train losses.
+        from fedml_tpu.scheduler import ClientScheduler
+
+        self.scheduler = ClientScheduler.from_config(
+            config,
+            num_clients=config.fed.client_num_in_total,
+            data=data,
+            log_fn=self.log_fn,
+            health=self.health,
+            tracer=self._tracer,
+        )
 
     def finish(self):
         # stop feeding the health registry from the global span stream —
@@ -259,9 +304,7 @@ class FedAvgServerManager(ServerManager):
     def send_init_msg(self):
         """Sample round-0 clients, broadcast w0 (ref send_init_msg :20-28)."""
         self._t0 = time.monotonic()
-        sampled = client_sampling(
-            0, self.config.fed.client_num_in_total, self.worker_num
-        )
+        sampled = self.scheduler.select(0, k=self.worker_num)
         self._round_span = self._tracer.start_span("round", round=0)
         with self._tracer.span("broadcast", round=0):
             for worker, client_idx in enumerate(sampled, start=1):
@@ -372,6 +415,8 @@ class FedAvgServerManager(ServerManager):
         if not dl:
             return
         self._deadline_passed = False
+        self._stall_last_count = -1
+        self._stall_strikes = 0
         # round generation captured at arm time: cancel() cannot stop a
         # callback already blocked on _round_lock, so a stale timer must
         # recognise that its round has already completed
@@ -414,13 +459,49 @@ class FedAvgServerManager(ServerManager):
                     return
                 if self._received_count() >= self._quorum():
                     self._complete_round()
+                    return
+                if not self._stall_valve:
+                    # legacy semantics (no participation faults): the
+                    # quorum-th upload completes the round on arrival —
+                    # _on_model_from_client checks _deadline_passed
+                    return
+                # Below quorum under a droppy fault plan: keep the flag
+                # set (the quorum-th upload still completes the round on
+                # arrival) and re-arm so stall detection keeps ticking.
+                # Three consecutive deadlines with NO new upload = a round
+                # that can never close (the whole cohort crashed/dropped):
+                # abandon it with whatever arrived rather than hang —
+                # quorum is a liveness floor, not worth a wedged
+                # federation (logged loudly).
+                n = self._received_count()
+                if n == self._stall_last_count:
+                    self._stall_strikes += 1
+                else:
+                    self._stall_last_count = n
+                    self._stall_strikes = 0
+                if self._stall_strikes >= 2:  # 3rd barren deadline
+                    logging.warning(
+                        "round %d stalled below quorum (%d/%d uploads "
+                        "after 3 deadlines) — abandoning with the "
+                        "partial set",
+                        self.round_idx, n, self._quorum(),
+                    )
+                    self.abandoned_rounds += 1
+                    self._complete_round()
+                    return
+                t = threading.Timer(
+                    self.config.fed.deadline_s,
+                    self._on_deadline,
+                    args=(armed_round,),
+                )
+                t.daemon = True
+                t.start()
+                self._deadline_timer = t
         except BaseException as e:  # noqa: BLE001
             # the timer thread would otherwise swallow this and leave the
             # server parked on its inbox forever; surface it through finish()
             self.deadline_error = e
             self.finish()
-            # else: below quorum — complete as soon as the quorum-th
-            # upload arrives (_on_model_from_client checks the flag)
 
     def _on_model_from_client(self, msg: Message):
         self._dead_workers.discard(msg.get_sender_id())
@@ -446,6 +527,11 @@ class FedAvgServerManager(ServerManager):
                 self.health.observe_train(
                     assigned[0], upload_round, time.monotonic() - assigned[1]
                 )
+                # power_of_choice bias signal: the client's local mean
+                # train loss rides the upload (ARG_TRAIN_LOSS)
+                loss = msg.get(MT.ARG_TRAIN_LOSS)
+                if loss is not None:
+                    self.scheduler.report_loss(assigned[0], float(loss))
             worker = msg.get_sender_id() - 1
             if self.config.comm.secure_agg:
                 # store the masked vector; unmasking happens once at round
@@ -504,6 +590,7 @@ class FedAvgServerManager(ServerManager):
         """Aggregate whatever has arrived, eval, resample, broadcast.
         Caller holds _round_lock."""
         self._disarm_deadline()
+        zero_uploads = False
         if self.config.comm.secure_agg:
             from fedml_tpu.secagg.secure_aggregation import (
                 ServerAggregator,
@@ -571,11 +658,21 @@ class FedAvgServerManager(ServerManager):
                     self.round_idx,
                 )
                 avg = self.global_vars
+                zero_uploads = True
             self._masked_uploads, self._masked_ns = {}, {}
             self._round_pks, self._recovery_vecs = {}, {}
             self._recovery_pending = False
             self._recovery_requested_for = None
             self._registry_sent = False
+        elif self.aggregator.received_count() == 0:
+            # abandoned round with zero uploads (entire cohort
+            # crashed/dropped): the model carries over unchanged
+            logging.warning(
+                "round %d closed with no uploads — model unchanged",
+                self.round_idx,
+            )
+            avg = self.global_vars
+            zero_uploads = True
         else:
             with self._tracer.span(
                 "aggregate",
@@ -583,7 +680,11 @@ class FedAvgServerManager(ServerManager):
                 n_uploads=self.aggregator.received_count(),
             ):
                 avg = self.aggregator.aggregate()
-        if self._server_step is not None:
+        if self._server_step is not None and not zero_uploads:
+            # a zero-upload round must not step the server optimizer: the
+            # pseudo-gradient is exactly zero, but momentum/Adam moments
+            # from earlier rounds would still move the model and decay the
+            # state on a round in which no client trained
             if self._server_opt_state is None:
                 self._server_opt_state = self._server_optimizer.init(
                     self.global_vars["params"]
@@ -625,9 +726,7 @@ class FedAvgServerManager(ServerManager):
                 self._broadcast(Message(MT.FINISH, 0, worker))
             self.finish()
             return
-        sampled = client_sampling(
-            self.round_idx, self.config.fed.client_num_in_total, self.worker_num
-        )
+        sampled = self.scheduler.select(self.round_idx, k=self.worker_num)
         self._round_span = self._tracer.start_span("round", round=self.round_idx)
         with self._tracer.span("broadcast", round=self.round_idx):
             for worker, client_idx in enumerate(sampled, start=1):
@@ -650,10 +749,18 @@ class FedAvgClientManager(ClientManager):
         rank: int,
         trainer: LocalTrainer,
         ef=None,
+        faults=None,
     ):
         super().__init__(comm, rank)
         self.config = config
         self.trainer = trainer
+        # fault injection (scheduler/faults.FaultInjector, usually shared
+        # across a federation's client actors): consulted per assignment —
+        # dropout skips training+upload, crash makes the CLIENT silent for
+        # every round from crash_at_round on (faults follow the client,
+        # not this worker slot — the sampler re-assigns clients to workers
+        # each round), slowdown sleeps, flaky double-sends the upload
+        self._faults = faults
         # TopKErrorFeedback store. The residual must follow the CLIENT, and
         # sampling re-assigns clients to ranks every round — so in-process
         # runtimes SHARE one store across all client actors (run_federation
@@ -715,7 +822,29 @@ class FedAvgClientManager(ClientManager):
         self.trainer.update_dataset(msg.get(MT.ARG_CLIENT_INDEX))
         round_idx = msg.get(MT.ARG_ROUND_IDX)
         w_round = msg.get(MT.ARG_MODEL_PARAMS)
+        fd = None
+        if self._faults is not None:
+            cid = int(self.trainer.client_index)
+            fd = self._faults.decide(cid, int(round_idx))
+            if fd.crashed:
+                # the CLIENT is gone from crash_at_round on: no training,
+                # no upload whenever it is sampled — the server's
+                # deadline/quorum absorbs each missing upload; this worker
+                # slot stays alive for the healthy clients later rounds
+                # assign it (the injector records one crash per client)
+                self._faults.record(cid, int(round_idx), "crash")
+                return
+            if fd.drop:
+                # dropout: skip the round entirely (never uploads) — the
+                # quorum path aggregates the partial cohort
+                self._faults.record(cid, int(round_idx), "dropout")
+                return
         weights, n = self.trainer.train(round_idx, w_round)
+        if fd is not None and fd.slowdown_s:
+            self._faults.record(
+                int(self.trainer.client_index), int(round_idx), "slowdown"
+            )
+            time.sleep(fd.slowdown_s)
         comp = self.config.comm.compression
         if self.config.comm.secure_agg:
             # advertise a fresh per-round keypair; the masked upload waits
@@ -756,7 +885,19 @@ class FedAvgClientManager(ClientManager):
         # round tag: lets the server discard a straggler's upload for an
         # already-closed round (FedConfig.deadline_s)
         out.add_params(MT.ARG_ROUND_IDX, round_idx)
+        if self.trainer.last_loss is not None:
+            out.add_params(MT.ARG_TRAIN_LOSS, float(self.trainer.last_loss))
         self.send_message(out)
+        if fd is not None and fd.flaky:
+            # flaky upload = at-least-once double delivery; the sync
+            # server's per-worker slot overwrite absorbs the duplicate
+            self._faults.record(
+                int(self.trainer.client_index), int(round_idx), "flaky"
+            )
+            try:
+                self.send_message(out)
+            except Exception:  # noqa: BLE001 — best-effort duplicate: the
+                pass  # real upload above already landed
 
 
 def run_federation(
@@ -775,8 +916,19 @@ def run_federation(
     (CI-script-framework.sh:16-23), but with a real exit-code/join
     discipline, and pluggable across loopback/gRPC/MQTT exactly like the
     reference's ``--backend`` switch (client_manager.py:20-33). Returns the
-    server manager (global_vars, history)."""
-    K = config.fed.client_num_per_round
+    server manager (global_vars, history).
+
+    One worker is spawned per scheduler slot — ``ceil(client_num_per_round
+    * overprovision_factor)`` of them — and a FedConfig.fault_plan, if
+    set, is applied through ONE shared FaultInjector so the run's fault
+    counters land in summary.json and the server's health registry."""
+    from fedml_tpu.scheduler import FaultInjector, overprovisioned_k
+
+    K = overprovisioned_k(
+        config.fed.client_num_per_round,
+        config.fed.overprovision_factor,
+        config.fed.client_num_in_total,
+    )
     server = FedAvgServerManager(
         config,
         comm_factory(0),
@@ -787,6 +939,19 @@ def run_federation(
         log_fn=log_fn,
         server_opt=server_opt,
     )
+    injector = FaultInjector.from_config(
+        config, health=server.health, tracer=get_tracer()
+    )
+    if (
+        injector is not None
+        and injector.plan.has_participation_faults()
+        and not config.fed.deadline_s
+    ):
+        raise ValueError(
+            "fault_plan can drop uploads (dropout_p/crash_at_round) but "
+            "deadline_s is 0: the server's all-received barrier would "
+            "wait forever — set FedConfig.deadline_s/min_clients"
+        )
     shared_train = jax.jit(
         make_local_train(model, config.train, config.fed.epochs, task=task)
     )
@@ -810,7 +975,8 @@ def run_federation(
         )
     clients = [
         FedAvgClientManager(
-            config, comm_factory(rank), rank, make_trainer(rank), ef=shared_ef
+            config, comm_factory(rank), rank, make_trainer(rank),
+            ef=shared_ef, faults=injector,
         )
         for rank in range(1, K + 1)
     ]
@@ -847,6 +1013,10 @@ def run_federation(
         t.join(timeout=60)
         if t.is_alive():
             raise RuntimeError("client thread failed to finish")
+    if injector is not None:
+        # run-level fault accounting into the metrics stream (summary.json
+        # records the injected faults — the CI oracle contract)
+        server.log_fn(injector.summary_row())
     return server
 
 

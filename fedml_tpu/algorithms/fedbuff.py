@@ -114,6 +114,13 @@ class FedBuffServerManager(ServerManager):
         self._buffer_taus: List[int] = []
         self._finished = False
         self._dead_workers: set = set()
+        # fault-starvation valve: consecutive DECLINED assignments with no
+        # intervening real upload. A plan that crashes/drops every client
+        # would otherwise spin the decline/re-dispatch loop forever with
+        # the buffer never reaching async_buffer_k — past the threshold
+        # the server shuts down loudly instead (runner raises).
+        self._decline_streak = 0
+        self.fault_starved = False
         # at-least-once delivery dedupe: a client retries an upload whose
         # RPC erred client-side AFTER server-side delivery (e.g. a unary
         # deadline hit while the server was busy flushing); the dispatch
@@ -139,11 +146,35 @@ class FedBuffServerManager(ServerManager):
         self._tracer = get_tracer()
         self.health = ClientHealthRegistry().attach(self._tracer)
         self._dispatch_times: Dict[int, tuple] = {}  # worker -> (cid, tag, t)
+        # Non-uniform dispatch (FedConfig.selection): route each
+        # assignment through the scheduler registry keyed by the dispatch
+        # counter — straggler_aware skips telemetry-flagged clients,
+        # power_of_choice biases to high-loss ones (staleness-aware
+        # participation in the FedBuff sense: a straggler is avoided up
+        # front instead of discounted after the fact). The default
+        # "uniform" keeps the legacy seeded stream bit-for-bit.
+        self._scheduler = None
+        if getattr(config.fed, "selection", "uniform") != "uniform":
+            from fedml_tpu.scheduler import ClientScheduler
+
+            self._scheduler = ClientScheduler.from_config(
+                config,
+                num_clients=config.fed.client_num_in_total,
+                data=data,
+                health=self.health,
+                tracer=self._tracer,
+                memoize=False,  # keyed by dispatch counter, unbounded
+            )
 
     # -- dispatch --
     def _next_client_index(self) -> int:
         """Seeded assignment stream (the async analog of the sync path's
-        round-seeded client_sampling, ref FedAVGAggregator.py:80-88)."""
+        round-seeded client_sampling, ref FedAVGAggregator.py:80-88);
+        policy-routed when FedConfig.selection is non-uniform."""
+        if self._scheduler is not None:
+            idx = int(self._scheduler.select(self._dispatch_counter, k=1)[0])
+            self._dispatch_counter += 1
+            return idx
         rng = np.random.default_rng(
             self.config.seed * 1_000_003 + self._dispatch_counter
         )
@@ -203,6 +234,41 @@ class FedBuffServerManager(ServerManager):
             if self._finished:
                 return
             self._dead_workers.discard(msg.get_sender_id())
+            if msg.get(MT.ARG_DECLINED):
+                # fault-injected decline: no update for this assignment —
+                # answer with a FRESH assignment (same dedupe discipline
+                # as an upload) so the worker keeps feeding the buffer
+                sender = msg.get_sender_id()
+                tag = msg.get(MT.ARG_ROUND_IDX, -1)
+                if tag >= 0 and self._last_upload_tag.get(sender) == tag:
+                    # duplicate decline (at-least-once delivery): restate
+                    # the outstanding assignment, same as the duplicate-
+                    # UPLOAD path — the duplicate means the worker may
+                    # never have seen our reply, and dropping it silently
+                    # would strand the worker until its orphan deadline
+                    if not self._finished:
+                        self._dispatch(sender, reuse=True)
+                    return
+                self._last_upload_tag[sender] = tag
+                self._decline_streak += 1
+                if self._decline_streak > max(100, 20 * self.worker_num):
+                    logging.error(
+                        "fault plan starved the buffer: %d consecutive "
+                        "declined assignments with no upload — every "
+                        "client appears crashed/dropped; shutting down",
+                        self._decline_streak,
+                    )
+                    self.fault_starved = True
+                    self._finished = True
+                    for worker in range(1, self.worker_num + 1):
+                        try:
+                            self.send_message(Message(MT.FINISH, 0, worker))
+                        except Exception:  # noqa: BLE001 — dead peer
+                            pass
+                    self.finish()
+                    return
+                self._dispatch(sender)
+                return
             delta = msg.get(MT.ARG_ASYNC_DELTA)
             base = msg.get(MT.ARG_BASE_VERSION, -1)
             if delta is None or base < 0:
@@ -232,11 +298,16 @@ class FedBuffServerManager(ServerManager):
                     self._dispatch(sender, reuse=True)
                 return
             self._last_upload_tag[sender] = tag
+            self._decline_streak = 0  # a real upload: the fleet is alive
             disp = self._dispatch_times.get(sender)
             if disp is not None and disp[1] == tag:
                 self.health.observe_train(
                     disp[0], tag, time.monotonic() - disp[2]
                 )
+                if self._scheduler is not None:
+                    loss = msg.get(MT.ARG_TRAIN_LOSS)
+                    if loss is not None:
+                        self._scheduler.report_loss(disp[0], float(loss))
             tau = self.version - int(base)
             self._buffer.append(delta)
             self._buffer_taus.append(tau)
@@ -336,13 +407,31 @@ class FedBuffClientManager(ClientManager):
         rank: int,
         trainer: LocalTrainer,
         orphan_deadline_s: Optional[float] = None,
+        faults=None,
     ):
         super().__init__(comm, rank)
         self.config = config
         self.trainer = trainer
+        # fault injection (scheduler/faults.py), keyed by the dispatch tag
+        # (the async "round"): a dropout/crashed assignment is DECLINED —
+        # the worker sends an empty ARG_DECLINED reply so the server
+        # re-dispatches it a fresh assignment instead of the fleet
+        # shrinking by one worker per injected fault (faults follow the
+        # CLIENT; the worker slot is simulation infrastructure). flaky
+        # double-sends the delta, exercising the server's at-least-once
+        # dedupe; slowdown drives real staleness.
+        self._faults = faults
         if orphan_deadline_s is not None:
             self.ORPHAN_DEADLINE_S = float(orphan_deadline_s)
         self._got_finish = False
+        # assignment dedupe: the server restates a worker's OUTSTANDING
+        # assignment when it sees a duplicate upload (at-least-once
+        # recovery). If this worker already handled that tag, the restated
+        # copy must be ignored — handling it again would upload a second
+        # duplicate, which the server would again answer with a restated
+        # assignment: a self-sustaining echo that doubles the worker's
+        # work for the rest of the run.
+        self._last_handled_tag: Optional[int] = None
         self._liveness_timer: Optional[threading.Timer] = None
         # arm/disarm/fire are serialized by this lock + generation counter:
         # Timer.cancel() cannot stop a callback already executing at the
@@ -400,9 +489,49 @@ class FedBuffClientManager(ClientManager):
 
     def _on_model(self, msg: Message):
         self._disarm_liveness()
+        tag = int(msg.get(MT.ARG_ROUND_IDX))
+        if tag == self._last_handled_tag:
+            # restated assignment we already completed (see above) — but
+            # keep the orphan deadman armed: we are still waiting on the
+            # server's NEXT dispatch, and returning disarmed would let a
+            # dead server strand this worker silently forever
+            self._arm_liveness()
+            return
+        self._last_handled_tag = tag
         self.trainer.update_dataset(msg.get(MT.ARG_CLIENT_INDEX))
         w_base = msg.get(MT.ARG_MODEL_PARAMS)
+        fd = None
+        if self._faults is not None:
+            cid = int(self.trainer.client_index)
+            # probabilistic draws keyed by the unique dispatch tag;
+            # crash_at_round compared against the server MODEL VERSION
+            # (the async round analog — tags grow unboundedly and would
+            # cross any crash threshold within a few dozen dispatches)
+            fd = self._faults.decide(
+                cid, tag, crash_round=int(msg.get(MT.ARG_BASE_VERSION, 0))
+            )
+            if fd.crashed or fd.drop:
+                # decline the assignment: this CLIENT produces no update
+                # (crash = never again, the injector records it once;
+                # dropout = this assignment only), but the worker must
+                # stay in the dispatch loop — going silent would shrink
+                # the fleet by one worker per injected fault and starve
+                # the buffer below async_buffer_k (a hang, not a test)
+                self._faults.record(
+                    cid, tag, "crash" if fd.crashed else "dropout"
+                )
+                out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
+                out.add_params(MT.ARG_DECLINED, True)
+                out.add_params(MT.ARG_ROUND_IDX, tag)
+                try:
+                    self.send_message(out)
+                finally:
+                    self._arm_liveness()
+                return
         new_vars, n = self.trainer.train(msg.get(MT.ARG_ROUND_IDX), w_base)
+        if fd is not None and fd.slowdown_s:
+            self._faults.record(int(self.trainer.client_index), tag, "slowdown")
+            time.sleep(fd.slowdown_s)
         delta = jax.tree_util.tree_map(
             lambda a, b: np.asarray(a) - np.asarray(b), new_vars, w_base
         )
@@ -413,6 +542,17 @@ class FedBuffClientManager(ClientManager):
         # dispatch tag: unique per assignment — the server's duplicate
         # filter keys on it (the retry below is at-least-once delivery)
         out.add_params(MT.ARG_ROUND_IDX, msg.get(MT.ARG_ROUND_IDX))
+        if self.trainer.last_loss is not None:
+            out.add_params(MT.ARG_TRAIN_LOSS, float(self.trainer.last_loss))
+        if fd is not None and fd.flaky:
+            # at-least-once double delivery: this extra copy lands first,
+            # the loop below sends the "real" one, and the server's
+            # dispatch-tag dedupe must absorb exactly one of them
+            self._faults.record(int(self.trainer.client_index), tag, "flaky")
+            try:
+                self.send_message(out)
+            except Exception:  # noqa: BLE001 — best-effort duplicate
+                pass
         import time as _time
 
         try:
@@ -459,10 +599,16 @@ def run_fedbuff_federation(
     """One-process async federation: 1 server + worker_num client actors in
     threads over any BaseCommManager (structure mirrors
     fedavg_transport.run_federation)."""
+    from fedml_tpu.telemetry import get_tracer as _get_tracer
+    from fedml_tpu.scheduler import FaultInjector
+
     K = config.fed.client_num_per_round
     server = FedBuffServerManager(
         config, comm_factory(0), model, data=data, task=task,
         worker_num=K, log_fn=log_fn,
+    )
+    injector = FaultInjector.from_config(
+        config, health=server.health, tracer=_get_tracer()
     )
     shared_train = jax.jit(
         make_local_train(model, config.train, config.fed.epochs, task=task)
@@ -473,6 +619,7 @@ def run_fedbuff_federation(
             comm_factory(rank),
             rank,
             LocalTrainer(config, data, model, task, local_train_fn=shared_train),
+            faults=injector,
         )
         for rank in range(1, K + 1)
     ]
@@ -504,6 +651,12 @@ def run_fedbuff_federation(
         if t.is_alive():
             raise RuntimeError("async client thread failed to finish")
     orphans = [c.rank for c in clients if c.orphaned]
+    if server.fault_starved:
+        raise RuntimeError(
+            "fedbuff fault plan starved the delta buffer: every client "
+            "appears crashed/dropped, the run cannot reach its step count "
+            "(fix the plan or lower async_buffer_k)"
+        )
     if orphans and server.server_steps < config.fed.comm_round:
         # orphaned workers AND an incomplete run: the failure is real
         raise RuntimeError(
@@ -518,6 +671,8 @@ def run_fedbuff_federation(
             "orphaned along the way (transient upload failures)",
             server.server_steps, orphans,
         )
+    if injector is not None:
+        server.log_fn(injector.summary_row())
     return server
 
 

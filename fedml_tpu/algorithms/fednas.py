@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from fedml_tpu.algorithms.fedavg import client_sampling, weighted_average
+from fedml_tpu.algorithms.fedavg import weighted_average
+from fedml_tpu.scheduler import select_clients
 from fedml_tpu.models.darts import DARTSNetwork, derive_genotype
 
 
@@ -188,7 +189,7 @@ class FedNASAPI:
         return variables, float(loss)
 
     def train_round(self, round_idx: int, client_num_per_round: int, epochs: int = 1):
-        sampled = client_sampling(
+        sampled = select_clients(
             round_idx, self.data.num_clients, client_num_per_round
         )
         locals_, weights_n = [], []

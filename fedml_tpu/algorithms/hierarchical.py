@@ -114,12 +114,10 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
         )
 
     def train_round(self, round_idx: int):
-        from fedml_tpu.algorithms.fedavg import client_sampling
-
         cfg = self.config
-        sampled = client_sampling(
-            round_idx, self.data.num_clients, cfg.fed.client_num_per_round
-        )
+        # scheduler-backed cohort (FedConfig.selection + fault plan) — the
+        # same memoized draw the base API's _round_plan/_log_round see
+        sampled = self._sample_clients(round_idx)
         sampled_set = set(int(i) for i in sampled)
         group_vars, group_weights, metrics_acc = [], [], None
         for gi, members in enumerate(self.groups):

@@ -193,6 +193,28 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--enable_wandb", is_flag=True, default=False,
               help="Start a wandb run and mirror metric rows to it (ref "
                    "main_fedavg.py:93-108); no-op if wandb is not installed")
+@click.option("--selection",
+              type=click.Choice(("uniform", "weighted", "power_of_choice",
+                                 "straggler_aware")),
+              default="uniform",
+              help="Client selection policy (scheduler/policies.py): "
+                   "reference-parity uniform, sample-count weighted, "
+                   "loss-biased power-of-choice (Cho et al. 2020), or "
+                   "straggler-avoiding (telemetry health registry). "
+                   "Round-keyed + seed-deterministic; uniform/weighted "
+                   "select identical cohorts across runtimes (see "
+                   "docs/SCHEDULING.md for the adaptive policies)")
+@click.option("--overprovision_factor", type=float, default=1.0,
+              help="Select ceil(k * factor) clients per round so "
+                   "deadline/quorum rounds still close with ~k useful "
+                   "uploads; transport runtimes spawn one worker per "
+                   "overprovisioned slot (1.0 = off)")
+@click.option("--fault_plan", type=str, default=None,
+              help="Fault-injection plan (scheduler/faults.py): inline "
+                   "JSON or a path to a JSON file — per-client dropout_p/"
+                   "slowdown_s/crash_at_round/flaky_upload_p, deterministic "
+                   "per (plan seed, client, round). Sync transport runs "
+                   "with participation faults require --deadline_s")
 @click.option("--deadline_s", type=float, default=0.0,
               help="Transport runtimes: straggler deadline — after this many "
                    "seconds the server closes the round on a quorum instead "
@@ -248,6 +270,68 @@ def _dp_cfg(opt):
     return DpConfig(clip_norm=clip, noise_multiplier=z, delta=delta)
 
 
+def _validate_scheduler(config, opt) -> None:
+    """Parse-time scheduler/fault-plan validation — a malformed plan or an
+    unsatisfiable combination must fail before minutes of data/model
+    setup, not as a mid-run hang."""
+    from fedml_tpu.scheduler import FaultPlan
+
+    if config.fed.overprovision_factor < 1.0:
+        raise click.UsageError("--overprovision_factor must be >= 1.0")
+    try:
+        plan = FaultPlan.from_config(config)
+    except ValueError as e:
+        raise click.UsageError(f"--fault_plan: {e}")
+    scheduler_engaged = (
+        config.fed.selection != "uniform"
+        or config.fed.overprovision_factor != 1.0
+        or plan is not None
+    )
+    if opt["algorithm"] == "dp_fedavg" and scheduler_engaged:
+        raise click.UsageError(
+            "--selection/--overprovision_factor/--fault_plan cannot be "
+            "combined with algorithm=dp_fedavg: its cohort is the "
+            "run-seeded secret Poisson draw (privacy amplification by "
+            "subsampling, privacy/dp_fedavg.py), which bypasses the "
+            "scheduler — the flags would be silently ignored"
+        )
+    if opt["algorithm"] in _LONGTAIL and scheduler_engaged:
+        # the long-tail drivers run their own fixed loops (uniform
+        # sampling or no sampling at all) — accepting the flags there
+        # would silently do nothing
+        raise click.UsageError(
+            "--selection/--overprovision_factor/--fault_plan have no "
+            f"effect for algorithm={opt['algorithm']}: it drives its own "
+            "fixed training loop outside the scheduler (supported: the "
+            "FedAvg family, fedbuff, hierarchical, fedavg_robust)"
+        )
+    if config.fed.overprovision_factor != 1.0 and config.comm.secure_agg:
+        raise click.UsageError(
+            "--overprovision_factor and --secure_agg are incompatible: "
+            "clients size the mask registry from client_num_per_round, so "
+            "an overprovisioned worker set would not cancel its masks"
+        )
+    if config.fed.overprovision_factor != 1.0 and opt["algorithm"] == "fedbuff":
+        raise click.UsageError(
+            "--overprovision_factor is a synchronous quorum-round concept "
+            "(select extra clients so deadline rounds close with ~k useful "
+            "uploads); fedbuff has no rounds to overprovision — its "
+            "workers stream continuously"
+        )
+    if (
+        plan is not None
+        and plan.has_participation_faults()
+        and opt["runtime"] in ("loopback", "mqtt", "shm", "grpc")
+        and opt["algorithm"] != "fedbuff"
+        and not config.fed.deadline_s
+    ):
+        raise click.UsageError(
+            "--fault_plan with dropout_p/crash_at_round on a synchronous "
+            "transport requires --deadline_s: the all-received barrier "
+            "would wait forever for the dropped upload"
+        )
+
+
 def _checked_buffer_k(opt) -> int:
     """fedbuff's buffer size, validated at parse time (a 0/negative k would
     otherwise surface as a mid-run ValueError after data/model setup); 0
@@ -284,6 +368,9 @@ def build_config(opt) -> RunConfig:
             eval_on_clients=opt.get("eval_on_clients", False),
             deadline_s=opt.get("deadline_s", 0.0),
             min_clients=opt.get("min_clients", 1),
+            selection=opt.get("selection", "uniform"),
+            overprovision_factor=opt.get("overprovision_factor", 1.0),
+            fault_plan=opt.get("fault_plan") or "",
             client_parallelism=opt.get("client_parallelism", "auto"),
             async_buffer_k=_checked_buffer_k(opt),
             async_staleness_exp=opt.get("staleness_exp", 0.5),
@@ -428,6 +515,7 @@ def run(**opt):
     # surface as a mid-run crash after minutes of dataset loading); the
     # result is rebuilt at the _build_api call site
     _dp_cfg(opt)
+    _validate_scheduler(config, opt)
     if opt["runtime"] in ("vmap", "mesh"):
         if config.comm.compression != "none":
             raise click.UsageError(
@@ -546,6 +634,7 @@ def run(**opt):
                     algo_state=getattr(
                         api, "checkpoint_state", lambda: None
                     )(),
+                    sched_state=_sched_state(api),
                 )
 
     _validate_variant(opt)
@@ -623,6 +712,10 @@ def run(**opt):
     try:
         with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
             final = api.train()
+        if getattr(api, "faults", None) is not None:
+            # vmap/mesh fault accounting into summary.json (the transport
+            # runners log their shared injector themselves)
+            log_fn(api.faults.summary_row())
         if poison_spec is not None:
             from fedml_tpu.data.edge_cases import attack_success_rate
 
@@ -642,6 +735,7 @@ def run(**opt):
                 round_idx=config.fed.comm_round,
                 server_opt_state=getattr(api, "server_opt_state", None),
                 algo_state=getattr(api, "checkpoint_state", lambda: None)(),
+                sched_state=_sched_state(api),
             )
         _telemetry_finish(
             telemetry, opt, logger, health=getattr(api, "health", None)
@@ -682,6 +776,13 @@ def _jsonable(v):
     return v
 
 
+def _sched_state(api):
+    """Scheduler RNG/selection state for the checkpoint's "sched" slot —
+    a resumed run re-selects the in-flight round's cohort identically."""
+    sched = getattr(api, "scheduler", None)
+    return sched.state_dict() if sched is not None else None
+
+
 def _restore(api, opt):
     """--resume: pour the checkpoint into the API and continue the round
     loop from the saved round (round-seeded sampling makes the continuation
@@ -691,7 +792,7 @@ def _restore(api, opt):
 
     if not opt["checkpoint_path"]:
         raise click.UsageError("--resume requires --checkpoint_path")
-    loaded_vars, round_idx, _, opt_state, algo_state = load_checkpoint(
+    loaded_vars, round_idx, _, opt_state, algo_state, sched_state = load_checkpoint(
         str(opt["checkpoint_path"])
     )
     api.global_vars = restore_like(api.global_vars, loaded_vars)
@@ -704,6 +805,11 @@ def _restore(api, opt):
     # Algorithm-private state (SCAFFOLD control variates): without this a
     # resumed run silently degenerates to FedAvg until the variates
     # re-learn, breaking the identical-continuation contract above.
+    # Scheduler selection memo + loss map: without it a resumed
+    # power_of_choice run would re-derive the in-flight cohort from an
+    # empty loss map and select differently than the uninterrupted run.
+    if sched_state is not None and getattr(api, "scheduler", None) is not None:
+        api.scheduler.load_state_dict(sched_state)
     if hasattr(api, "restore_state"):
         if algo_state is None:
             raise click.UsageError(
@@ -1134,12 +1240,26 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
     rank = opt["rank"]
     if rank is None:
         raise click.UsageError("runtime=grpc requires --rank")
-    K = config.fed.client_num_per_round
+    # one worker per scheduler slot (overprovisioned cohorts need
+    # ceil(k * factor) client processes — launch scripts must match)
+    from fedml_tpu.scheduler import overprovisioned_k
+
+    K = overprovisioned_k(
+        config.fed.client_num_per_round,
+        config.fed.overprovision_factor,
+        config.fed.client_num_in_total,
+    )
     if opt["ip_config"]:
         table = read_ip_config(str(opt["ip_config"]))
     else:
         table = {r: "127.0.0.1" for r in range(K + 1)}
     comm = GrpcCommManager(rank, table, base_port=opt["base_port"])
+    # per-process fault injector (client ranks only): the plan is
+    # deterministic in (seed, client, round), so every process injects
+    # the same faults; the server infers dropouts from its quorum rounds
+    from fedml_tpu.scheduler import FaultInjector
+
+    faults = FaultInjector.from_config(config) if rank != 0 else None
     if opt["algorithm"] == "fedbuff":
         from fedml_tpu.algorithms.fedbuff import (
             FedBuffClientManager,
@@ -1162,8 +1282,13 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
                 config, data, model, task,
                 straggle_s=opt.get("straggle_ms", 0.0) / 1e3,
             ),
+            faults=faults,
         )
         client.run()
+        if faults is not None:
+            # per-process fault accounting (this rank's summary.json) —
+            # the in-process runners log their shared injector instead
+            log_fn(faults.summary_row())
         if client.orphaned:
             raise click.ClickException(
                 f"async worker rank {rank} orphaned: server unreachable "
@@ -1197,8 +1322,11 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
             config, data, model, task,
             straggle_s=opt.get("straggle_ms", 0.0) / 1e3,
         ),
+        faults=faults,
     )
     client.run()
+    if faults is not None:
+        log_fn(faults.summary_row())  # this rank's summary.json
     return {"rank": rank, "finished": True}, None
 
 

@@ -51,6 +51,26 @@ class FedConfig:
     # FedAvg sub-rounds inside every group before the cross-group average.
     group_num: int = 1
     group_comm_round: int = 1
+    # Client selection policy (scheduler/policies.py registry): "uniform"
+    # (reference-parity round-seeded draw), "weighted" (by local sample
+    # counts), "power_of_choice" (loss-biased d-choose-k, Cho et al. 2020),
+    # "straggler_aware" (avoids telemetry-flagged stragglers). All
+    # round-keyed and seed-deterministic; uniform/weighted select
+    # identical cohorts across the simulation and transport runtimes,
+    # the adaptive two share the rule but feed on runtime-local signals
+    # (docs/SCHEDULING.md).
+    selection: str = "uniform"
+    # Select ceil(client_num_per_round * factor) clients per round —
+    # deadline/quorum rounds still close with ~k useful uploads when part
+    # of the cohort drops. 1.0 = off. Transport runners spawn one worker
+    # per overprovisioned slot.
+    overprovision_factor: float = 1.0
+    # Fault-injection plan (scheduler/faults.py): inline JSON or a path to
+    # a JSON file ({seed, default, clients: {id: {dropout_p, slowdown_s,
+    # crash_at_round, flaky_upload_p}}}); "" = no injected faults.
+    # Deterministic per (plan seed, client, round), so CI can exercise the
+    # deadline/quorum and staleness recovery paths on purpose.
+    fault_plan: str = ""
     # Straggler tolerance for the transport runtime (the reference's
     # aggregator barrier waits forever — FedAVGAggregator.py:43-49, SURVEY §5
     # "no straggler mitigation"). deadline_s > 0: after broadcasting, the

@@ -59,12 +59,19 @@ class MessageType:
     ARG_MASKED_UPDATE = "masked_update"
     ARG_CLIENT_INDEX = "client_index"
     ARG_NUM_SAMPLES = "num_samples"
+    # client's local mean train loss for the round, attached to uploads —
+    # the bias signal power_of_choice selection feeds on (scheduler/)
+    ARG_TRAIN_LOSS = "train_loss"
     ARG_ROUND_IDX = "round_idx"
     # asynchronous buffered aggregation (algorithms/fedbuff.py): clients
     # upload deltas tagged with the model VERSION they trained from; the
     # server discounts by staleness = current_version - base_version
     ARG_ASYNC_DELTA = "async_delta"
     ARG_BASE_VERSION = "base_version"
+    # async assignment decline: the worker reports "no update for this
+    # assignment" (fault-injected dropout/crashed client) so the server
+    # re-dispatches instead of waiting on an upload that will never come
+    ARG_DECLINED = "declined"
     ARG_PUBKEY = "pubkey"
     ARG_PUBKEY_REGISTRY = "pubkey_registry"  # {party: pk}, public material
     ARG_DROPPED = "dropped_parties"

@@ -138,9 +138,12 @@ def make_sharded_fedavg_round(
 class DistributedFedAvgAPI(FedAvgAPI):
     """Multi-chip FedAvg driver (ref FedML_FedAvg_distributed, FedAvgAPI.py:21-27
     + both manager classes). Subclass of the single-chip simulator: the host
-    loop (sampling, stacking, metrics, eval) is inherited; this class only
-    swaps the round function for the shard_map version and pads + places each
-    round's batch sharded over the mesh."""
+    loop (sampling, stacking, metrics, eval) is inherited — including the
+    scheduler-backed cohort selection and participation-fault filtering
+    (FedConfig.selection/fault_plan, scheduler/): a fault-shrunk cohort is
+    just another client-axis size, padded to the mesh like any ragged
+    round — and this class only swaps the round function for the shard_map
+    version and pads + places each round's batch sharded over the mesh."""
 
     _use_device_store = False  # batches are padded + sharded from host
 

@@ -41,7 +41,6 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
-from fedml_tpu.algorithms.fedavg import client_sampling
 from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
 from fedml_tpu.core.comm import Observer
 from fedml_tpu.core.grpc_comm import GrpcCommManager
@@ -120,9 +119,11 @@ def run_hierarchical_grpc_group(
 
     try:
         for r in range(config.fed.comm_round):
-            sampled = client_sampling(
-                r, data.num_clients, config.fed.client_num_per_round
-            )
+            # every bridge process derives the round's cohort through its
+            # OWN api's scheduler: deterministic in (seed, round, config)
+            # — the per-process loss/health stores are never fed here, so
+            # all processes agree by construction
+            sampled = api._sample_clients(r)
             sampled_set = set(int(i) for i in sampled)
             w_group, weight, metrics = api._group_round(
                 r, rank, api.groups[rank], sampled_set

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling, round_client_rngs
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, round_client_rngs
 from fedml_tpu.algorithms.hierarchical import resolve_groups
 from fedml_tpu.config import RunConfig
 from fedml_tpu.data.base import FederatedDataset, bucket_steps, stack_clients
@@ -186,9 +186,10 @@ class HierarchicalShardedAPI(FedAvgAPI):
     def train_round(self, round_idx: int):
         cfg = self.config
         R = cfg.fed.group_comm_round
-        sampled = client_sampling(
-            round_idx, self.data.num_clients, cfg.fed.client_num_per_round
-        )
+        # scheduler-backed cohort (FedConfig.selection + fault plan),
+        # memoized — identical to what the host-loop hierarchical API and
+        # the base _round_plan derive for this round
+        sampled = self._sample_clients(round_idx)
         sampled_set = set(int(i) for i in sampled)
         cohorts = [
             [int(c) for c in members if int(c) in sampled_set]

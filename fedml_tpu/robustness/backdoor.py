@@ -91,15 +91,12 @@ class BackdoorFedAvgAPI(RobustFedAvgAPI):
         return super().train_round(round_idx)
 
     def _place_batch(self, batch, round_rng):
-        from fedml_tpu.algorithms.fedavg import client_sampling
-
         base = super(RobustFedAvgAPI, self)._place_batch(batch, round_rng)
         noise_rng = jax.random.fold_in(round_rng, 0x5EED)
-        sampled = client_sampling(
-            getattr(self, "_current_round", 0),
-            self.data.num_clients,
-            self.config.fed.client_num_per_round,
-        )
+        # the round's ACTUAL cohort (memoized _round_plan) — recomputing a
+        # uniform draw here would misalign the attack mask whenever the
+        # scheduler's policy or a fault plan changed the cohort
+        sampled = self._round_plan(getattr(self, "_current_round", 0))[0]
         attack_mask = jnp.asarray(
             np.array(
                 [1.0 if int(c) in self._attacker_set else 0.0 for c in sampled],

@@ -1,0 +1,58 @@
+"""Client scheduling & fault-injection runtime — beyond the reference,
+whose only participation logic is one uniform round-seeded draw
+(FedAVGAggregator.py:80-88) with "no straggler mitigation, no
+client-dropout tolerance" (SURVEY §5).
+
+Two halves:
+
+- :mod:`fedml_tpu.scheduler.policies` — pluggable cohort selection behind
+  one :class:`SelectionPolicy` interface with a registry (``uniform``,
+  ``weighted``, ``power_of_choice``, ``straggler_aware``) plus an
+  ``overprovision`` wrapper for deadline/quorum rounds, and the
+  :class:`ClientScheduler` driver every runtime shares. Selection is
+  round-keyed and seed-deterministic, so the vmap simulator and the
+  transport federations pick byte-identical cohorts from the same config
+  (a test contract, tests/test_scheduler.py).
+- :mod:`fedml_tpu.scheduler.faults` — a deterministic fault-injection
+  harness (:class:`FaultPlan`: per-client dropout probability, slowdown,
+  crash-at-round, flaky upload) that wraps the client train path so the
+  deadline/quorum recovery machinery, the FedBuff staleness path, and the
+  transports can be exercised on purpose in tests/CI instead of by
+  wall-clock luck.
+
+Stdlib + numpy only — importable before (and without) jax, like
+telemetry; scheduling must never add a hot-path dependency."""
+
+from fedml_tpu.scheduler.faults import (
+    FaultDecision,
+    FaultInjector,
+    FaultPlan,
+)
+from fedml_tpu.scheduler.policies import (
+    POLICY_NAMES,
+    ClientScheduler,
+    OverprovisionPolicy,
+    SelectionContext,
+    SelectionPolicy,
+    get_policy,
+    make_policy,
+    overprovisioned_k,
+    register_policy,
+    select_clients,
+)
+
+__all__ = [
+    "POLICY_NAMES",
+    "ClientScheduler",
+    "FaultDecision",
+    "FaultInjector",
+    "FaultPlan",
+    "OverprovisionPolicy",
+    "SelectionContext",
+    "SelectionPolicy",
+    "get_policy",
+    "make_policy",
+    "overprovisioned_k",
+    "register_policy",
+    "select_clients",
+]

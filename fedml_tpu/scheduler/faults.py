@@ -1,0 +1,293 @@
+"""Deterministic fault injection for federated runs.
+
+The deadline/quorum recovery paths (fedavg_transport.py), the FedBuff
+staleness machinery, and the loopback/shm/gRPC transports all exist to
+tolerate clients that are slow, flaky, or gone — but until now the only
+way to exercise them was wall-clock luck (a sleep in a test, a real
+straggler in production). A :class:`FaultPlan` makes client misbehavior a
+config input: per-client dropout probability, a fixed slowdown, a
+crash-at-round, and a flaky (duplicated) upload, every decision a pure
+function of ``(plan seed, client id, round)`` so the same plan injects
+the same faults in every run, process, and resumed continuation.
+
+JSON schema (CLI ``--fault_plan`` accepts the inline document or a path
+to a file containing it)::
+
+    {
+      "seed": 0,                      # fault RNG seed (default 0)
+      "default": {                    # spec applied to unlisted clients
+        "dropout_p": 0.0,             # P(skip this round's upload)
+        "slowdown_s": 0.0,            # sleep this long around training
+        "crash_at_round": null,       # from this round on: silent forever
+        "flaky_upload_p": 0.0         # P(upload delivered twice)
+      },
+      "clients": {"3": {"dropout_p": 0.5}, ...}   # per-client overrides
+    }
+
+Semantics by runtime:
+
+- **sync transports** (loopback/shm/grpc/mqtt): ``dropout`` — the client
+  skips training and never uploads that round (the server's
+  deadline/quorum path absorbs it; sync runs therefore REQUIRE
+  ``deadline_s > 0`` when the plan can drop). ``crash_at_round`` — the
+  CLIENT is silent in every round that samples it from that round on
+  (the worker slot stays alive: the sampler re-assigns clients to
+  workers each round, and faults follow the client). ``slowdown_s`` —
+  sleep around local training (drives the straggler detector and
+  deadline races). ``flaky_upload`` — the upload is sent twice
+  (at-least-once retry double-delivery; exercises the sync server's
+  same-slot overwrite).
+- **FedBuff**: faults are per assignment (dispatch tag). A dropped or
+  crashed assignment is DECLINED (an empty ``ARG_DECLINED`` reply) and
+  the server immediately re-dispatches, so the worker fleet never
+  shrinks and the delta buffer keeps filling; ``flaky_upload``
+  double-sends the delta, exercising the at-least-once dedupe.
+- **vmap/mesh simulators**: the cohort trains as one jitted program, so
+  only participation faults apply — ``dropout``/``crash`` remove the
+  client from the round's cohort before batching (at least one survivor
+  is kept so the round stays well-formed); timing faults are ignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientFaultSpec:
+    dropout_p: float = 0.0
+    slowdown_s: float = 0.0
+    crash_at_round: Optional[int] = None
+    flaky_upload_p: float = 0.0
+
+    def validate(self, who: str) -> None:
+        if not 0.0 <= self.dropout_p <= 1.0:
+            raise ValueError(f"{who}: dropout_p must be in [0, 1]")
+        if not 0.0 <= self.flaky_upload_p <= 1.0:
+            raise ValueError(f"{who}: flaky_upload_p must be in [0, 1]")
+        if self.slowdown_s < 0:
+            raise ValueError(f"{who}: slowdown_s must be >= 0")
+        if self.crash_at_round is not None and self.crash_at_round < 0:
+            raise ValueError(f"{who}: crash_at_round must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultDecision:
+    """What happens to (client, round): at most one participation fault
+    (crashed wins over drop) plus independent timing faults."""
+
+    crashed: bool = False
+    drop: bool = False
+    slowdown_s: float = 0.0
+    flaky: bool = False
+
+    @property
+    def participates(self) -> bool:
+        return not (self.crashed or self.drop)
+
+
+_SPEC_KEYS = {f.name for f in dataclasses.fields(ClientFaultSpec)}
+
+
+def _parse_spec(doc: dict, who: str) -> ClientFaultSpec:
+    unknown = set(doc) - _SPEC_KEYS
+    if unknown:
+        raise ValueError(
+            f"{who}: unknown fault spec keys {sorted(unknown)} "
+            f"(known: {sorted(_SPEC_KEYS)})"
+        )
+    spec = ClientFaultSpec(**doc)
+    spec.validate(who)
+    return spec
+
+
+class FaultPlan:
+    """Per-client fault specs + the deterministic per-round coin flips."""
+
+    def __init__(
+        self,
+        clients: Optional[Dict[int, ClientFaultSpec]] = None,
+        default: Optional[ClientFaultSpec] = None,
+        seed: int = 0,
+    ):
+        self.clients = {int(c): s for c, s in (clients or {}).items()}
+        self.default = default or ClientFaultSpec()
+        self.seed = int(seed)
+
+    # -- construction --
+    @classmethod
+    def from_json(cls, doc: dict) -> "FaultPlan":
+        unknown = set(doc) - {"seed", "default", "clients"}
+        if unknown:
+            raise ValueError(
+                f"fault plan: unknown top-level keys {sorted(unknown)} "
+                "(known: seed, default, clients)"
+            )
+        default = _parse_spec(doc.get("default", {}), "fault plan default")
+        clients = {
+            int(cid): _parse_spec(spec, f"fault plan client {cid}")
+            for cid, spec in (doc.get("clients") or {}).items()
+        }
+        return cls(clients=clients, default=default, seed=doc.get("seed", 0))
+
+    @classmethod
+    def from_spec(cls, spec: str) -> Optional["FaultPlan"]:
+        """Parse the CLI/config string: inline JSON (starts with '{') or a
+        path to a JSON file; ''/None means no faults."""
+        if not spec:
+            return None
+        text = spec.strip()
+        if not text.startswith("{"):
+            if not os.path.exists(text):
+                raise ValueError(
+                    f"fault plan {text!r} is neither inline JSON nor an "
+                    "existing file"
+                )
+            with open(text) as f:
+                text = f.read()
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"fault plan is not valid JSON: {e}") from e
+        return cls.from_json(doc)
+
+    @classmethod
+    def from_config(cls, config) -> Optional["FaultPlan"]:
+        return cls.from_spec(getattr(config.fed, "fault_plan", ""))
+
+    # -- queries --
+    def spec_for(self, client_id: int) -> ClientFaultSpec:
+        return self.clients.get(int(client_id), self.default)
+
+    def has_participation_faults(self) -> bool:
+        """True when the plan can remove an upload (dropout or crash) —
+        sync transport runs then need deadline/quorum rounds to not hang."""
+        return any(
+            s.dropout_p > 0 or s.crash_at_round is not None
+            for s in list(self.clients.values()) + [self.default]
+        )
+
+    def decide(
+        self, client_id: int, round_idx: int, crash_round: Optional[int] = None
+    ) -> FaultDecision:
+        """The (client, round) fault decision — pure in (seed, client,
+        round): one SeedSequence draw stream per pair, probabilities in a
+        fixed order, so every process and every re-run agrees.
+
+        ``crash_round`` overrides the value ``crash_at_round`` is compared
+        against: FedBuff keys its probabilistic draws by the per-assignment
+        dispatch tag (unique, unbounded), which would cross any
+        ``crash_at_round`` threshold within a few dozen dispatches — it
+        passes the server MODEL VERSION here instead (the async analog of
+        a training round)."""
+        spec = self.spec_for(client_id)
+        cr = int(round_idx) if crash_round is None else int(crash_round)
+        crashed = spec.crash_at_round is not None and cr >= spec.crash_at_round
+        drop = flaky = False
+        if spec.dropout_p > 0 or spec.flaky_upload_p > 0:
+            rng = np.random.default_rng(
+                [self.seed & 0x7FFFFFFF, int(client_id), int(round_idx) & 0x7FFFFFFF]
+            )
+            drop = bool(rng.random() < spec.dropout_p)
+            flaky = bool(rng.random() < spec.flaky_upload_p)
+        return FaultDecision(
+            crashed=crashed,
+            drop=drop and not crashed,
+            slowdown_s=spec.slowdown_s,
+            flaky=flaky and not crashed,
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "seed": self.seed,
+            "default": dataclasses.asdict(self.default),
+            "clients": {
+                str(c): dataclasses.asdict(s) for c, s in sorted(self.clients.items())
+            },
+        }
+
+
+_FAULT_KINDS = ("dropout", "crash", "slowdown", "flaky")
+# MetricsLogger key per kind (summary.json schema, asserted by CI)
+_FAULT_ROW_KEYS = {
+    "dropout": "faults/dropouts",
+    "crash": "faults/crashes",
+    "slowdown": "faults/slowdowns",
+    "flaky": "faults/flaky_uploads",
+}
+
+
+class FaultInjector:
+    """The runtime half: applies a plan's decisions and accounts for every
+    injected fault (thread-safe — transport clients run in threads).
+
+    One injector is shared across a federation's client actors so the
+    counters describe the RUN; ``summary_row()`` is forwarded into
+    MetricsLogger at the end (summary.json records the injected faults —
+    the CI oracle contract), each event is emitted as a ``fault``
+    telemetry span, and — when the server's health registry is reachable
+    (in-process federations) — recorded per client via
+    ``ClientHealthRegistry.observe_fault``."""
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        health: Optional[object] = None,
+        tracer: Optional[object] = None,
+    ):
+        self.plan = plan
+        self.health = health
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {k: 0 for k in _FAULT_KINDS}
+        self._crash_logged: set = set()
+
+    @classmethod
+    def from_config(
+        cls, config, health=None, tracer=None
+    ) -> Optional["FaultInjector"]:
+        plan = FaultPlan.from_config(config)
+        if plan is None:
+            return None
+        return cls(plan, health=health, tracer=tracer)
+
+    def decide(
+        self, client_id: int, round_idx: int, crash_round: Optional[int] = None
+    ) -> FaultDecision:
+        return self.plan.decide(client_id, round_idx, crash_round=crash_round)
+
+    def record(self, client_id: int, round_idx: int, kind: str) -> None:
+        assert kind in _FAULT_KINDS, kind
+        with self._lock:
+            if kind == "crash":
+                # a crash is one event per client, not one per ignored round
+                if client_id in self._crash_logged:
+                    return
+                self._crash_logged.add(client_id)
+            self.counters[kind] += 1
+        if self._tracer is not None:
+            with self._tracer.span(
+                "fault", client=int(client_id), round=int(round_idx), kind=kind
+            ):
+                pass
+        if self.health is not None and hasattr(self.health, "observe_fault"):
+            self.health.observe_fault(client_id, round_idx, kind)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.counters.values())
+
+    def summary_row(self) -> dict:
+        """Flat MetricsLogger row of the run's injected-fault counts."""
+        with self._lock:
+            row = {
+                _FAULT_ROW_KEYS[k]: int(v) for k, v in self.counters.items()
+            }
+            row["faults/total"] = sum(self.counters.values())
+        return row

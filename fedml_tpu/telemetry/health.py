@@ -44,7 +44,13 @@ _TRAIN_BUCKETS = (
 
 
 class _ClientRecord:
-    __slots__ = ("last_seen_round", "rounds_participated", "times", "seen_rounds")
+    __slots__ = (
+        "last_seen_round",
+        "rounds_participated",
+        "times",
+        "seen_rounds",
+        "faults",
+    )
 
     def __init__(self, window: int):
         self.last_seen_round = -1
@@ -52,6 +58,9 @@ class _ClientRecord:
         self.times: deque = deque(maxlen=window)
         # bounded dedupe memory: only the most recent window of round ids
         self.seen_rounds: deque = deque(maxlen=window)
+        # injected/observed faults by kind (scheduler/faults.py feeds this
+        # via observe_fault): {"dropout": n, "crash": n, ...}
+        self.faults: Dict[str, int] = {}
 
     def mean(self) -> Optional[float]:
         if not self.times:
@@ -96,6 +105,11 @@ class ClientHealthRegistry:
             "Observed local-train wall time across all clients",
             buckets=_TRAIN_BUCKETS,
         )
+        self._c_faults = r.counter(
+            "fedml_client_faults_total",
+            "Client faults observed/injected, by kind",
+            labelnames=("kind",),
+        )
 
     # -- feeding --
     def observe_train(
@@ -127,6 +141,27 @@ class ClientHealthRegistry:
         if n_obs % 32 == 0 or n_clients <= 32:
             self.straggler_ids()
         return True
+
+    def observe_fault(self, client_id: int, round_idx: int, kind: str) -> None:
+        """Record a client fault (scheduler fault injection, or a real
+        failure the runtime observed). Faults are NOT train observations:
+        they never touch the timing stats or the straggler flag, only the
+        per-client fault tally surfaced in snapshot()."""
+        cid = int(client_id)
+        with self._lock:
+            rec = self._clients.get(cid)
+            if rec is None:
+                rec = self._clients[cid] = _ClientRecord(self.window)
+            rec.faults[kind] = rec.faults.get(kind, 0) + 1
+            rec.last_seen_round = max(rec.last_seen_round, int(round_idx))
+            n_clients = len(self._clients)
+        self._g_seen.set(n_clients)
+        self._c_faults.inc(kind=kind)
+
+    def faults(self, client_id: int) -> Dict[str, int]:
+        with self._lock:
+            rec = self._clients.get(int(client_id))
+            return dict(rec.faults) if rec else {}
 
     def _on_span(self, ev: SpanEvent) -> None:
         if ev.name != self.span_name:
@@ -221,5 +256,6 @@ class ClientHealthRegistry:
                 "p50_train_s": rec.percentile(0.5),
                 "p90_train_s": rec.percentile(0.9),
                 "straggler": cid in stragglers,
+                "faults": dict(rec.faults),
             }
         return out

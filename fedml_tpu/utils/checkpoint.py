@@ -58,6 +58,7 @@ def save_checkpoint(
     rng=None,
     server_opt_state=None,
     algo_state=None,
+    sched_state=None,
     extra_meta: Optional[dict] = None,
 ) -> None:
     """Atomic write of (params, server opt state, round, rng): everything —
@@ -84,10 +85,16 @@ def save_checkpoint(
         # algorithm-private state (e.g. SCAFFOLD control variates) — the
         # API's checkpoint_state()/restore_state() hooks own its shape
         _flatten("algo", _to_numpy(algo_state), flat)
+    if sched_state is not None:
+        # scheduler selection memo + loss map (scheduler/policies.py
+        # ClientScheduler.state_dict) — a resumed run re-selects the
+        # in-flight round's cohort byte-identically
+        _flatten("sched", _to_numpy(sched_state), flat)
     meta = {
         "round_idx": int(round_idx),
         "has_opt": server_opt_state is not None,
         "has_algo": algo_state is not None,
+        "has_sched": sched_state is not None,
     }
     meta.update(extra_meta or {})
     flat["__meta__"] = np.frombuffer(
@@ -102,8 +109,10 @@ def save_checkpoint(
 
 def load_checkpoint(
     path: str,
-) -> Tuple[dict, int, Optional[np.ndarray], Any, Any]:
-    """Returns (global_vars, round_idx, rng, server_opt_state, algo_state)."""
+) -> Tuple[dict, int, Optional[np.ndarray], Any, Any, Any]:
+    """Returns (global_vars, round_idx, rng, server_opt_state, algo_state,
+    sched_state). ``sched_state`` is None for checkpoints written before
+    the scheduler slot existed (meta carries no has_sched)."""
     with np.load(path + ".npz") as z:
         flat = {k: z[k] for k in z.files}
     meta = json.loads(flat.pop("__meta__").tobytes().decode("utf-8"))
@@ -111,10 +120,12 @@ def load_checkpoint(
     vars_flat = {k[len("vars/"):]: v for k, v in flat.items() if k.startswith("vars/")}
     opt_flat = {k[len("opt/"):]: v for k, v in flat.items() if k.startswith("opt/")}
     algo_flat = {k[len("algo/"):]: v for k, v in flat.items() if k.startswith("algo/")}
+    sched_flat = {k[len("sched/"):]: v for k, v in flat.items() if k.startswith("sched/")}
     global_vars = _unflatten(vars_flat)
     opt_state = _unflatten(opt_flat) if meta.get("has_opt") else None
     algo_state = _unflatten(algo_flat) if meta.get("has_algo") else None
-    return global_vars, meta["round_idx"], rng, opt_state, algo_state
+    sched_state = _unflatten(sched_flat) if meta.get("has_sched") else None
+    return global_vars, meta["round_idx"], rng, opt_state, algo_state, sched_state
 
 
 def _to_numpy(tree):

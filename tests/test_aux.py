@@ -42,7 +42,7 @@ def test_checkpoint_roundtrip(tmp_path):
         p, params, round_idx=5, rng=np.asarray(rng),
         server_opt_state=opt_state, algo_state=algo_state,
     )
-    vars2, round_idx, rng2, opt2_raw, algo2 = load_checkpoint(p)
+    vars2, round_idx, rng2, opt2_raw, algo2, _ = load_checkpoint(p)
     assert round_idx == 5
     np.testing.assert_array_equal(algo2["c"], algo_state["c"])
     np.testing.assert_array_equal(np.asarray(rng), rng2)

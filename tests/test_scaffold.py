@@ -177,7 +177,7 @@ def test_checkpoint_resume_preserves_control_variates(tmp_path):
     )
 
     resumed = ScaffoldAPI(cfg, data, model)
-    loaded_vars, round_idx, _, _, algo_state = load_checkpoint(p)
+    loaded_vars, round_idx, _, _, algo_state, _ = load_checkpoint(p)
     from fedml_tpu.utils.checkpoint import restore_like
 
     resumed.global_vars = restore_like(resumed.global_vars, loaded_vars)
