@@ -114,6 +114,35 @@ print(f"  scheduler ok: selected {sel}, "
 PY
 rm -rf "$SCHEDDIR"
 
+echo "== compile warmup smoke: AOT warmup + hardened persistent cache (docs/COMPILE.md) =="
+# Same config twice over ONE cache dir: the scan-LSTM round compiles
+# slowly enough (>= 2 s) to clear the conservative persistence threshold,
+# so run 2 must LOAD its compile (persistent hit) and report strictly
+# lower measured compile time — and warmup runs are numerically identical.
+CCDIR=$(mktemp -d); CLOG1=$(mktemp -d); CLOG2=$(mktemp -d)
+for log in "$CLOG1" "$CLOG2"; do
+  python -m fedml_tpu --algorithm fedavg --model rnn \
+    --dataset shakespeare_synth --client_num_in_total 4 \
+    --client_num_per_round 2 --comm_round 1 --epochs 1 --batch_size 8 \
+    --warmup --compile_cache_dir "$CCDIR" --log_dir "$log" > /dev/null
+done
+python - "$CLOG1" "$CLOG2" <<'PY'
+import json, sys
+s1 = json.load(open(f"{sys.argv[1]}/summary.json"))
+s2 = json.load(open(f"{sys.argv[2]}/summary.json"))
+assert s1["compile/persistent_puts"] >= 1, s1   # cold run persisted a compile
+assert s2["compile/persistent_hits"] > 0, s2    # repeat run loaded it
+assert s2["compile/persistent_quarantined"] == 0, s2
+assert s2["compile/compile_s"] < s1["compile/compile_s"], (
+    s1["compile/compile_s"], s2["compile/compile_s"])
+assert s1["compile/round_compile_s"] > 0 and s1["compile/cache_misses"] > 0
+assert s2["Test/Loss"] == s1["Test/Loss"]       # warmup+cache never change numerics
+print(f"  compile ok: warmup compile {s1['compile/compile_s']:.2f}s -> "
+      f"{s2['compile/compile_s']:.2f}s with {int(s2['compile/persistent_hits'])} "
+      f"persistent hit(s), numerics identical")
+PY
+rm -rf "$CCDIR" "$CLOG1" "$CLOG2"
+
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 

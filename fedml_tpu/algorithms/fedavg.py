@@ -180,6 +180,7 @@ def make_fedavg_round(
     post_aggregate: Optional[Callable] = None,
     aggregate_fn: Optional[Callable] = None,
     client_mode: Optional[str] = None,
+    client_metrics: bool = False,
 ):
     """Build the jitted FedAvg round function (vmap over clients, one chip).
 
@@ -191,6 +192,15 @@ def make_fedavg_round(
     arguments beyond client_rngs are forwarded to both hooks (e.g. a noise
     rng supplied by the API's _place_batch).
 
+    ``client_metrics=True`` additionally returns per-client
+    ``client_loss_sum``/``client_count`` vectors (leading client axis)
+    alongside the scalar sums — the true per-client loss signal
+    ``power_of_choice`` selection biases on (cohort-mean feeding made the
+    simulator's bias signal diverge from the transports', ROADMAP item).
+    Off by default: callers that combine metric trees across cohorts of
+    different sizes (the hierarchical group loop) must not see
+    ragged-shaped leaves.
+
     The returned callable takes an optional keyword ``may_pad`` — the
     host's static knowledge of whether this cohort has any all-padding
     local step (see :func:`resolve_skip_empty_steps`). Each distinct
@@ -199,31 +209,76 @@ def make_fedavg_round(
     mode = client_mode or resolve_client_parallelism(
         config.fed.client_parallelism, model
     )
+    # Program dedup (fedml_tpu/compile/): the jit cache is keyed by the
+    # jit OBJECT, so every factory call would otherwise compile its own
+    # copy of a structurally identical round. When the program is fully
+    # determined by describable fields (no opaque hooks), route through
+    # the process-wide ProgramCache; opaque callables bypass it — an
+    # over-merged digest would be silent wrong numerics.
+    from fedml_tpu.compile import (
+        get_program_cache,
+        hooks_cacheable,
+        model_fingerprint,
+    )
+
+    cacheable = hooks_cacheable(
+        local_train_fn, post_train, post_aggregate, aggregate_fn
+    )
 
     def build(skip: bool):
-        local_train = local_train_fn or make_local_train(
-            model, config.train, config.fed.epochs, task=task,
-            skip_empty_steps=skip,
+        def builder():
+            local_train = local_train_fn or make_local_train(
+                model, config.train, config.fed.epochs, task=task,
+                skip_empty_steps=skip,
+            )
+            lifted = client_axis_map(local_train, mode)
+
+            def round_fn(global_vars, x, y, mask, num_samples, client_rngs, *extra):
+                client_vars, metrics = lifted(global_vars, x, y, mask, client_rngs)
+                if post_train is not None:
+                    client_vars = post_train(client_vars, global_vars, *extra)
+                # aggregate_fn replaces the weighted average outright (Byzantine-
+                # robust aggregators: median/trimmed-mean/Krum; DP's fixed-
+                # denominator estimator needs w_t, hence the third argument)
+                if aggregate_fn is not None:
+                    new_global = aggregate_fn(client_vars, num_samples, global_vars)
+                else:
+                    new_global = weighted_average(client_vars, num_samples)
+                if post_aggregate is not None:
+                    new_global = post_aggregate(new_global, *extra)
+                agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)
+                if (
+                    client_metrics
+                    and isinstance(metrics, dict)
+                    and "loss_sum" in metrics
+                    and "count" in metrics
+                ):
+                    # per-client loss signal for power_of_choice — the
+                    # stacked (pre-sum) vectors ride along with the sums
+                    agg_metrics["client_loss_sum"] = metrics["loss_sum"]
+                    agg_metrics["client_count"] = metrics["count"]
+                return new_global, agg_metrics
+
+            return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+
+        cache = get_program_cache()
+        if not cacheable:
+            return cache.wrap_uncached("fedavg_round", builder())
+        return cache.get_or_build(
+            "fedavg_round",
+            {
+                "kind": "fedavg_round",
+                "model": model_fingerprint(model),
+                "train": config.train,
+                "epochs": config.fed.epochs,
+                "task": task,
+                "mode": mode,
+                "skip": skip,
+                "donate": donate,
+                "client_metrics": client_metrics,
+            },
+            builder,
         )
-        lifted = client_axis_map(local_train, mode)
-
-        def round_fn(global_vars, x, y, mask, num_samples, client_rngs, *extra):
-            client_vars, metrics = lifted(global_vars, x, y, mask, client_rngs)
-            if post_train is not None:
-                client_vars = post_train(client_vars, global_vars, *extra)
-            # aggregate_fn replaces the weighted average outright (Byzantine-
-            # robust aggregators: median/trimmed-mean/Krum; DP's fixed-
-            # denominator estimator needs w_t, hence the third argument)
-            if aggregate_fn is not None:
-                new_global = aggregate_fn(client_vars, num_samples, global_vars)
-            else:
-                new_global = weighted_average(client_vars, num_samples)
-            if post_aggregate is not None:
-                new_global = post_aggregate(new_global, *extra)
-            agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)
-            return new_global, agg_metrics
-
-        return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
 
     # A caller-supplied local_train_fn fixed its own skip choice at build
     # time — only the default local train can vary per cohort.
@@ -276,6 +331,7 @@ def make_fedavg_multiround(
     (steps, bs): the round body, the fold_in/split PRNG stream, and the
     weighted average are the same code."""
     from fedml_tpu.data.device_store import _gather
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
 
     mode = client_mode or resolve_client_parallelism(
         config.fed.client_parallelism, model
@@ -331,7 +387,24 @@ def make_fedavg_multiround(
         )
         return gv, mets
 
-    return jax.jit(multi_fn, donate_argnums=(0,))
+    cache = get_program_cache()
+    if local_train_fn is not None:
+        return cache.wrap_uncached("fedavg_multiround", jax.jit(multi_fn, donate_argnums=(0,)))
+    return cache.get_or_build(
+        "fedavg_multiround",
+        {
+            "kind": "fedavg_multiround",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "mode": mode,
+            "steps": steps,
+            "bs": bs,
+            "may_pad": may_pad,
+        },
+        lambda: jax.jit(multi_fn, donate_argnums=(0,)),
+    )
 
 
 class FedAvgAPI:
@@ -353,6 +426,12 @@ class FedAvgAPI:
     # per-round host-side work (server optimizer step, robust post hooks)
     # set this False.
     _supports_fused = True
+    # Whether this API's round fn may return per-client loss vectors
+    # (power_of_choice's true bias signal). Subclasses that combine metric
+    # trees across cohorts of different sizes (hierarchical groups) or
+    # whose round programs don't emit the vectors (mesh shard_map) fall
+    # back to the cohort-mean signal.
+    _client_loss_vectors = True
 
     def __init__(
         self,
@@ -417,6 +496,21 @@ class FedAvgAPI:
             config, health=self.health, tracer=self._tracer
         )
         self._fault_cache: dict = {}  # round -> post-fault survivors
+        # rounds whose TRUE per-client losses were already fed to the
+        # scheduler (train_round's vector fetch) — _log_round must not
+        # overwrite them with the cohort mean
+        self._client_loss_rounds: set = set()
+        # round -> placed device batch, populated by the AOT warmup path
+        # and consumed (popped) by train_round so warmup's signature
+        # derivation doesn't double the round-0 stack + H2D cost
+        self._warm_placed: dict = {}
+        # (start_round, n_rounds) -> (fn, rest): same contract for the
+        # fused path — the chunk's gather-index/mask stacking and H2D
+        # transfer is paid once at warmup, not again at dispatch. Valid
+        # across the warmup->train gap because every rest component is
+        # deterministic in (round, config.seed) and self.rng is never
+        # reassigned after __init__.
+        self._warm_fused: dict = {}
         self._store = None
         if self._use_device_store and config.data.device_cache:
             from fedml_tpu.data.device_store import DeviceDataStore, fits_on_device
@@ -451,7 +545,32 @@ class FedAvgAPI:
             local_train_fn=local_train_fn,
             donate=self._donate,
             client_mode=self._client_mode,
+            client_metrics=self._wants_client_losses(),
         )
+
+    def _wants_client_losses(self) -> bool:
+        """True when the round program should emit per-client loss
+        vectors: the selection policy feeds on per-client losses AND this
+        API's round family supports the vectors. Derived from config (not
+        the scheduler object — the round fn is built before it)."""
+        return (
+            self._client_loss_vectors
+            and self.config.fed.selection == "power_of_choice"
+        )
+
+    def warmup(self, log_fn=None):
+        """AOT-compile this run's programs before round 0
+        (``jit(...).lower(...).compile()`` — fedml_tpu/compile/warmup.py):
+        the round program for ``start_round``'s cohort shapes (the fused
+        chunk program when the planner would fuse), the eval program, and
+        the server-optimizer step when present. Emits ``compile``
+        telemetry spans and forwards per-program compile seconds + XLA
+        cost analysis (flops/bytes) through ``log_fn`` into summary.json.
+        Executes nothing — warm runs are numerically identical to cold
+        runs (tests/test_compile.py)."""
+        from fedml_tpu.compile import warmup_api
+
+        return warmup_api(self, log_fn=log_fn or self.log_fn)
 
     def train_round(self, round_idx: int):
         # _round_plan is the one derivation of "this round's cohort" —
@@ -462,9 +581,16 @@ class FedAvgAPI:
         with self._tracer.span(
             "broadcast", round=round_idx, clients=len(sampled)
         ):
-            batch = self._round_batch(sampled, round_idx)
-            rng = jax.random.fold_in(self.rng, round_idx + 1)
-            placed = self._place_batch(batch, rng)
+            # the AOT warmup path already stacked + placed this round's
+            # batch to derive its lowering signature — consume it instead
+            # of paying the host stack + H2D transfer twice (the inputs
+            # are pure functions of (round, rng), so the values are
+            # identical either way)
+            placed = self._warm_placed.pop(round_idx, None)
+            if placed is None:
+                batch = self._round_batch(sampled, round_idx)
+                rng = jax.random.fold_in(self.rng, round_idx + 1)
+                placed = self._place_batch(batch, rng)
         kw = {}
         if getattr(self.round_fn, "supports_may_pad", False):
             kw["may_pad"] = self._round_may_pad(round_idx)
@@ -478,7 +604,28 @@ class FedAvgAPI:
             self.global_vars, metrics = self.round_fn(
                 self.global_vars, *placed, **kw
             )
+        if (
+            isinstance(metrics, dict)
+            and "client_loss_sum" in metrics
+            and self.scheduler.wants_client_losses
+        ):
+            self._report_client_losses(sampled, metrics, round_idx)
         return sampled, metrics
+
+    def _report_client_losses(self, sampled, metrics, round_idx: int):
+        """Feed the scheduler TRUE per-client losses from the round's
+        ``client_loss_sum``/``client_count`` vectors — the same per-client
+        mean the transport clients attach to their uploads
+        (ARG_TRAIN_LOSS), so sim and transport power_of_choice bias on
+        identical signals and select identical cohorts. The fetch blocks
+        on the round (adaptive policies already run eager, per-round —
+        _fused_chunk_len disables chunking for them)."""
+        losses = np.asarray(metrics["client_loss_sum"])[: len(sampled)]
+        counts = np.asarray(metrics["client_count"])[: len(sampled)]
+        for cid, s, c in zip(sampled, losses, counts):
+            if c > 0:
+                self.scheduler.report_loss(int(cid), float(s) / float(c))
+        self._client_loss_rounds.add(int(round_idx))
 
     def _client_counts(self, sampled):
         if self._store is not None:
@@ -583,20 +730,27 @@ class FedAvgAPI:
             )
         return np.asarray(self.data.test_x), np.asarray(self.data.test_y)
 
-    def evaluate_global(self):
-        """(loss, acc) of the global model on the central test set, with the
-        padded test batches cached on device (the host arrays would
-        otherwise be re-shipped every eval)."""
+    def _eval_batches(self):
+        """The central test set as padded device batches, cached (the host
+        arrays would otherwise be re-shipped every eval). Shared by
+        evaluate_global and the AOT warmup path, so the warmed eval
+        program sees exactly the shapes the run will dispatch."""
         from fedml_tpu.train.evaluate import pad_to_batches
-
-        from fedml_tpu.train.evaluate import metrics_to_loss_acc
 
         if self._test_dev is None:
             xb, yb, mb = pad_to_batches(
                 np.asarray(self.data.test_x), np.asarray(self.data.test_y), 256
             )
             self._test_dev = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
-        return metrics_to_loss_acc(self.eval_fn(self.global_vars, *self._test_dev))
+        return self._test_dev
+
+    def evaluate_global(self):
+        """(loss, acc) of the global model on the central test set."""
+        from fedml_tpu.train.evaluate import metrics_to_loss_acc
+
+        return metrics_to_loss_acc(
+            self.eval_fn(self.global_vars, *self._eval_batches())
+        )
 
     def round_flops(self, round_idx: int = 0):
         """XLA-costed FLOPs of one round call at this round's batch shapes
@@ -786,6 +940,19 @@ class FedAvgAPI:
         """Run rounds [start_round, start_round+n_rounds) as one on-device
         scan (see :func:`make_fedavg_multiround`). Returns stacked per-round
         metrics {loss_sum, correct, count, steps: [T]}."""
+        plan = self._warm_fused.pop((start_round, n_rounds), None)
+        fn, rest = plan if plan is not None else self._fused_plan(
+            start_round, n_rounds
+        )
+        self.global_vars, metrics = fn(self.global_vars, *rest)
+        return metrics
+
+    def _fused_plan(self, start_round: int, n_rounds: int):
+        """(fused program, its non-model args) for one chunk — the round
+        indices/masks/weights plus the jitted multi-round fn from the
+        per-shape cache. Split out of :meth:`train_rounds_fused` so the
+        AOT warmup path can lower/compile the exact chunk program round 0
+        will dispatch without executing it."""
         cfg = self.config
         store = self._store
         if cfg.data.batch_size == -1:
@@ -843,8 +1010,7 @@ class FedAvgAPI:
                 may_pad=chunk_may_pad,
             )
             self._fused_fns[key] = fn
-        self.global_vars, metrics = fn(
-            self.global_vars,
+        return fn, (
             store.flat_x,
             store.flat_y,
             jnp.asarray(np.stack(idxs)),
@@ -853,7 +1019,6 @@ class FedAvgAPI:
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32),
             self.rng,
         )
-        return metrics
 
     def _log_round(self, round_idx: int, metrics, round_time_s: float) -> dict:
         cfg = self.config
@@ -864,12 +1029,14 @@ class FedAvgAPI:
             "Train/Acc": float(metrics["correct"]) / max(count, 1e-9),
             "round_time_s": round_time_s,
         }
-        # feed power_of_choice: the vmap cohort trains as ONE program, so
-        # the only per-round loss signal here is the cohort mean — report
-        # it to every participant (the transport runtimes report true
-        # per-client losses off the upload messages instead)
-        for cid in self._round_plan(round_idx)[0]:
-            self.scheduler.report_loss(int(cid), row["Train/Loss"])
+        # feed power_of_choice: rounds whose program emitted per-client
+        # loss vectors already reported TRUE per-client losses
+        # (_report_client_losses — sim/transport parity); everything else
+        # (fused chunks, mesh/hierarchical rounds) falls back to the
+        # cohort mean reported to every participant
+        if round_idx not in self._client_loss_rounds:
+            for cid in self._round_plan(round_idx)[0]:
+                self.scheduler.report_loss(int(cid), row["Train/Loss"])
         if self._is_eval_round(round_idx):
             with self._tracer.span("eval", round=round_idx):
                 if cfg.fed.eval_on_clients:

@@ -73,6 +73,28 @@ class FedAvgAggregator:
         return jax.device_get(avg)
 
 
+def shared_local_train(model: ModelDef, config: RunConfig, task: str):
+    """THE jitted client local-train program for a transport federation,
+    deduped through the process-wide ProgramCache (fedml_tpu/compile/):
+    every LocalTrainer, every runner, and every test module building the
+    same (model, train config, epochs, task) shares one compile."""
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
+    return get_program_cache().get_or_build(
+        "local_train",
+        {
+            "kind": "local_train",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+        },
+        lambda: jax.jit(
+            make_local_train(model, config.train, config.fed.epochs, task=task)
+        ),
+    )
+
+
 class LocalTrainer:
     """Client-side trainer wrapper (ref FedAVGTrainer.py:7-54: update_dataset
     by client_index, train(round) -> (weights, local_sample_number))."""
@@ -90,9 +112,11 @@ class LocalTrainer:
         self.data = data
         self.model = model
         # Share one jitted fn across in-process trainers — K distinct
-        # closures would defeat the jit cache and compile K times.
-        self.local_train = local_train_fn or jax.jit(
-            make_local_train(model, config.train, config.fed.epochs, task=task)
+        # closures would defeat the jit cache and compile K times. The
+        # program cache (fedml_tpu/compile/) extends that sharing across
+        # trainer instances and processes' test modules.
+        self.local_train = local_train_fn or shared_local_train(
+            model, config, task
         )
         self.client_index = 0
         # Simulated compute heterogeneity: sleep this long after every
@@ -136,9 +160,15 @@ class LocalTrainer:
         n = len(self.data.client_y[self.client_index])
         out = jax.device_get(new_vars)
         try:
-            self.last_loss = float(
-                np.asarray(m["loss_sum"])
-            ) / max(float(np.asarray(m["count"])), 1e-9)
+            count = float(np.asarray(m["count"]))
+            # a zero-sample shard has no loss signal: report None (upload
+            # omits ARG_TRAIN_LOSS, client stays "cold" for
+            # power_of_choice) exactly like the sim's c > 0 skip in
+            # _report_client_losses — a fabricated 0.0 would rank the
+            # client last in sim/transport-divergent ways
+            self.last_loss = (
+                float(np.asarray(m["loss_sum"])) / count if count > 0 else None
+            )
         except (KeyError, TypeError):  # custom local_train_fn metric shape
             self.last_loss = None
         if self.straggle_s:
@@ -159,6 +189,7 @@ class FedAvgServerManager(ServerManager):
         worker_num: Optional[int] = None,
         log_fn=None,
         server_opt: bool = False,
+        faults=None,
     ):
         super().__init__(comm, rank=0)
         self.config = config
@@ -197,9 +228,20 @@ class FedAvgServerManager(ServerManager):
                 make_server_optimizer,
                 make_server_step,
             )
+            from fedml_tpu.compile import get_program_cache
 
             self._server_optimizer = make_server_optimizer(config.server)
-            self._server_step = jax.jit(make_server_step(self._server_optimizer))
+            # program dedup: the step's code is determined by the server
+            # config alone (param shapes are a jit shape class)
+            self._server_step = get_program_cache().get_or_build(
+                "server_opt",
+                {
+                    "kind": "fedopt_server_step",
+                    "server": config.server,
+                    "step_builder": make_server_step,
+                },
+                lambda: jax.jit(make_server_step(self._server_optimizer)),
+            )
         self.round_idx = 0
         # Straggler deadline state (FedConfig.deadline_s/min_clients). The
         # timer thread races the comm receive loop; _round_lock serializes
@@ -218,9 +260,21 @@ class FedAvgServerManager(ServerManager):
         # after 3 consecutive firings with NO new upload the round is
         # abandoned with whatever arrived (possibly nothing — the model
         # then carries over unchanged), loudly, instead of hanging.
-        from fedml_tpu.scheduler import FaultPlan
+        #
+        # The plan is read off the ONE FaultInjector the runner plumbs in
+        # (run_federation) — re-parsing FedConfig.fault_plan here would
+        # re-read the plan file and open a drift window where the valve
+        # and the injected faults disagree (a plan file swapped between
+        # the two parses). Direct constructions without an injector
+        # (grpc rank 0: the clients inject in their own processes) parse
+        # once as a fallback.
+        self.faults = faults
+        if faults is not None:
+            _plan = faults.plan
+        else:
+            from fedml_tpu.scheduler import FaultPlan
 
-        _plan = FaultPlan.from_config(config)
+            _plan = FaultPlan.from_config(config)
         self._stall_valve = (
             _plan is not None and _plan.has_participation_faults()
         )
@@ -909,6 +963,7 @@ def run_federation(
     log_fn=None,
     trainer_factory=None,
     server_opt: bool = False,
+    warmup: bool = False,
 ):
     """One-process federation over any transport: 1 server + K client actors
     in threads, each on ``comm_factory(rank)`` (a BaseCommManager) — the
@@ -920,8 +975,15 @@ def run_federation(
 
     One worker is spawned per scheduler slot — ``ceil(client_num_per_round
     * overprovision_factor)`` of them — and a FedConfig.fault_plan, if
-    set, is applied through ONE shared FaultInjector so the run's fault
-    counters land in summary.json and the server's health registry."""
+    set, is parsed ONCE into a single FaultInjector shared by every
+    client actor AND the server's stall valve (no repeat file reads, no
+    plan-swapped-mid-startup drift); its counters land in summary.json
+    and the server's health registry.
+
+    ``warmup=True`` AOT-compiles the shared local-train program for the
+    round-0 cohort's shape classes BEFORE any worker thread starts — the
+    warmup barrier that lets ``deadline_s`` rounds begin with compilation
+    already paid instead of racing a cold compile."""
     from fedml_tpu.scheduler import FaultInjector, overprovisioned_k
 
     K = overprovisioned_k(
@@ -929,19 +991,7 @@ def run_federation(
         config.fed.overprovision_factor,
         config.fed.client_num_in_total,
     )
-    server = FedAvgServerManager(
-        config,
-        comm_factory(0),
-        model,
-        data=data,
-        task=task,
-        worker_num=K,
-        log_fn=log_fn,
-        server_opt=server_opt,
-    )
-    injector = FaultInjector.from_config(
-        config, health=server.health, tracer=get_tracer()
-    )
+    injector = FaultInjector.from_config(config, tracer=get_tracer())
     if (
         injector is not None
         and injector.plan.has_participation_faults()
@@ -952,9 +1002,33 @@ def run_federation(
             "deadline_s is 0: the server's all-received barrier would "
             "wait forever — set FedConfig.deadline_s/min_clients"
         )
-    shared_train = jax.jit(
-        make_local_train(model, config.train, config.fed.epochs, task=task)
+    server = FedAvgServerManager(
+        config,
+        comm_factory(0),
+        model,
+        data=data,
+        task=task,
+        worker_num=K,
+        log_fn=log_fn,
+        server_opt=server_opt,
+        faults=injector,
     )
+    if injector is not None:
+        # the injector predates the server (the server's stall valve reads
+        # its plan); point its fault accounting at the server's registry
+        injector.health = server.health
+    shared_train = shared_local_train(model, config, task)
+    if warmup and trainer_factory is None:
+        from fedml_tpu.compile import warmup_local_train
+
+        warmup_local_train(
+            shared_train,
+            config,
+            data,
+            server.global_vars,
+            server.scheduler.select(0, k=K),  # memoized: send_init_msg reuses it
+            log_fn=log_fn,
+        )
     make_trainer = trainer_factory or (
         lambda rank: LocalTrainer(
             config, data, model, task, local_train_fn=shared_train
@@ -1027,6 +1101,7 @@ def run_loopback_federation(
     task: str = "classification",
     log_fn=None,
     server_opt: bool = False,
+    warmup: bool = False,
 ):
     """Federation over the in-process loopback hub (see run_federation)."""
     hub = LoopbackHub()
@@ -1038,6 +1113,7 @@ def run_loopback_federation(
         task=task,
         log_fn=log_fn,
         server_opt=server_opt,
+        warmup=warmup,
     )
 
 
@@ -1049,6 +1125,7 @@ def run_shm_federation(
     log_fn=None,
     sock_dir: Optional[str] = None,
     server_opt: bool = False,
+    warmup: bool = False,
 ):
     """Federation over the shared-memory local transport (TRPC-equivalent,
     ref trpc_comm_manager.py:25-114): bulk tensors ride POSIX shared memory,
@@ -1066,6 +1143,7 @@ def run_shm_federation(
             task=task,
             log_fn=log_fn,
             server_opt=server_opt,
+            warmup=warmup,
         )
 
 
@@ -1078,6 +1156,7 @@ def run_mqtt_federation(
     host: str = None,
     port: int = 1883,
     server_opt: bool = False,
+    warmup: bool = False,
 ):
     """Federation over MQTT pub/sub (ref mqtt_comm_manager.py:14-123):
     embedded in-process broker by default, real broker when host given."""
@@ -1090,5 +1169,5 @@ def run_mqtt_federation(
         factory = lambda rank: MqttCommManager(rank, host=host, port=port)
     return run_federation(
         config, data, model, factory, task=task, log_fn=log_fn,
-        server_opt=server_opt,
+        server_opt=server_opt, warmup=warmup,
     )

@@ -102,7 +102,26 @@ def make_fednova_round(model, config, task="classification", local_train_fn=None
         agg_metrics = jax.tree_util.tree_map(jnp.sum, metrics)
         return new_global, agg_metrics
 
-    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+    # program dedup (fedml_tpu/compile/): one jitted FedNova round per
+    # (model, train config, epochs, task) per process
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
+    cache = get_program_cache()
+    builder = lambda: jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+    if local_train_fn is not None:
+        return cache.wrap_uncached("fednova_round", builder())
+    return cache.get_or_build(
+        "fednova_round",
+        {
+            "kind": "fednova_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "donate": donate,
+        },
+        builder,
+    )
 
 
 class FedNovaAPI(FedAvgAPI):
@@ -181,7 +200,32 @@ def make_sharded_fednova_round(model, config, mesh, task="classification", local
         in_specs=(P(),) + (spec,) * 5,
         out_specs=(P(), P()),
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    # program dedup (fedml_tpu/compile/): keyed like the sharded FedAvg
+    # round — the mesh fingerprint is part of the program's identity
+    from fedml_tpu.compile import (
+        get_program_cache,
+        mesh_fingerprint,
+        model_fingerprint,
+    )
+
+    cache = get_program_cache()
+    builder = lambda: jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    if local_train_fn is not None:
+        return cache.wrap_uncached("sharded_fednova_round", builder())
+    return cache.get_or_build(
+        "sharded_fednova_round",
+        {
+            "kind": "sharded_fednova_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "mesh": mesh_fingerprint(mesh),
+            "donate": donate,
+        },
+        builder,
+    )
 
 
 # The mesh-runtime driver (DistributedFedNovaAPI) lives in

@@ -78,10 +78,24 @@ class FedOptAPI(FedAvgAPI):
         super().__init__(config, data, model, **kw)
         self.server_opt = make_server_optimizer(config.server)
         self.server_opt_state = self.server_opt.init(self.global_vars["params"])
-        self._server_step = jax.jit(self._make_server_step())
+        # program dedup (fedml_tpu/compile/): the server step's CODE is
+        # fully determined by the server config (the param tree enters as
+        # a jit shape class, not a program determinant) — one jit object
+        # serves every model and every API instance in the process
+        from fedml_tpu.compile import get_program_cache
 
-    def _make_server_step(self):
-        return make_server_step(self.server_opt)
+        # step_builder marker MUST be the module-level make_server_step —
+        # the transport server manager (fedavg_transport) keys the same
+        # program with it, so both sides dedup onto ONE executable
+        self._server_step = get_program_cache().get_or_build(
+            "server_opt",
+            {
+                "kind": "fedopt_server_step",
+                "server": config.server,
+                "step_builder": make_server_step,
+            },
+            lambda: jax.jit(make_server_step(self.server_opt)),
+        )
 
     def train_round(self, round_idx: int):
         old_vars = self.global_vars

@@ -50,6 +50,10 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
     # The global model is fed to several group sub-rounds; donation would
     # invalidate it after the first group.
     _donate = False
+    # Group sub-rounds have ragged cohort sizes and their metric trees are
+    # tree_map-summed across groups — per-client loss vectors would make
+    # the leaves ragged; power_of_choice keeps the cohort-mean signal here.
+    _client_loss_vectors = False
 
     def __init__(self, config, data, model, groups: Sequence[np.ndarray] = None, **kw):
         super().__init__(config, data, model, **kw)

@@ -124,7 +124,30 @@ def make_qfedavg_round(
         new_global = qfedavg_update(global_vars, client_vars, losses, lr, q)
         return new_global, jax.tree_util.tree_map(jnp.sum, metrics)
 
-    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+    # program dedup (fedml_tpu/compile/): q and lr are baked into the
+    # traced update as program CONSTANTS, so both must determine the
+    # digest (q explicitly; lr rides in config.train) — the scaffold
+    # server-constant lesson
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
+    cache = get_program_cache()
+    builder = lambda: jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+    if local_train_fn is not None:
+        return cache.wrap_uncached("qfedavg_round", builder())
+    return cache.get_or_build(
+        "qfedavg_round",
+        {
+            "kind": "qfedavg_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "mode": mode,
+            "q": float(q),
+            "donate": donate,
+        },
+        builder,
+    )
 
 
 class QFedAvgAPI(FedAvgAPI):

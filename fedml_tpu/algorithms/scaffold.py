@@ -188,7 +188,32 @@ def make_scaffold_round(
         )
         return new_global, c_server_new, c_stack_new, agg
 
-    return jax.jit(round_fn, donate_argnums=(2,) if donate else ())
+    # program dedup (fedml_tpu/compile/): one jitted SCAFFOLD round per
+    # (model, train config, epochs, task, schedule) per process
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
+    return get_program_cache().get_or_build(
+        "scaffold_round",
+        {
+            "kind": "scaffold_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            # client_mode=None resolves inside the body from this config
+            # field — both enter the key so "vmap" and "scan" programs
+            # can never merge
+            "mode": client_mode,
+            "parallelism": config.fed.client_parallelism,
+            # the cohort body BAKES IN the server lr (η_g) and the /N of
+            # the c-server update — they are program constants, not shape
+            # classes, and merging across them is wrong numerics
+            "server": config.server,
+            "n_total": config.fed.client_num_in_total,
+            "donate": donate,
+        },
+        lambda: jax.jit(round_fn, donate_argnums=(2,) if donate else ()),
+    )
 
 
 def _make_scaffold_cohort_body(model, config, task, client_mode):

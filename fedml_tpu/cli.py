@@ -26,6 +26,7 @@ import numpy as np
 
 from fedml_tpu.config import (
     CommConfig,
+    CompileConfig,
     DataConfig,
     FedConfig,
     MeshConfig,
@@ -234,6 +235,21 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="Transport runtimes: pairwise-masked uploads — the "
                    "server only ever sums masked field vectors (ref "
                    "turboaggregate); quorum rounds recover dropout masks")
+@click.option("--warmup", is_flag=True, default=False,
+              help="AOT-compile the run's programs before round 0 "
+                   "(fedml_tpu/compile/warmup.py): round/eval/server "
+                   "programs on vmap/mesh, the shared client local-train "
+                   "on loopback/shm/mqtt (so --deadline_s rounds start "
+                   "with compilation already paid). Emits compile "
+                   "telemetry spans + per-program XLA cost analysis into "
+                   "summary.json; numerics are identical to a cold run")
+@click.option("--compile_cache_dir", type=click.Path(path_type=Path), default=None,
+              help="Enable the hardened persistent XLA compile cache at "
+                   "this directory (fedml_tpu/compile/persistent.py: "
+                   "atomic writes, sha256 integrity verification with "
+                   "quarantine, advisory file lock). Pass a fresh "
+                   "directory for a per-run cache; cache hit/miss/"
+                   "quarantine counts land in summary.json (compile/*)")
 @click.option("--rank", type=int, default=None,
               help="runtime=grpc: this process's rank (0 = server, 1..K = "
                    "clients; ref main_fedavg_rpc.py --fl_worker_index)")
@@ -332,6 +348,64 @@ def _validate_scheduler(config, opt) -> None:
         )
 
 
+# Algorithms whose round-0 programs warmup_api/warmup_local_train can
+# actually enumerate: the standard FedAvgAPI round/eval/server-step family.
+# scaffold/ditto/dp_fedavg/hierarchical run bespoke train_round loops
+# (their _build_round_fn is None or their cohorts reshape per group/draw),
+# so warming there would either no-op or compile a program the run never
+# dispatches — strictly worse than no flag.
+_WARMUP_ALGOS = (
+    "fedavg", "fedprox", "fedopt", "fednova", "qfedavg", "fedavg_robust",
+)
+
+
+def _validate_compile(config, opt) -> None:
+    """--warmup covers the algorithm×runtime combinations whose round-0
+    programs can be enumerated up front; anywhere else the flag would
+    silently do nothing (or waste a compile) — fail at parse time
+    instead."""
+    if not config.compile.warmup:
+        return
+    if opt["algorithm"] == "fedbuff":
+        raise click.UsageError(
+            "--warmup is not supported for algorithm=fedbuff: its workers "
+            "stream continuously and compile on first dispatch; there is "
+            "no round-0 barrier to warm against"
+        )
+    if opt["algorithm"] not in _WARMUP_ALGOS:
+        raise click.UsageError(
+            f"--warmup is not supported for algorithm={opt['algorithm']}: "
+            "its driver builds its programs inside its own training loop, "
+            "so there is no round-0 program to enumerate up front "
+            f"(supported: {', '.join(_WARMUP_ALGOS)} on vmap/mesh and the "
+            "sync transports)"
+        )
+    if opt["runtime"] == "grpc":
+        raise click.UsageError(
+            "--warmup is not supported for runtime=grpc: each client "
+            "process owns its own programs — run the warmup in-process "
+            "via the loopback/shm runtimes, or rely on a shared "
+            "--compile_cache_dir to carry compiles across processes"
+        )
+
+
+def _log_compile(logger, baseline, restore=None) -> None:
+    """Forward the run's compile-cache activity (program dedup hits/misses
+    + hardened persistent-layer counters) into summary.json — the CI
+    oracle the ci.sh warmup smoke asserts on — then reinstate the
+    pre-run persistent-cache binding (the row must be logged FIRST: it
+    reads the run's installed cache). Called from the run() finally
+    blocks so a crashed run can't leave its per-run cache installed in
+    a long-lived process; the restore itself is exception-proof."""
+    from fedml_tpu.compile import compile_summary_row
+
+    try:
+        logger.log(compile_summary_row(baseline))
+    finally:
+        if restore is not None:
+            restore()
+
+
 def _checked_buffer_k(opt) -> int:
     """fedbuff's buffer size, validated at parse time (a 0/negative k would
     otherwise surface as a mid-run ValueError after data/model setup); 0
@@ -400,6 +474,10 @@ def build_config(opt) -> RunConfig:
             secure_agg=opt.get("secure_agg", False),
         ),
         mesh=MeshConfig(client_shards=opt["client_shards"]),
+        compile=CompileConfig(
+            warmup=opt.get("warmup", False),
+            cache_dir=str(opt.get("compile_cache_dir") or ""),
+        ),
         model=opt["model"],
         seed=opt["seed"],
     )
@@ -516,237 +594,280 @@ def run(**opt):
     # result is rebuilt at the _build_api call site
     _dp_cfg(opt)
     _validate_scheduler(config, opt)
-    if opt["runtime"] in ("vmap", "mesh"):
-        if config.comm.compression != "none":
-            raise click.UsageError(
-                "--compression applies to the transport runtimes "
-                "(loopback/shm/grpc/mqtt); the vmap/mesh runtimes exchange "
-                "no messages, so the flag would be silently ignored"
-            )
-        if config.fed.deadline_s or config.fed.min_clients != 1:
-            raise click.UsageError(
-                "--deadline_s/--min_clients apply to the transport runtimes "
-                "(loopback/shm/grpc/mqtt); vmap/mesh rounds are one SPMD "
-                "program with no uploads to time out on"
-            )
-    elif config.fed.min_clients != 1 and not config.fed.deadline_s:
-        raise click.UsageError(
-            "--min_clients only takes effect after a --deadline_s deadline "
-            "passes; without one the server still waits for every client"
+    _validate_compile(config, opt)
+    restore_compile_cache = None
+    if config.compile.cache_dir:
+        # BEFORE any jit: every compile of this run should be eligible
+        # for the hardened persistent store (compile/persistent.py).
+        # install_run_cache hands back a restore() that reinstates the
+        # previous binding when the run completes, so a run embedded in a
+        # long-lived process can't hijack later compiles onto its (maybe
+        # deleted) cache dir.
+        from fedml_tpu.compile import install_run_cache
+
+        _, restore_compile_cache = install_run_cache(
+            config.compile.cache_dir,
+            min_compile_time_secs=config.compile.min_compile_time_s,
         )
-    if config.comm.secure_agg:
+    from fedml_tpu.compile import compile_snapshot
+
+    # baseline for the summary.json compile row: a run embedded in a
+    # long-lived process (CliRunner tests, sweeps) reports ITS cache
+    # activity, not the process's lifetime totals
+    compile_baseline = compile_snapshot()
+    try:
         if opt["runtime"] in ("vmap", "mesh"):
-            raise click.UsageError(
-                "--secure_agg applies to the transport runtimes "
-                "(loopback/shm/grpc/mqtt)"
-            )
-        if config.comm.compression != "none":
-            raise click.UsageError(
-                "--secure_agg and --compression are mutually exclusive: "
-                "masked field vectors cannot be sparsified/quantized"
-            )
-    if config.comm.error_feedback:
-        if config.comm.compression != "topk":
-            raise click.UsageError(
-                "--error_feedback is a top-k residual memory; it requires "
-                "--compression topk"
-            )
-        if config.fed.deadline_s:
-            raise click.UsageError(
-                "--error_feedback assumes every upload is aggregated, but "
-                "--deadline_s quorum rounds can discard late uploads — the "
-                "shipped (and residual-cleared) coordinates would be lost"
-            )
-        if (
-            opt["runtime"] == "grpc"
-            and config.fed.client_num_per_round != config.fed.client_num_in_total
-        ):
-            raise click.UsageError(
-                "--error_feedback under runtime=grpc requires full "
-                "participation (client_num_per_round == client_num_in_total): "
-                "residuals live per process and cannot follow a client that "
-                "the sampler re-assigns to another rank"
-            )
-    data = data_registry.load(config)
-    task = data_registry.task_for_dataset(config.data.dataset)
-    sample_shape = tuple(data.client_x[0].shape[1:])
-    model = create_model(config.model, config.data.dataset, sample_shape, data.num_classes)
-
-    poison_spec = attack_cfg = None
-    if opt.get("attack", "none") == "backdoor":
-        if opt["algorithm"] != "fedavg_robust" or opt["runtime"] != "vmap":
-            raise click.UsageError(
-                "--attack backdoor requires --algorithm fedavg_robust "
-                "--runtime vmap"
-            )
-        from fedml_tpu.data.edge_cases import PoisonSpec, poison_clients
-        from fedml_tpu.robustness.backdoor import AttackConfig
-
-        k = opt.get("num_attackers", 1)
-        if not 0 < k < data.num_clients:
-            raise click.UsageError(
-                f"--num_attackers must be in [1, {data.num_clients - 1}]"
-            )
-        poison_spec = PoisonSpec(
-            target_label=opt.get("target_label", 0),
-            poison_frac=opt.get("poison_frac", 0.5),
-        )
-        # attacker ids derived ONCE — the poisoned shards and the boosted
-        # uploads must target the same client set
-        attack_cfg = AttackConfig(
-            attacker_ids=tuple(range(k)),
-            boost=opt.get("attack_boost", 10.0),
-        )
-        data = poison_clients(
-            data, attacker_ids=attack_cfg.attacker_ids, spec=poison_spec,
-            seed=config.seed,
-        )
-
-    if opt.get("enable_wandb"):
-        from fedml_tpu.utils.metrics import wandb_init
-
-        wandb_init(
-            name=f"{opt['algorithm']}-r{opt['comm_round']}"
-            f"-e{opt['epochs']}-lr{opt['lr']}",
-            config={k: str(v) for k, v in opt.items()},
-        )
-    logger = MetricsLogger(
-        str(opt["log_dir"]) if opt["log_dir"] else None,
-        use_wandb=opt.get("enable_wandb", False),
-    )
-    telemetry = _telemetry_start(opt)
-    api_cell = []
-
-    def log_fn(row):
-        logger.log(row)
-        # crash-resumable: persist on every test round, not just at the end.
-        # round_idx convention = "next round to run": row["round"] just
-        # completed, so the continuation starts at row["round"] + 1.
-        if opt["checkpoint_path"] and "Test/Acc" in row and api_cell:
-            api = api_cell[0]
-            gv = getattr(api, "global_vars", None)
-            if gv is not None:
-                save_checkpoint(
-                    str(opt["checkpoint_path"]),
-                    gv,
-                    round_idx=row["round"] + 1,
-                    server_opt_state=getattr(api, "server_opt_state", None),
-                    algo_state=getattr(
-                        api, "checkpoint_state", lambda: None
-                    )(),
-                    sched_state=_sched_state(api),
+            if config.comm.compression != "none":
+                raise click.UsageError(
+                    "--compression applies to the transport runtimes "
+                    "(loopback/shm/grpc/mqtt); the vmap/mesh runtimes exchange "
+                    "no messages, so the flag would be silently ignored"
                 )
-
-    _validate_variant(opt)
-    if opt["runtime"] == "grpc":
-        # true multi-process federation: this process is ONE participant
-        # (ref main_fedavg_rpc.py per-process drivers + run_*.sh launchers)
-        if opt["algorithm"] not in ("fedavg", "fedprox", "fedopt", "fedbuff"):
+            if config.fed.deadline_s or config.fed.min_clients != 1:
+                raise click.UsageError(
+                    "--deadline_s/--min_clients apply to the transport runtimes "
+                    "(loopback/shm/grpc/mqtt); vmap/mesh rounds are one SPMD "
+                    "program with no uploads to time out on"
+                )
+        elif config.fed.min_clients != 1 and not config.fed.deadline_s:
             raise click.UsageError(
-                "runtime=grpc supports fedavg/fedprox/fedopt/fedbuff"
+                "--min_clients only takes effect after a --deadline_s deadline "
+                "passes; without one the server still waits for every client"
             )
-        try:
-            final, grpc_health = _run_grpc_process(
-                config, data, model, task, log_fn, opt
-            )
-            _telemetry_finish(telemetry, opt, logger, health=grpc_health)
-        finally:
-            _telemetry_finish(telemetry, opt, logger)
-        logger.close()
-        click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
-        return None
+        if config.comm.secure_agg:
+            if opt["runtime"] in ("vmap", "mesh"):
+                raise click.UsageError(
+                    "--secure_agg applies to the transport runtimes "
+                    "(loopback/shm/grpc/mqtt)"
+                )
+            if config.comm.compression != "none":
+                raise click.UsageError(
+                    "--secure_agg and --compression are mutually exclusive: "
+                    "masked field vectors cannot be sparsified/quantized"
+                )
+        if config.comm.error_feedback:
+            if config.comm.compression != "topk":
+                raise click.UsageError(
+                    "--error_feedback is a top-k residual memory; it requires "
+                    "--compression topk"
+                )
+            if config.fed.deadline_s:
+                raise click.UsageError(
+                    "--error_feedback assumes every upload is aggregated, but "
+                    "--deadline_s quorum rounds can discard late uploads — the "
+                    "shipped (and residual-cleared) coordinates would be lost"
+                )
+            if (
+                opt["runtime"] == "grpc"
+                and config.fed.client_num_per_round != config.fed.client_num_in_total
+            ):
+                raise click.UsageError(
+                    "--error_feedback under runtime=grpc requires full "
+                    "participation (client_num_per_round == client_num_in_total): "
+                    "residuals live per process and cannot follow a client that "
+                    "the sampler re-assigns to another rank"
+                )
+        data = data_registry.load(config)
+        task = data_registry.task_for_dataset(config.data.dataset)
+        sample_shape = tuple(data.client_x[0].shape[1:])
+        model = create_model(config.model, config.data.dataset, sample_shape, data.num_classes)
 
-    builder = _LONGTAIL.get(opt["algorithm"])
-    if builder is not None:
-        if opt["resume"]:
-            raise click.UsageError(
-                f"--resume is not supported for algorithm={opt['algorithm']}"
+        poison_spec = attack_cfg = None
+        if opt.get("attack", "none") == "backdoor":
+            if opt["algorithm"] != "fedavg_robust" or opt["runtime"] != "vmap":
+                raise click.UsageError(
+                    "--attack backdoor requires --algorithm fedavg_robust "
+                    "--runtime vmap"
+                )
+            from fedml_tpu.data.edge_cases import PoisonSpec, poison_clients
+            from fedml_tpu.robustness.backdoor import AttackConfig
+
+            k = opt.get("num_attackers", 1)
+            if not 0 < k < data.num_clients:
+                raise click.UsageError(
+                    f"--num_attackers must be in [1, {data.num_clients - 1}]"
+                )
+            poison_spec = PoisonSpec(
+                target_label=opt.get("target_label", 0),
+                poison_frac=opt.get("poison_frac", 0.5),
             )
-        allowed_runtimes = (
-            ("vmap", "mesh") if opt["algorithm"] == "centralized" else ("vmap",)
+            # attacker ids derived ONCE — the poisoned shards and the boosted
+            # uploads must target the same client set
+            attack_cfg = AttackConfig(
+                attacker_ids=tuple(range(k)),
+                boost=opt.get("attack_boost", 10.0),
+            )
+            data = poison_clients(
+                data, attacker_ids=attack_cfg.attacker_ids, spec=poison_spec,
+                seed=config.seed,
+            )
+
+        if opt.get("enable_wandb"):
+            from fedml_tpu.utils.metrics import wandb_init
+
+            wandb_init(
+                name=f"{opt['algorithm']}-r{opt['comm_round']}"
+                f"-e{opt['epochs']}-lr{opt['lr']}",
+                config={k: str(v) for k, v in opt.items()},
+            )
+        logger = MetricsLogger(
+            str(opt["log_dir"]) if opt["log_dir"] else None,
+            use_wandb=opt.get("enable_wandb", False),
         )
-        if opt["runtime"] not in allowed_runtimes:
-            raise click.UsageError(
-                f"algorithm={opt['algorithm']} supports only "
-                f"--runtime {'|'.join(allowed_runtimes)}"
+        telemetry = _telemetry_start(opt)
+        api_cell = []
+
+        def log_fn(row):
+            logger.log(row)
+            # crash-resumable: persist on every test round, not just at the end.
+            # round_idx convention = "next round to run": row["round"] just
+            # completed, so the continuation starts at row["round"] + 1.
+            if opt["checkpoint_path"] and "Test/Acc" in row and api_cell:
+                api = api_cell[0]
+                gv = getattr(api, "global_vars", None)
+                if gv is not None:
+                    save_checkpoint(
+                        str(opt["checkpoint_path"]),
+                        gv,
+                        round_idx=row["round"] + 1,
+                        server_opt_state=getattr(api, "server_opt_state", None),
+                        algo_state=getattr(
+                            api, "checkpoint_state", lambda: None
+                        )(),
+                        sched_state=_sched_state(api),
+                    )
+
+        _validate_variant(opt)
+        if opt["runtime"] == "grpc":
+            # true multi-process federation: this process is ONE participant
+            # (ref main_fedavg_rpc.py per-process drivers + run_*.sh launchers)
+            if opt["algorithm"] not in ("fedavg", "fedprox", "fedopt", "fedbuff"):
+                raise click.UsageError(
+                    "runtime=grpc supports fedavg/fedprox/fedopt/fedbuff"
+                )
+            try:
+                final, grpc_health = _run_grpc_process(
+                    config, data, model, task, log_fn, opt
+                )
+                _telemetry_finish(telemetry, opt, logger, health=grpc_health)
+            finally:
+                _telemetry_finish(telemetry, opt, logger)
+                _log_compile(logger, compile_baseline, restore_compile_cache)
+            logger.close()
+            click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
+            return None
+
+        builder = _LONGTAIL.get(opt["algorithm"])
+        if builder is not None:
+            if opt["resume"]:
+                raise click.UsageError(
+                    f"--resume is not supported for algorithm={opt['algorithm']}"
+                )
+            allowed_runtimes = (
+                ("vmap", "mesh") if opt["algorithm"] == "centralized" else ("vmap",)
             )
-        if opt["checkpoint_path"] and opt["algorithm"] != "fedseg":
-            # fail loudly rather than let a 50-round run discover at crash
-            # time that nothing was ever saved
-            raise click.UsageError(
-                f"--checkpoint_path is not supported for algorithm="
-                f"{opt['algorithm']} (supported: the FedAvg family and fedseg)"
-            )
+            if opt["runtime"] not in allowed_runtimes:
+                raise click.UsageError(
+                    f"algorithm={opt['algorithm']} supports only "
+                    f"--runtime {'|'.join(allowed_runtimes)}"
+                )
+            if opt["checkpoint_path"] and opt["algorithm"] != "fedseg":
+                # fail loudly rather than let a 50-round run discover at crash
+                # time that nothing was ever saved
+                raise click.UsageError(
+                    f"--checkpoint_path is not supported for algorithm="
+                    f"{opt['algorithm']} (supported: the FedAvg family and fedseg)"
+                )
+            try:
+                with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
+                    final = builder(config, data, model, task, log_fn, opt)
+            finally:
+                # long-tail drivers have no per-client health registry; the
+                # trace/comm totals still flush (on success AND on a crash)
+                _telemetry_finish(telemetry, opt, logger)
+                _log_compile(logger, compile_baseline, restore_compile_cache)
+            logger.close()
+            click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
+            return None
+
+        api = _build_api(
+            opt["algorithm"], opt["runtime"], config, data, model, task, log_fn,
+            defense=opt.get("defense", "norm_diff_clipping"),
+            num_byzantine=opt.get("num_byzantine", 1),
+            multi_krum_m=opt.get("multi_krum_m", 3),
+            norm_bound=opt.get("norm_bound", 5.0),
+            noise_stddev=opt.get("noise_stddev", 0.025),
+            attack_cfg=attack_cfg,
+            ditto_lambda=opt.get("ditto_lambda", 0.1),
+            dp_cfg=_dp_cfg(opt),
+            qffl_q=opt.get("qffl_q", 1.0),
+        )
+        api_cell.append(api)
+
+        if opt["resume"]:
+            if opt["runtime"] in ("loopback", "mqtt", "shm"):
+                raise click.UsageError(
+                    f"--resume is not supported for runtime={opt['runtime']}"
+                )
+            _restore(api, opt)
+
+        if config.compile.warmup and hasattr(api, "warmup"):
+            # vmap/mesh: AOT-compile round/eval/server programs before round 0
+            # (the transport _Runner has no .warmup — run_federation takes the
+            # flag and warms the shared local-train program instead)
+            api.warmup(log_fn=log_fn)
+
         try:
             with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
-                final = builder(config, data, model, task, log_fn, opt)
+                final = api.train()
+            if getattr(api, "faults", None) is not None:
+                # vmap/mesh fault accounting into summary.json (the transport
+                # runners log their shared injector themselves)
+                log_fn(api.faults.summary_row())
+            if poison_spec is not None:
+                from fedml_tpu.data.edge_cases import attack_success_rate
+
+                final = dict(final or {})
+                final["Backdoor/ASR"] = attack_success_rate(
+                    model, api.global_vars, data, poison_spec, eval_fn=api.eval_fn
+                )
+                # persist the attack metric alongside the per-round rows
+                log_fn({
+                    "round": config.fed.comm_round - 1,
+                    "Backdoor/ASR": final["Backdoor/ASR"],
+                })
+            if opt["checkpoint_path"]:
+                save_checkpoint(
+                    str(opt["checkpoint_path"]),
+                    getattr(api, "global_vars"),
+                    round_idx=config.fed.comm_round,
+                    server_opt_state=getattr(api, "server_opt_state", None),
+                    algo_state=getattr(api, "checkpoint_state", lambda: None)(),
+                    sched_state=_sched_state(api),
+                )
+            _telemetry_finish(
+                telemetry, opt, logger, health=getattr(api, "health", None)
+            )
         finally:
-            # long-tail drivers have no per-client health registry; the
-            # trace/comm totals still flush (on success AND on a crash)
+            # exception backstop: flush the trace and stop the exporter even
+            # when the run crashed mid-train (idempotent after the call above);
+            # the compile row + cache restore ride the same backstop so a
+            # crashed run can't leave its per-run cache installed
             _telemetry_finish(telemetry, opt, logger)
+            _log_compile(logger, compile_baseline, restore_compile_cache)
         logger.close()
         click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
-        return None
-
-    api = _build_api(
-        opt["algorithm"], opt["runtime"], config, data, model, task, log_fn,
-        defense=opt.get("defense", "norm_diff_clipping"),
-        num_byzantine=opt.get("num_byzantine", 1),
-        multi_krum_m=opt.get("multi_krum_m", 3),
-        norm_bound=opt.get("norm_bound", 5.0),
-        noise_stddev=opt.get("noise_stddev", 0.025),
-        attack_cfg=attack_cfg,
-        ditto_lambda=opt.get("ditto_lambda", 0.1),
-        dp_cfg=_dp_cfg(opt),
-        qffl_q=opt.get("qffl_q", 1.0),
-    )
-    api_cell.append(api)
-
-    if opt["resume"]:
-        if opt["runtime"] in ("loopback", "mqtt", "shm"):
-            raise click.UsageError(
-                f"--resume is not supported for runtime={opt['runtime']}"
-            )
-        _restore(api, opt)
-
-    try:
-        with trace(str(opt["profile_dir"]) if opt["profile_dir"] else None):
-            final = api.train()
-        if getattr(api, "faults", None) is not None:
-            # vmap/mesh fault accounting into summary.json (the transport
-            # runners log their shared injector themselves)
-            log_fn(api.faults.summary_row())
-        if poison_spec is not None:
-            from fedml_tpu.data.edge_cases import attack_success_rate
-
-            final = dict(final or {})
-            final["Backdoor/ASR"] = attack_success_rate(
-                model, api.global_vars, data, poison_spec, eval_fn=api.eval_fn
-            )
-            # persist the attack metric alongside the per-round rows
-            log_fn({
-                "round": config.fed.comm_round - 1,
-                "Backdoor/ASR": final["Backdoor/ASR"],
-            })
-        if opt["checkpoint_path"]:
-            save_checkpoint(
-                str(opt["checkpoint_path"]),
-                getattr(api, "global_vars"),
-                round_idx=config.fed.comm_round,
-                server_opt_state=getattr(api, "server_opt_state", None),
-                algo_state=getattr(api, "checkpoint_state", lambda: None)(),
-                sched_state=_sched_state(api),
-            )
-        _telemetry_finish(
-            telemetry, opt, logger, health=getattr(api, "health", None)
-        )
-    finally:
-        # exception backstop: flush the trace and stop the exporter even
-        # when the run crashed mid-train (idempotent after the call above)
-        _telemetry_finish(telemetry, opt, logger)
-    logger.close()
-    click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
-    return api
+        return api
+    except BaseException:
+        # a validation/setup failure BEFORE (or inside) a dispatch
+        # path's own finally must not leave the per-run compile cache
+        # installed process-wide (the CliRunner/sweep hijack the
+        # install_run_cache docstring describes). restore() reinstates
+        # a fixed prior snapshot, so paths that already restored via
+        # _log_compile are unaffected by the second call.
+        if restore_compile_cache is not None:
+            restore_compile_cache()
+        raise
 
 
 _VARIANTS = {
@@ -888,6 +1009,7 @@ def _build_api(algorithm, runtime, config, data, model, task, log_fn,
                 server = runner_fn(
                     config, data, model, task=task, log_fn=log_fn,
                     server_opt=algorithm == "fedopt",
+                    warmup=config.compile.warmup,
                 )
                 self.global_vars = server.global_vars
                 # expose the FedOpt moments so --checkpoint_path persists

@@ -1,0 +1,222 @@
+"""In-process program deduplication + AOT warmup handles.
+
+The XLA jit cache is keyed by the *jit object*: two factories that build
+byte-identical round functions still compile twice, because each
+``jax.jit`` call wraps a fresh closure. Every algorithm family here
+builds its round/eval/train programs through small factories, and a full
+test run constructs hundreds of such factories over a handful of model ×
+config shapes — so a cold tier-1 run used to spend the bulk of its
+budget recompiling near-identical small programs (ROADMAP timeout note).
+
+:class:`ProgramCache` closes that gap: factories describe the program's
+static determinants (see :mod:`fedml_tpu.compile.digest`) and get back a
+process-wide shared :class:`CachedProgram` — one jit object, one compile
+per (program structure, shape class) per process. Factories handed
+opaque callables (custom ``local_train_fn``, defense hooks) must bypass
+the registry via :meth:`ProgramCache.wrap_uncached`; a digest that
+over-merged two different programs would be a silent-wrong-numerics bug,
+so the keying is deliberately conservative.
+
+:class:`CachedProgram` is also the AOT warmup surface:
+``prog.warmup(*args)`` runs ``jit(...).lower(...).compile()`` ahead of
+round 0 (emitting a ``compile`` telemetry span + XLA cost analysis) and
+keeps the compiled executable; subsequent calls whose abstract signature
+matches dispatch straight to it, so the warmup compile IS the run's
+compile — warm runs are numerically identical to cold runs because the
+executable is built from the exact same lowering either way."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from fedml_tpu.compile.digest import call_signature, program_digest
+from fedml_tpu.telemetry import get_tracer
+
+
+class CachedProgram:
+    """A jit-compiled program handle: callable, lowerable, warmable.
+
+    Transparent stand-in for the wrapped ``jax.jit`` object at every call
+    site (``__call__``/``lower`` forward to it). After :meth:`warmup`,
+    calls whose signature matches a warmed executable dispatch to the AOT
+    executable directly; anything else (different shape class, kwargs,
+    sharding mismatch) falls back to the ordinary jit dispatch path."""
+
+    def __init__(
+        self,
+        fn: Callable,
+        label: str,
+        digest: Optional[str] = None,
+        cache: Optional["ProgramCache"] = None,
+    ):
+        self.fn = fn
+        self.label = label
+        self.digest = digest
+        self._cache = cache
+        self._aot: Dict[tuple, Any] = {}
+        self._aot_stats: Dict[tuple, dict] = {}
+
+    def __call__(self, *args, **kwargs):
+        if self._aot and not kwargs:
+            sig = call_signature(args)
+            exe = self._aot.get(sig)
+            if exe is not None:
+                try:
+                    return exe(*args)
+                except (TypeError, ValueError):
+                    # same shapes/dtypes but a different sharding/layout
+                    # than the warmed executable (checked BEFORE anything
+                    # executes) — evict the signature so later rounds
+                    # don't re-pay the failed dispatch, and let the jit
+                    # path compile/dispatch that variant normally. The
+                    # stats entry goes too: a later warmup() must really
+                    # recompile, not report a stale aot_cache_hit while
+                    # the executable is gone
+                    self._aot.pop(sig, None)
+                    self._aot_stats.pop(sig, None)
+        return self.fn(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        return self.fn.lower(*args, **kwargs)
+
+    def warmup(self, *args, tracer=None) -> dict:
+        """AOT-compile this program for the signature of ``args``
+        (``jit(...).lower(...).compile()``) and keep the executable for
+        dispatch. Lowering never executes the function, so donated
+        buffers in ``args`` are untouched. Idempotent per signature —
+        a second warmup is a hit with ``compile_s == 0``. Returns
+        ``{compile_s, flops, bytes, aot_cache_hit}``."""
+        sig = call_signature(args)
+        st = self._aot_stats.get(sig)
+        if st is not None:
+            # a hit costs nothing: report compile_s=0 (the docstring
+            # contract) so a repeat run in a long-lived process doesn't
+            # re-bill the first run's compile seconds in its summary rows
+            return dict(st, compile_s=0.0, aot_cache_hit=True)
+        tracer = tracer or get_tracer()
+        t0 = time.perf_counter()
+        with tracer.span("compile", program=self.label, aot=True):
+            compiled = self.fn.lower(*args).compile()
+        dt = time.perf_counter() - t0
+        flops = bytes_accessed = None
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):  # older jax returns [dict]
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0)) or None
+            bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
+        except Exception:  # noqa: BLE001 — no cost model on this backend
+            pass
+        self._aot[sig] = compiled
+        st = {
+            "compile_s": dt,
+            "flops": flops,
+            "bytes": bytes_accessed,
+            "aot_cache_hit": False,
+        }
+        self._aot_stats[sig] = st
+        if self._cache is not None:
+            self._cache._note_compile_time(dt)
+        return dict(st)
+
+
+class ProgramCache:
+    """Process-wide registry of :class:`CachedProgram`s keyed by the
+    canonical digest of their static determinants (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs: Dict[str, CachedProgram] = {}
+        self.hits = 0
+        self.misses = 0
+        self.bypassed = 0
+        self.compile_s = 0.0  # accumulated measured (AOT) compile seconds
+
+    def get_or_build(
+        self, label: str, key_fields: Dict[str, Any], builder: Callable[[], Callable]
+    ) -> CachedProgram:
+        """The shared program for ``key_fields``, building it via
+        ``builder()`` on first request. ``builder`` must return a jit
+        object whose traced program is FULLY determined by
+        ``key_fields`` — when any closure input is not canonically
+        describable, use :meth:`wrap_uncached` instead."""
+        digest = program_digest(key_fields)
+        with self._lock:
+            prog = self._programs.get(digest)
+            if prog is not None:
+                self.hits += 1
+                return prog
+        # build outside the lock: builders only wrap jax.jit (compilation
+        # itself stays lazy), so a racing duplicate build is cheap and the
+        # second one below is discarded
+        fn = builder()
+        with self._lock:
+            prog = self._programs.get(digest)
+            if prog is None:
+                prog = CachedProgram(fn, label, digest=digest, cache=self)
+                self._programs[digest] = prog
+                self.misses += 1
+            else:
+                self.hits += 1
+        return prog
+
+    def wrap_uncached(self, label: str, fn: Callable) -> CachedProgram:
+        """Wrap a jit object that must NOT be deduplicated (opaque
+        closures), still counting it and giving it the warmup surface."""
+        with self._lock:
+            self.bypassed += 1
+        return CachedProgram(fn, label, cache=self)
+
+    def _note_compile_time(self, dt: float) -> None:
+        with self._lock:
+            self.compile_s += float(dt)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "bypassed": self.bypassed,
+                "programs": len(self._programs),
+                "compile_s": self.compile_s,
+            }
+
+    def summary_row(self, baseline: Optional[dict] = None) -> dict:
+        """Flat MetricsLogger row of (baseline-relative) cache activity —
+        the summary.json compile-accounting contract (docs/COMPILE.md)."""
+        snap = self.stats()
+        base = baseline or {}
+        return {
+            "compile/cache_hits": snap["hits"] - base.get("hits", 0),
+            "compile/cache_misses": snap["misses"] - base.get("misses", 0),
+            "compile/cache_bypassed": snap["bypassed"] - base.get("bypassed", 0),
+            "compile/programs": snap["programs"],
+            "compile/compile_s": snap["compile_s"] - base.get("compile_s", 0.0),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs.clear()
+            self.hits = self.misses = self.bypassed = 0
+            self.compile_s = 0.0
+
+
+def hooks_cacheable(*hooks) -> bool:
+    """THE cache-bypass predicate shared by every round factory: a
+    factory may dedupe its program ONLY when every opaque hook that could
+    shape the traced computation is None. Single-sourced so a factory
+    growing a new hook parameter cannot forget the matching bypass term
+    in one copy (an over-merged digest is silent wrong numerics)."""
+    return all(h is None for h in hooks)
+
+
+_GLOBAL = ProgramCache()
+
+
+def get_program_cache() -> ProgramCache:
+    """The process-wide program cache every factory dedupes through (the
+    session-scoped ``program_cache`` pytest fixture exposes this same
+    object, so test modules share each other's compiles)."""
+    return _GLOBAL

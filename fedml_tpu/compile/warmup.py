@@ -1,0 +1,209 @@
+"""AOT warmup — compile the run's programs before round 0.
+
+Round 0 of a cold run silently includes XLA compilation: the first round
+dispatch blocks on a compile that can take orders of magnitude longer
+than the round itself, which skews round-0 wall-clock metrics and forced
+the transport deadline/quorum machinery to special-case "arbitrarily
+long cold compiles" (PR 3). ``--warmup`` moves that cost to an explicit,
+observable phase: every program the run will dispatch at round 0 is
+``jit(...).lower(...).compile()``d up front (through
+:meth:`CachedProgram.warmup`, which keeps the executable for dispatch —
+so the warmup compile IS the run's compile, not a duplicate), under
+``compile`` telemetry spans, with per-program XLA cost analysis
+(flops/bytes) and compile seconds forwarded into summary.json.
+
+Warm and cold runs are numerically identical by construction: warmup
+only lowers and compiles — it executes nothing, consumes no RNG, and
+touches no training state (pinned by tests/test_compile.py).
+
+Covered programs, matching what ``FedAvgAPI.train`` dispatches first:
+
+- the round program — the eager round-fn variant for round
+  ``start_round``'s cohort shapes, or the fused multi-round chunk
+  program when ``fused_rounds`` applies;
+- the eval program at the cached test-batch shapes;
+- the server-optimizer step (FedOpt family), when present.
+
+Later shape classes (a differently-bucketed cohort, the second
+``may_pad`` variant) still compile lazily on first dispatch — warmup
+covers the round-0 cold start, not every program the run may ever
+build."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from fedml_tpu.telemetry import get_tracer
+
+
+def _warm_one(rows: dict, label: str, fn, args, tracer) -> None:
+    """AOT-compile one program; record per-program stats; never crash the
+    run (a backend without AOT support degrades to lazy compilation)."""
+    if not hasattr(fn, "warmup"):
+        from fedml_tpu.compile.program_cache import get_program_cache
+
+        # the AOT executable lives on this throwaway wrapper, so only the
+        # persistent compile cache (when installed) carries the benefit to
+        # the run's lazy dispatch — route the factory through the
+        # ProgramCache instead of relying on this fallback
+        logging.warning(
+            "warmup program %r is a bare jit object (no ProgramCache "
+            "wrapper): the warmed executable cannot serve its dispatches "
+            "directly", label,
+        )
+        fn = get_program_cache().wrap_uncached(label, fn)
+    try:
+        st = fn.warmup(*args, tracer=tracer)
+    except Exception as e:  # noqa: BLE001 — warmup must not kill the run
+        logging.warning("warmup of program %r failed: %s", label, e)
+        rows[f"compile/{label}_error"] = f"{type(e).__name__}: {e}"
+        return
+    rows[f"compile/{label}_compile_s"] = st["compile_s"]
+    rows[f"compile/{label}_aot_cache_hit"] = bool(st.get("aot_cache_hit"))
+    if st.get("flops"):
+        rows[f"compile/{label}_flops"] = st["flops"]
+    if st.get("bytes"):
+        rows[f"compile/{label}_bytes"] = st["bytes"]
+
+
+def warmup_api(api, log_fn: Optional[Callable[[dict], None]] = None) -> dict:
+    """Warm a FedAvgAPI-family simulator (vmap or mesh): round + eval +
+    server-optimizer programs for ``api.start_round``'s shapes. Returns
+    the compile-stats row (also forwarded through ``log_fn``)."""
+    import jax
+
+    tracer = getattr(api, "_tracer", None) or get_tracer()
+    rows: dict = {}
+    t0 = time.perf_counter()
+    with tracer.span("warmup"):
+        r0 = int(getattr(api, "start_round", 0))
+        mesh = getattr(api, "mesh", None)
+        if mesh is not None:
+            # mesh runtime: round outputs carry NamedSharding(mesh, P()),
+            # so from round r0+1 on the round INPUT does too. Replicate
+            # global_vars onto the mesh now (values unchanged) so ONE
+            # warmed executable serves every round, instead of matching
+            # only round r0's single-device placement.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            api.global_vars = jax.device_put(
+                api.global_vars, NamedSharding(mesh, PartitionSpec())
+            )
+        # -- round program: fused chunk when the planner would fuse,
+        #    else the eager variant for round r0's cohort --
+        fused_len = 1
+        if hasattr(api, "_fused_chunk_len") and hasattr(api, "_fused_plan"):
+            try:
+                fused_len = api._fused_chunk_len(r0)
+            except Exception:  # noqa: BLE001 — planner guards vary by algo
+                fused_len = 1
+        if fused_len > 1:
+            fn, rest = api._fused_plan(r0, fused_len)
+            if hasattr(api, "_warm_fused"):
+                # hand the whole plan to train_rounds_fused so the chunk's
+                # index/mask stacking + H2D transfer is paid once, not twice
+                api._warm_fused[(r0, fused_len)] = (fn, rest)
+            _warm_one(
+                rows, "round_fused", fn, (api.global_vars, *rest), tracer
+            )
+        else:
+            sampled = api._round_plan(r0)[0]
+            batch = api._round_batch(sampled, r0)
+            rng = jax.random.fold_in(api.rng, r0 + 1)
+            placed = api._place_batch(batch, rng)
+            if hasattr(api, "_warm_placed"):
+                # hand the placed batch to train_round(r0) so the stack +
+                # host->device transfer is paid once, not twice
+                api._warm_placed[r0] = placed
+            fn = api.round_fn
+            variant_for = getattr(fn, "variant_for", None)
+            if variant_for is not None:
+                fn = variant_for(api._round_may_pad(r0))
+            _warm_one(rows, "round", fn, (api.global_vars, *placed), tracer)
+        # -- eval program at the cached test-batch shapes --
+        if getattr(api, "eval_fn", None) is not None and hasattr(
+            api, "_eval_batches"
+        ):
+            batches = api._eval_batches()
+            _warm_one(
+                rows, "eval", api.eval_fn, (api.global_vars, *batches), tracer
+            )
+        # -- server optimizer step (FedOpt family) --
+        server_step = getattr(api, "_server_step", None)
+        opt_state = getattr(api, "server_opt_state", None)
+        if server_step is not None and opt_state is not None:
+            _warm_one(
+                rows,
+                "server_opt",
+                server_step,
+                (api.global_vars, api.global_vars, opt_state),
+                tracer,
+            )
+    rows["compile/warmup_s"] = time.perf_counter() - t0
+    if log_fn is not None:
+        log_fn(dict(rows))
+    return rows
+
+
+def warmup_local_train(
+    shared_train,
+    config,
+    data,
+    global_vars,
+    client_ids,
+    log_fn: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """Warm a transport federation's shared local-train program for every
+    distinct shape class among ``client_ids`` (the round-0 cohort) — the
+    warmup *barrier* that lets ``--deadline_s`` rounds start with
+    compilation already paid instead of racing a cold compile.
+
+    Shape classes are derived exactly the way ``LocalTrainer._train``
+    derives them (``stack_clients`` of one client at the configured
+    batch/bucket settings), so the warmed signature matches the training
+    dispatch byte-for-byte."""
+    import jax
+    import numpy as np
+
+    from fedml_tpu.data.base import bucket_steps, stack_clients
+
+    tracer = get_tracer()
+    rows: dict = {}
+    t0 = time.perf_counter()
+    seen = set()
+    with tracer.span("warmup", programs="local_train"):
+        for cid in client_ids:
+            n = len(data.client_y[int(cid)])
+            klass = bucket_steps(
+                [n], config.data.batch_size, config.data.pad_bucket
+            )[:2]
+            if klass in seen:
+                continue
+            seen.add(klass)
+            batch = stack_clients(
+                data,
+                [int(cid)],
+                config.data.batch_size,
+                seed=0,  # values are irrelevant — only shapes enter lower()
+                pad_bucket=config.data.pad_bucket,
+            )
+            rng = jax.random.PRNGKey(0)
+            _warm_one(
+                rows,
+                f"local_train_s{klass[0]}b{klass[1]}",
+                shared_train,
+                (
+                    global_vars,
+                    np.asarray(batch.x[0]),
+                    np.asarray(batch.y[0]),
+                    np.asarray(batch.mask[0]),
+                    rng,
+                ),
+                tracer,
+            )
+    rows["compile/warmup_s"] = time.perf_counter() - t0
+    if log_fn is not None:
+        log_fn(dict(rows))
+    return rows

@@ -186,6 +186,28 @@ class CommConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CompileConfig:
+    """Compile-runtime knobs (fedml_tpu/compile/ — the reference framework
+    is PyTorch eager and has no compilation cost dimension at all)."""
+
+    # AOT-compile the run's programs before round 0
+    # (``jit(...).lower(...).compile()``, compile/warmup.py): round + eval
+    # + server-optimizer programs on vmap/mesh, the shared client
+    # local-train program on the sync transports (so --deadline_s rounds
+    # start with compilation already paid). Numerics are identical to a
+    # cold run — warmup only lowers/compiles, it executes nothing.
+    warmup: bool = False
+    # Persistent XLA compile-cache directory served by the hardened store
+    # (compile/persistent.py: atomic writes, sha256 integrity check with
+    # quarantine, advisory file lock). "" = no persistent cache.
+    cache_dir: str = ""
+    # Only persist compiles at least this slow. The conservative 2 s
+    # default matches tests/conftest.py: aggressive thresholds (0.3-0.5 s)
+    # corrupt the heap on this jaxlib (ROADMAP "compile-cache hygiene").
+    min_compile_time_s: float = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh spec replacing the reference's gpu_mapping.yaml
     (fedml_api/distributed/utils/gpu_mapping.py:8-39)."""
@@ -205,6 +227,7 @@ class RunConfig:
     server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
     comm: CommConfig = dataclasses.field(default_factory=CommConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    compile: CompileConfig = dataclasses.field(default_factory=CompileConfig)
     model: str = "lr"
     seed: int = 0
 

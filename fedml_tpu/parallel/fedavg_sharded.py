@@ -122,17 +122,50 @@ def make_sharded_fedavg_round(
         return new_global, agg_metrics
 
     data_spec = P(axis)
-    sharded = jax.shard_map(
-        shard_body,
-        mesh=mesh,
-        in_specs=(P(),) + (data_spec,) * 5 + (P(),) * n_extra,
-        out_specs=(P(), P()),
-        # the all_gather-ed aggregate is replicated by construction (every
-        # shard reduces the same gathered stack), which static VMA
-        # inference cannot see
-        check_vma=aggregate_fn is None,
+
+    def builder():
+        sharded = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(),) + (data_spec,) * 5 + (P(),) * n_extra,
+            out_specs=(P(), P()),
+            # the all_gather-ed aggregate is replicated by construction (every
+            # shard reduces the same gathered stack), which static VMA
+            # inference cannot see
+            check_vma=aggregate_fn is None,
+        )
+        return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    # Program dedup (fedml_tpu/compile/): sharded rounds are keyed by the
+    # mesh topology on top of the usual (model, train config, schedule)
+    # determinants; opaque hooks bypass the registry.
+    from fedml_tpu.compile import (
+        get_program_cache,
+        hooks_cacheable,
+        mesh_fingerprint,
+        model_fingerprint,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    cache = get_program_cache()
+    if not hooks_cacheable(
+        local_train_fn, post_train, post_aggregate, aggregate_fn
+    ):
+        return cache.wrap_uncached("sharded_fedavg_round", builder())
+    return cache.get_or_build(
+        "sharded_fedavg_round",
+        {
+            "kind": "sharded_fedavg_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "mode": mode,
+            "mesh": mesh_fingerprint(mesh),
+            "n_extra": n_extra,
+            "donate": donate,
+        },
+        builder,
+    )
 
 
 class DistributedFedAvgAPI(FedAvgAPI):
@@ -146,6 +179,9 @@ class DistributedFedAvgAPI(FedAvgAPI):
     version and pads + places each round's batch sharded over the mesh."""
 
     _use_device_store = False  # batches are padded + sharded from host
+    # the shard_map round psum-reduces its metrics — no per-client loss
+    # vectors; power_of_choice keeps the cohort-mean signal on the mesh
+    _client_loss_vectors = False
 
     def __init__(
         self,

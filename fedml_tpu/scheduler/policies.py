@@ -418,11 +418,23 @@ class ClientScheduler:
             self._on_select(r, sel)
         return sel
 
+    @property
+    def wants_client_losses(self) -> bool:
+        """True when the active policy biases on per-client losses
+        (power_of_choice, possibly overprovision-wrapped) — the signal
+        the vmap round program's ``client_loss_sum``/``client_count``
+        vectors exist to feed (FedAvgAPI._report_client_losses)."""
+        inner = getattr(self._policy, "inner", self._policy)
+        return isinstance(inner, PowerOfChoicePolicy)
+
     def report_loss(self, client_id: int, loss: float) -> None:
         """Feed a client's last observed local train loss
         (power_of_choice's bias signal). Any runtime may call this with
         whatever loss signal it has — true per-client loss on the
-        transports, the cohort mean in the vmap simulator."""
+        transports, the cohort mean in the vmap simulator. The vmap
+        simulator upgrades to TRUE per-client losses when
+        :attr:`wants_client_losses` (sim/transport parity for
+        power_of_choice)."""
         if loss is None or not np.isfinite(loss):
             return
         self._ctx.losses[int(client_id)] = float(loss)

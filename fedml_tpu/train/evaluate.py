@@ -31,21 +31,38 @@ def pad_to_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
 
 def make_eval_fn(model: ModelDef, task: str = "classification"):
     """Returns jitted ``eval_fn(variables, x, y, mask) -> {loss_sum, correct,
-    count}`` over batched inputs x [S, B, ...]."""
+    count}`` over batched inputs x [S, B, ...].
+
+    Deduped through the process-wide ProgramCache (fedml_tpu/compile/):
+    every API instance over the same (model, task) shares ONE jitted eval
+    program instead of recompiling per constructor call."""
     task_loss = make_task_loss(task)
 
-    @jax.jit
-    def eval_fn(variables, x, y, mask):
-        def body(carry, inp):
-            xb, yb, mb = inp
-            logits, _ = model.apply(variables, xb, train=False)
-            loss, correct, total = task_loss(logits, yb, mb)
-            return carry + jnp.stack([loss * total, correct, total]), None
+    def builder():
+        @jax.jit
+        def eval_fn(variables, x, y, mask):
+            def body(carry, inp):
+                xb, yb, mb = inp
+                logits, _ = model.apply(variables, xb, train=False)
+                loss, correct, total = task_loss(logits, yb, mb)
+                return carry + jnp.stack([loss * total, correct, total]), None
 
-        sums, _ = jax.lax.scan(body, jnp.zeros(3), (x, y, mask))
-        return {"loss_sum": sums[0], "correct": sums[1], "count": sums[2]}
+            sums, _ = jax.lax.scan(body, jnp.zeros(3), (x, y, mask))
+            return {"loss_sum": sums[0], "correct": sums[1], "count": sums[2]}
 
-    return eval_fn
+        return eval_fn
+
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
+    return get_program_cache().get_or_build(
+        "eval",
+        {
+            "kind": "eval",
+            "model": model_fingerprint(model),
+            "task": task,
+        },
+        builder,
+    )
 
 
 def metrics_to_loss_acc(m) -> Tuple[float, float]:

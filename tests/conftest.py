@@ -14,26 +14,48 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
-# Persistent compilation cache — CONSERVATIVE settings on purpose. The old
-# aggressive config (min_entry_size=-1, min_compile_time=0.3) cached every
-# tiny program and CORRUPTED THE HEAP on this container's jaxlib+CPU stack:
-# cold-cache suite runs flaked ~40% with wrong resume numerics (a restored
-# model evaluating at chance), `free(): invalid pointer` / segfaults at
-# exit, and fatal "Garbage-collecting" aborts mid-run (the DARTS unrolled
-# trace and the jax.profiler TF import were the usual victims — they are
-# just the next malloc-heavy phase after the corruption). With the cache
-# fully off the same repro loops ran clean 6/6 — but the fast tier then
-# recompiles everything and blows the tier-1 time budget. Caching only
-# slow-to-compile programs (>= 2 s) keeps the big wins (fused chunks,
-# second-order DARTS, attention stacks) with none of the tiny-entry churn
-# that reproduced the corruption; detector loops (the resume tests and the
-# abort-prone file combo) ran clean under this config.
-jax.config.update("jax_compilation_cache_dir", "/tmp/fedml_tpu_jax_cache_v2")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+# Persistent compilation cache — the HARDENED wrapper (fedml_tpu/compile/
+# persistent.py: atomic rename writes, sha256 integrity verification with
+# quarantine, advisory file lock), so concurrent pytest processes can no
+# longer poison each other's entries (the PR 3 corruption incident class).
+#
+# Thresholds stay CONSERVATIVE on purpose. The old aggressive config
+# (min_entry_size=-1, min_compile_time=0.3) cached every tiny program and
+# CORRUPTED THE HEAP on this container's jaxlib+CPU stack: cold-cache suite
+# runs flaked ~40% with wrong resume numerics (a restored model evaluating
+# at chance), `free(): invalid pointer` / segfaults at exit, and fatal
+# "Garbage-collecting" aborts mid-run (the DARTS unrolled trace and the
+# jax.profiler TF import were the usual victims — they are just the next
+# malloc-heavy phase after the corruption). With the cache fully off the
+# same repro loops ran clean 6/6 — but the fast tier then recompiles
+# everything and blows the tier-1 time budget. Caching only slow-to-compile
+# programs (>= 2 s) keeps the big wins (fused chunks, second-order DARTS,
+# attention stacks) with none of the tiny-entry churn that reproduced the
+# corruption; detector loops (the resume tests and the abort-prone file
+# combo) ran clean under this config. The hardened store uses its own
+# .ftpc entry format, so the v3 dir below never mixes with stock-format
+# leftovers.
+from fedml_tpu.compile import install_hardened_cache  # noqa: E402
+
+install_hardened_cache(
+    "/tmp/fedml_tpu_jax_cache_v3", min_compile_time_secs=2.0
+)
+
+
+@pytest.fixture(scope="session")
+def program_cache():
+    """THE process-wide ProgramCache (fedml_tpu/compile/program_cache.py)
+    — the same registry every round/eval/train factory dedupes through,
+    exposed session-scoped so test modules share each other's compiles
+    instead of recompiling structurally identical programs."""
+    from fedml_tpu.compile import get_program_cache
+
+    return get_program_cache()
 
 
 def pytest_configure(config):

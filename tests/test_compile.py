@@ -1,0 +1,494 @@
+"""Compile runtime (fedml_tpu/compile/): program dedup, digest stability,
+AOT warmup numerics parity, and the hardened persistent cache's
+corruption-proofing (ISSUE 4 acceptance contract).
+
+The quarantine/recompile tests drive REAL jax compiles through the
+hardened store in subprocesses, so a (hypothetical) deserialization fault
+can never poison this pytest process — exactly the isolation discipline
+the store exists to enforce."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.compile import (
+    CachedProgram,
+    HardenedFileCache,
+    ProgramCache,
+    call_signature,
+    canonical,
+    compile_snapshot,
+    compile_summary_row,
+    get_program_cache,
+    model_fingerprint,
+    program_digest,
+)
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import ModelDef
+from fedml_tpu.models.linear import LogisticRegression
+
+# ---------------------------------------------------------------------------
+# shared fixtures (mirror tests/test_scheduler.py so the ProgramCache
+# actually dedupes across the two modules — that sharing IS the feature)
+# ---------------------------------------------------------------------------
+
+
+def _data(num_clients=6, samples=12):
+    return synthetic_classification(
+        num_clients=num_clients, num_classes=3, feat_shape=(5,),
+        samples_per_client=samples, partition_method="homo", seed=9,
+    )
+
+
+def _model():
+    return ModelDef(
+        module=LogisticRegression(num_classes=3), input_shape=(5,),
+        num_classes=3, name="lr",
+    )
+
+
+def _cfg(**fed_kw):
+    base = dict(
+        client_num_in_total=6, client_num_per_round=3, comm_round=2,
+        epochs=1, frequency_of_the_test=1,
+    )
+    base.update(fed_kw)
+    return RunConfig(
+        data=DataConfig(batch_size=-1),
+        fed=FedConfig(**base),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# digest: canonicalization + cross-process stability
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_abstracts_arrays_to_shape_dtype():
+    """Concrete values NEVER enter a digest — two arrays of the same
+    shape/dtype canonicalize identically, different shapes differ."""
+    a = canonical(np.zeros((2, 3), np.float32))
+    b = canonical(np.ones((2, 3), np.float32) * 7)
+    c = canonical(np.zeros((2, 4), np.float32))
+    assert a == b
+    assert a != c
+    assert a == {"__aval__": [[2, 3], "float32"]}
+
+
+def test_canonical_dict_order_independent():
+    f1 = {"x": {"b": 2, "a": 1}, "y": [1, 2]}
+    f2 = {"y": [1, 2], "x": {"a": 1, "b": 2}}
+    assert program_digest(f1) == program_digest(f2)
+
+
+def test_digest_distinguishes_configs():
+    t1 = TrainConfig(lr=0.1)
+    t2 = TrainConfig(lr=0.2)
+    assert program_digest({"train": t1}) != program_digest({"train": t2})
+    assert program_digest({"train": t1}) == program_digest(
+        {"train": TrainConfig(lr=0.1)}
+    )
+
+
+def test_digest_stable_across_processes():
+    """The plain-field digest (configs, shapes, strings) is the persistent
+    keying contract — pin it against a fresh interpreter."""
+    fields_src = (
+        "{'kind': 'round', 'train': TrainConfig(lr=0.05, momentum=0.9), "
+        "'epochs': 2, 'task': 'classification', "
+        "'x': np.zeros((4, 8), np.float32)}"
+    )
+    prog = (
+        "import numpy as np\n"
+        "from fedml_tpu.config import TrainConfig\n"
+        "from fedml_tpu.compile.digest import program_digest\n"
+        f"print(program_digest({fields_src}))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        check=True, timeout=120,
+    )
+    from fedml_tpu.config import TrainConfig as TC
+
+    here = program_digest({
+        "kind": "round", "train": TC(lr=0.05, momentum=0.9),
+        "epochs": 2, "task": "classification",
+        "x": np.zeros((4, 8), np.float32),
+    })
+    assert out.stdout.strip() == here
+
+
+# ---------------------------------------------------------------------------
+# ProgramCache: hit/miss accounting + factory dedup
+# ---------------------------------------------------------------------------
+
+
+def test_program_cache_hit_miss_accounting():
+    pc = ProgramCache()
+    built = []
+
+    def builder():
+        built.append(1)
+        return lambda x: x
+
+    p1 = pc.get_or_build("p", {"k": 1}, builder)
+    p2 = pc.get_or_build("p", {"k": 1}, builder)
+    p3 = pc.get_or_build("p", {"k": 2}, builder)
+    assert p1 is p2 and p1 is not p3
+    assert len(built) == 2  # one build per distinct digest
+    assert pc.stats()["hits"] == 1
+    assert pc.stats()["misses"] == 2
+    u = pc.wrap_uncached("opaque", lambda x: x)
+    assert isinstance(u, CachedProgram)
+    assert pc.stats()["bypassed"] == 1
+
+
+def test_round_factories_dedupe_onto_one_program(program_cache):
+    """Two independently constructed FedAvg round factories over the same
+    (model, config) land on ONE CachedProgram — the compile-once-per-shape
+    contract. An opaque hook must bypass the registry."""
+    from fedml_tpu.algorithms.fedavg import make_fedavg_round
+
+    model, cfg = _model(), _cfg()
+    before = program_cache.stats()
+    f1 = make_fedavg_round(model, cfg)
+    f2 = make_fedavg_round(model, cfg)
+    # the dispatch wrappers differ but resolve to the same cached program
+    # (vmap mode collapses both may_pad variants onto one skip choice)
+    assert f1.variant_for(False) is f2.variant_for(False)
+    assert f1.variant_for(True) is f2.variant_for(True)
+    after = program_cache.stats()
+    assert after["hits"] >= before["hits"] + 1
+    f3 = make_fedavg_round(
+        model, cfg, post_aggregate=lambda g: g  # opaque hook
+    )
+    assert f3.variant_for(False) is not f1.variant_for(False)
+    assert program_cache.stats()["bypassed"] > before["bypassed"]
+
+
+def test_eval_factory_dedupes(program_cache):
+    from fedml_tpu.train.evaluate import make_eval_fn
+
+    model = _model()
+    assert make_eval_fn(model) is make_eval_fn(model)
+
+
+def test_fedopt_server_step_dedupes_across_vmap_and_transport(program_cache):
+    """The vmap API (fedopt.py) and the transport server manager
+    (fedavg_transport.py) key the FedOpt server step on the SAME
+    (kind, server config, step_builder) fields, so both sides share ONE
+    jit object. The probe below issues the transport-side call verbatim
+    with a must-not-run builder — if either site's key drifts, the miss
+    invokes the builder and the test fails."""
+    from fedml_tpu.algorithms.fedopt import FedOptAPI, make_server_step
+    from fedml_tpu.config import ServerConfig
+
+    cfg = _cfg()
+    api = FedOptAPI(cfg, _data(), _model(), log_fn=lambda *a, **k: None)
+    probe = program_cache.get_or_build(
+        "server_opt",
+        {
+            "kind": "fedopt_server_step",
+            "server": cfg.server,
+            "step_builder": make_server_step,
+        },
+        lambda: pytest.fail("transport-side key missed the vmap-side program"),
+    )
+    assert probe is api._server_step
+    # a different server config is a different program
+    assert probe.digest != program_digest(
+        {
+            "kind": "fedopt_server_step",
+            "server": ServerConfig(server_lr=0.5),
+            "step_builder": make_server_step,
+        }
+    )
+
+
+def test_model_fingerprint_distinguishes_architectures():
+    m1 = _model()
+    m2 = ModelDef(
+        module=LogisticRegression(num_classes=4), input_shape=(5,),
+        num_classes=4, name="lr",
+    )
+    assert model_fingerprint(m1) != model_fingerprint(m2)
+    assert model_fingerprint(m1) == model_fingerprint(_model())
+
+
+def test_compile_summary_row_is_baseline_relative():
+    pc = get_program_cache()
+    base = compile_snapshot()
+    pc.get_or_build("t", {"unique": "test_compile_summary_row"}, lambda: (lambda x: x))
+    row = compile_summary_row(base)
+    assert row["compile/cache_misses"] == 1
+    assert row["compile/cache_hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# CachedProgram: AOT warmup surface
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_compiles_and_dispatches_aot():
+    import jax
+    import jax.numpy as jnp
+
+    pc = ProgramCache()
+    prog = pc.wrap_uncached("f", jax.jit(lambda x: jnp.sin(x) + 1))
+    x = np.ones((8,), np.float32)
+    st = prog.warmup(x)
+    assert st["aot_cache_hit"] is False
+    assert st["compile_s"] > 0
+    assert pc.stats()["compile_s"] == pytest.approx(st["compile_s"])
+    # idempotent per signature: the second warmup is a hit
+    st2 = prog.warmup(x)
+    assert st2["aot_cache_hit"] is True
+    # the warmed executable serves the call and matches the jit path
+    np.testing.assert_array_equal(
+        np.asarray(prog(x)), np.asarray(jax.jit(lambda x: jnp.sin(x) + 1)(x))
+    )
+    # a different shape class falls back to the ordinary jit path
+    y = np.ones((4,), np.float32)
+    np.testing.assert_allclose(np.asarray(prog(y)), np.sin(y) + 1, rtol=1e-6)
+
+
+def test_call_signature_separates_shape_classes():
+    a = (np.zeros((2, 3), np.float32),)
+    b = (np.zeros((2, 3), np.float32) + 5,)
+    c = (np.zeros((3, 2), np.float32),)
+    assert call_signature(a) == call_signature(b)
+    assert call_signature(a) != call_signature(c)
+
+
+# ---------------------------------------------------------------------------
+# warmup-vs-cold numerics parity (byte-identical round results)
+# ---------------------------------------------------------------------------
+
+
+def _tree_equal(t1, t2):
+    import jax
+
+    l1, d1 = jax.tree_util.tree_flatten(t1)
+    l2, d2 = jax.tree_util.tree_flatten(t2)
+    assert d1 == d2
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_warmup_vs_cold_numerics_parity_vmap():
+    """--warmup only lowers/compiles — it executes nothing, consumes no
+    RNG, and touches no training state, so warmed runs produce
+    byte-identical models (the acceptance-criteria parity clause)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    data, model = _data(), _model()
+    cold = FedAvgAPI(_cfg(), data, model)
+    cold.train()
+    warm = FedAvgAPI(_cfg(), data, model)
+    rows = warm.warmup(log_fn=lambda r: None)
+    assert "compile/warmup_s" in rows
+    warm.train()
+    _tree_equal(cold.global_vars, warm.global_vars)
+
+
+def test_warmup_fused_chunk_memo_and_parity():
+    """When the planner would fuse (start_round mid-chunk — round 0 itself
+    is always an eval round, so fresh runs warm the eager variant), warmup
+    AOT-compiles the fused chunk program AND memoizes the whole plan so
+    train_rounds_fused doesn't rebuild/re-ship the chunk's index/mask
+    arrays; numerics stay byte-identical to a cold run."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    data, model = _data(), _model()
+    cfg = RunConfig(
+        data=DataConfig(batch_size=4),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=3, comm_round=5,
+            epochs=1, frequency_of_the_test=4, fused_rounds=4,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+    cold = FedAvgAPI(cfg, data, model)
+    cold.start_round = 1
+    assert cold._fused_chunk_len(1) == 4  # the branch under test is live
+    cold.train()
+    warm = FedAvgAPI(cfg, data, model)
+    warm.start_round = 1
+    rows = warm.warmup(log_fn=lambda r: None)
+    assert rows.get("compile/round_fused_compile_s", 0) > 0
+    assert (1, 4) in warm._warm_fused  # plan memo populated by warmup...
+    warm.train()
+    assert not warm._warm_fused  # ...and consumed at dispatch
+    _tree_equal(cold.global_vars, warm.global_vars)
+
+
+def test_warmup_vs_cold_numerics_parity_loopback():
+    from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+
+    data, model = _data(), _model()
+    cold = run_loopback_federation(_cfg(), data, model)
+    warm = run_loopback_federation(_cfg(), data, model, warmup=True)
+    _tree_equal(cold.global_vars, warm.global_vars)
+
+
+# ---------------------------------------------------------------------------
+# HardenedFileCache: integrity, quarantine, atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_hardened_cache_roundtrip(tmp_path):
+    c = HardenedFileCache(str(tmp_path))
+    assert c.get("k1") is None
+    c.put("k1", b"payload-bytes")
+    assert c.get("k1") == b"payload-bytes"
+    assert c.stats() == {
+        "hits": 1, "misses": 1, "puts": 1, "quarantined": 0, "evicted": 0,
+    }
+
+
+def test_hardened_cache_size_cap_evicts_lru(tmp_path, monkeypatch):
+    """jax_compilation_cache_max_size parity: the hardened store enforces
+    the size cap the stock LRUCache honored, evicting least-recently-used
+    entries (never the one just written)."""
+    c = HardenedFileCache(str(tmp_path))
+    monkeypatch.setattr(
+        HardenedFileCache, "_max_size_bytes", staticmethod(lambda: 150)
+    )
+    c.put("old", b"x" * 60)
+    time.sleep(0.05)  # distinct timestamps order the LRU scan
+    c.put("mid", b"y" * 60)
+    time.sleep(0.05)
+    c.put("new", b"z" * 60)  # framed total now exceeds the 150-byte cap
+    assert c.get("new") == b"z" * 60
+    assert c.get("old") is None  # oldest evicted
+    assert c.stats()["evicted"] >= 1
+    assert c.stats()["quarantined"] == 0
+
+
+def test_hardened_cache_first_writer_wins(tmp_path):
+    c = HardenedFileCache(str(tmp_path))
+    c.put("k", b"first")
+    c.put("k", b"second")
+    assert c.get("k") == b"first"
+    assert c.stats()["puts"] == 1
+
+
+def test_hardened_cache_quarantines_truncated_entry(tmp_path):
+    """A torn/truncated entry returns a MISS (the program recompiles) and
+    is moved into quarantine/ — never wrong bytes."""
+    c = HardenedFileCache(str(tmp_path))
+    c.put("k", b"x" * 256)
+    (entry,) = tmp_path.glob("*.ftpc")
+    blob = entry.read_bytes()
+    entry.write_bytes(blob[: len(blob) // 2])
+    assert c.get("k") is None
+    assert c.stats()["quarantined"] == 1
+    assert not entry.exists()
+    assert len(list((tmp_path / "quarantine").iterdir())) == 1
+    # the slot is writable again — recompile then hit
+    c.put("k", b"y" * 256)
+    assert c.get("k") == b"y" * 256
+
+
+def test_hardened_cache_rejects_bit_rot(tmp_path):
+    c = HardenedFileCache(str(tmp_path))
+    c.put("k", b"A" * 64)
+    (entry,) = tmp_path.glob("*.ftpc")
+    blob = bytearray(entry.read_bytes())
+    blob[-1] ^= 0xFF  # flip one payload bit
+    entry.write_bytes(bytes(blob))
+    assert c.get("k") is None
+    assert c.stats()["quarantined"] == 1
+
+
+def test_hardened_cache_ignores_stock_format_files(tmp_path):
+    """A directory previously populated by the stock jax cache is treated
+    as empty (our entries carry the .ftpc suffix + magic), not misread."""
+    (tmp_path / "jit_foo-deadbeef").write_bytes(b"stock cache bytes")
+    c = HardenedFileCache(str(tmp_path))
+    assert c.get("jit_foo-deadbeef") is None
+    assert c.stats()["quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real jax compiles through the hardened store (subprocesses)
+# ---------------------------------------------------------------------------
+
+_E2E_PROG = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from fedml_tpu.compile import install_hardened_cache
+c = install_hardened_cache(sys.argv[1], min_compile_time_secs=0.0)
+assert c is not None, "hardened cache failed to install on this jax"
+f = jax.jit(lambda x: jnp.sin(x) @ x.T)
+x = np.arange(64 * 64, dtype=np.float32).reshape(64, 64) / 4096.0
+r = np.asarray(f(x))
+print(json.dumps({"stats": c.stats(), "sum": float(r.sum())}))
+"""
+
+
+def _run_e2e(cache_dir):
+    out = subprocess.run(
+        [sys.executable, "-c", _E2E_PROG, str(cache_dir)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_e2e_persistent_cache_hit_and_corruption_recovery(tmp_path):
+    """Three fresh processes over one cache dir: (1) cold compile + put;
+    (2) integrity-verified hit; (3) after on-disk truncation, the loader
+    quarantines and RECOMPILES to the same numerics instead of
+    deserializing garbage — the PR 3 incident class, closed."""
+    r1 = _run_e2e(tmp_path)
+    assert r1["stats"]["puts"] >= 1
+    r2 = _run_e2e(tmp_path)
+    assert r2["stats"]["hits"] >= 1
+    assert r2["sum"] == r1["sum"]
+    for p in pathlib.Path(tmp_path).glob("*.ftpc"):
+        blob = p.read_bytes()
+        p.write_bytes(blob[: len(blob) // 2])
+    r3 = _run_e2e(tmp_path)
+    assert r3["stats"]["quarantined"] >= 1
+    assert r3["stats"]["hits"] == 0
+    assert r3["sum"] == r1["sum"]
+    assert (pathlib.Path(tmp_path) / "quarantine").exists()
+
+
+# ---------------------------------------------------------------------------
+# session fixture contract
+# ---------------------------------------------------------------------------
+
+
+def test_program_cache_fixture_is_the_global_registry(program_cache):
+    assert program_cache is get_program_cache()
+
+
+def test_install_run_cache_restores_previous_binding(tmp_path):
+    """A run-scoped cache install must not hijack later compiles in a
+    long-lived process: restore() reinstates the prior binding (here: the
+    conftest-installed shared hardened store)."""
+    import jax
+
+    from fedml_tpu.compile import install_run_cache, installed_cache
+
+    prev = installed_cache()
+    prev_dir = jax.config.jax_compilation_cache_dir
+    cache, restore = install_run_cache(str(tmp_path), min_compile_time_secs=3.0)
+    assert installed_cache() is cache
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+    restore()
+    assert installed_cache() is prev
+    assert jax.config.jax_compilation_cache_dir == prev_dir

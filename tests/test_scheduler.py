@@ -322,10 +322,18 @@ def test_fedbuff_fault_starvation_raises_instead_of_hanging():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("policy,factor", [("uniform", 1.0), ("weighted", 1.5)])
+@pytest.mark.parametrize(
+    "policy,factor",
+    [("uniform", 1.0), ("weighted", 1.5), ("power_of_choice", 1.0)],
+)
 def test_selection_parity_simulation_vs_transport(policy, factor):
     """Same seed + config ⇒ byte-identical per-round selected-client sets
-    in the vmap simulator and the loopback transport federation."""
+    in the vmap simulator and the loopback transport federation.
+
+    power_of_choice parity is the PR 4 scheduler follow-up: the vmap round
+    program now returns per-client loss vectors, so the simulator biases
+    on TRUE per-client losses (not the cohort mean) — the same signal the
+    transport reads off its uploads' ARG_TRAIN_LOSS."""
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
 
