@@ -13,8 +13,20 @@ cd "$(dirname "$0")/.."
 echo "== syntax gate =="
 python -m compileall -q fedml_tpu tests bench.py __graft_entry__.py
 
+# fedlint JIT-hazard gate (docs/ANALYSIS.md) — stdlib-only, runs before
+# jax starts: zero unsuppressed findings or the gate is red
+echo "== static analysis gate (fedlint) =="
+python -m fedml_tpu.analysis --fail-on-findings
+
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export JAX_PLATFORMS=cpu
+
+# digest-completeness fuzzer: every registered program factory must split
+# its digest whenever a config perturbation changes the lowered program
+# (the SCAFFOLD eta_g silent-wrong-numerics class) — abstract lowering
+# only, no compiles
+echo "== digest-completeness audit =="
+python -m fedml_tpu.analysis --digest-audit --fail-on-findings
 
 echo "== fast unit tier =="
 python -m pytest tests/ -q -m 'not slow' -x
@@ -142,6 +154,19 @@ print(f"  compile ok: warmup compile {s1['compile/compile_s']:.2f}s -> "
       f"persistent hit(s), numerics identical")
 PY
 rm -rf "$CCDIR" "$CLOG1" "$CLOG2"
+
+echo "== CLI smoke: recompile-budget sentinel =="
+# a sane budget passes; budget 0 must fail loudly (exit 1) — both
+# directions of the tripwire (fedml_tpu/analysis/sentinel.py)
+python -m fedml_tpu --algorithm fedavg --model lr --dataset synthetic \
+  --client_num_in_total 8 --client_num_per_round 4 --comm_round 2 \
+  --epochs 1 --recompile_budget 150 --ci > /dev/null
+if python -m fedml_tpu --algorithm fedavg --model lr --dataset synthetic \
+  --client_num_in_total 8 --client_num_per_round 4 --comm_round 1 \
+  --epochs 1 --recompile_budget 0 --ci > /dev/null 2>&1; then
+  echo "  ERROR: --recompile_budget 0 did not fail"; exit 1
+fi
+echo "  recompile_budget ok"
 
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

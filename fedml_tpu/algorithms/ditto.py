@@ -103,7 +103,28 @@ def make_ditto_round(
         )
         return new_global, new_stack, g_metrics
 
-    return jax.jit(round_fn, donate_argnums=(1,) if donate else ())
+    # program dedup (fedml_tpu/compile/): fedlint uncached-jit caught this
+    # factory returning a bare jit object — --warmup aside, every DittoAPI
+    # construction over the same (model, config, lam) recompiled its own
+    # round. lam is baked into the traced personal objective (prox_mu) as
+    # a program CONSTANT, so it must split the digest.
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
+    return get_program_cache().get_or_build(
+        "ditto_round",
+        {
+            "kind": "ditto_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "lam": float(lam),
+            "mode": client_mode,
+            "parallelism": config.fed.client_parallelism,
+            "donate": donate,
+        },
+        lambda: jax.jit(round_fn, donate_argnums=(1,) if donate else ()),
+    )
 
 
 def _make_ditto_cohort_body(model, config, lam, task, client_mode):
@@ -159,10 +180,25 @@ def make_ditto_cohort_round(
     moved out to the host store (state_store.MmapClientState); only the
     cohort's [C, ...] personal rows enter HBM. Identical in-program math
     ⇒ spilled runs bit-match in-HBM runs (tests/test_state_spill.py)."""
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
     # donate the cohort rows (argnum 1): the host store keeps the durable copy
-    return jax.jit(
-        _make_ditto_cohort_body(model, config, lam, task, client_mode),
-        donate_argnums=(1,),
+    return get_program_cache().get_or_build(
+        "ditto_cohort_round",
+        {
+            "kind": "ditto_cohort_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "lam": float(lam),
+            "mode": client_mode,
+            "parallelism": config.fed.client_parallelism,
+        },
+        lambda: jax.jit(
+            _make_ditto_cohort_body(model, config, lam, task, client_mode),
+            donate_argnums=(1,),
+        ),
     )
 
 
@@ -225,7 +261,26 @@ def make_sharded_ditto_cohort_round(
         out_specs=(P(), data_spec, P()),
         check_vma=False,  # same stance as make_sharded_ditto_round
     )
-    return jax.jit(sharded, donate_argnums=(1,))
+    from fedml_tpu.compile import (
+        get_program_cache,
+        mesh_fingerprint,
+        model_fingerprint,
+    )
+
+    return get_program_cache().get_or_build(
+        "sharded_ditto_cohort_round",
+        {
+            "kind": "sharded_ditto_cohort_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "lam": float(lam),
+            "parallelism": config.fed.client_parallelism,
+            "mesh": mesh_fingerprint(mesh),
+        },
+        lambda: jax.jit(sharded, donate_argnums=(1,)),
+    )
 
 
 def make_sharded_ditto_round(
@@ -306,7 +361,27 @@ def make_sharded_ditto_round(
         # static VMA inference (same stance as scaffold's sharded round)
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(1,) if donate else ())
+    from fedml_tpu.compile import (
+        get_program_cache,
+        mesh_fingerprint,
+        model_fingerprint,
+    )
+
+    return get_program_cache().get_or_build(
+        "sharded_ditto_round",
+        {
+            "kind": "sharded_ditto_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "lam": float(lam),
+            "parallelism": config.fed.client_parallelism,
+            "mesh": mesh_fingerprint(mesh),
+            "donate": donate,
+        },
+        lambda: jax.jit(sharded, donate_argnums=(1,) if donate else ()),
+    )
 
 
 class DittoAPI(FedAvgAPI):

@@ -224,23 +224,13 @@ class FedAvgServerManager(ServerManager):
         self._server_step = None
         self._server_opt_state = None
         if server_opt:
-            from fedml_tpu.algorithms.fedopt import (
-                make_server_optimizer,
-                make_server_step,
-            )
-            from fedml_tpu.compile import get_program_cache
+            # one registration point (fedopt.make_cached_server_step) so
+            # this manager and the vmap/mesh APIs can never drift apart in
+            # how they key the shared server-step program
+            from fedml_tpu.algorithms.fedopt import make_cached_server_step
 
-            self._server_optimizer = make_server_optimizer(config.server)
-            # program dedup: the step's code is determined by the server
-            # config alone (param shapes are a jit shape class)
-            self._server_step = get_program_cache().get_or_build(
-                "server_opt",
-                {
-                    "kind": "fedopt_server_step",
-                    "server": config.server,
-                    "step_builder": make_server_step,
-                },
-                lambda: jax.jit(make_server_step(self._server_optimizer)),
+            self._server_step, self._server_optimizer = (
+                make_cached_server_step(config)
             )
         self.round_idx = 0
         # Straggler deadline state (FedConfig.deadline_s/min_clients). The

@@ -55,9 +55,8 @@ from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message, MessageType as MT
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.models import ModelDef
-from fedml_tpu.algorithms.fedavg_transport import LocalTrainer
+from fedml_tpu.algorithms.fedavg_transport import LocalTrainer, shared_local_train
 from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
-from fedml_tpu.train.client import make_local_train
 from fedml_tpu.train.evaluate import evaluate, make_eval_fn
 
 
@@ -610,9 +609,11 @@ def run_fedbuff_federation(
     injector = FaultInjector.from_config(
         config, health=server.health, tracer=_get_tracer()
     )
-    shared_train = jax.jit(
-        make_local_train(model, config.train, config.fed.epochs, task=task)
-    )
+    # THE shared transport local-train program (fedavg_transport): deduped
+    # through the ProgramCache, so a fedbuff fleet shares the sync
+    # transports' compile instead of jitting its own throwaway copy
+    # (fedlint uncached-jit caught the bare jax.jit that used to be here)
+    shared_train = shared_local_train(model, config, task)
     clients = [
         FedBuffClientManager(
             config,

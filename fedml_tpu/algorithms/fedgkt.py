@@ -58,12 +58,12 @@ class FedGKTAPI:
         self.client_opt = optax.sgd(lr, momentum=0.9)
         self.server_opt = optax.sgd(lr, momentum=0.9)
         self.server_opt_state = self.server_opt.init(self.server_vars["params"])
-        self._client_step = jax.jit(self._make_client_step())
-        self._server_step = jax.jit(self._make_server_step())
-        self._extract = jax.jit(
+        self._client_step = jax.jit(self._make_client_step())  # fedlint: disable=uncached-jit -- per-API-instance step over opaque self state; long-tail driver outside the warmup/dedup path
+        self._server_step = jax.jit(self._make_server_step())  # fedlint: disable=uncached-jit -- per-API-instance step over opaque self state; long-tail driver outside the warmup/dedup path
+        self._extract = jax.jit(  # fedlint: disable=uncached-jit -- per-API-instance inference closure over self.client_net; long-tail driver outside the warmup/dedup path
             lambda cv, x: self.client_net.apply(cv, x, train=False)
         )
-        self._server_infer = jax.jit(
+        self._server_infer = jax.jit(  # fedlint: disable=uncached-jit -- per-API-instance inference closure over self.server_net; long-tail driver outside the warmup/dedup path
             lambda sv, f: self.server_net.apply(sv, f, train=False)
         )
 

@@ -75,8 +75,8 @@ class FedNASAPI:
         self.arch_opt = optax.adam(arch_lr, b1=0.5, b2=0.999)
         self.batch_size = batch_size
         self.genotype_history: List = []
-        self._train_step = jax.jit(self._make_step(update_arch=False))
-        self._arch_step = jax.jit(
+        self._train_step = jax.jit(self._make_step(update_arch=False))  # fedlint: disable=uncached-jit -- per-API-instance DARTS step over opaque self state; long-tail driver outside the warmup/dedup path
+        self._arch_step = jax.jit(  # fedlint: disable=uncached-jit -- per-API-instance DARTS arch step over opaque self state; long-tail driver outside the warmup/dedup path
             self._make_second_order_arch_step()
             if arch_grad == "second"
             else self._make_step(update_arch=True)

@@ -67,6 +67,34 @@ def make_server_step(opt: optax.GradientTransformation) -> Callable:
     return server_step
 
 
+def make_cached_server_step(config: RunConfig):
+    """THE jitted FedOpt server-step program, deduped through the
+    process-wide ProgramCache — the one registration point shared by the
+    vmap/mesh APIs and the transport server manager (both previously
+    spelled the digest dict out by hand; a drift between the two copies
+    would have split the program they are required to share). The step's
+    CODE is fully determined by the server config — the param tree enters
+    as a jit shape class, not a program determinant — so one jit object
+    serves every model and every API instance in the process. Returns
+    ``(cached_program, optimizer)``."""
+    from fedml_tpu.compile import get_program_cache
+
+    opt = make_server_optimizer(config.server)
+    # step_builder marker MUST be the module-level make_server_step —
+    # every call site keys the same program with it, so all sides dedup
+    # onto ONE executable
+    prog = get_program_cache().get_or_build(
+        "server_opt",
+        {
+            "kind": "fedopt_server_step",
+            "server": config.server,
+            "step_builder": make_server_step,
+        },
+        lambda: jax.jit(make_server_step(opt)),
+    )
+    return prog, opt
+
+
 class FedOptAPI(FedAvgAPI):
     _supports_fused = False  # per-round host-side work forbids chunk fusion
     """FedOpt simulator: FedAvgAPI with a server-optimizer step appended to
@@ -76,26 +104,8 @@ class FedOptAPI(FedAvgAPI):
 
     def __init__(self, config: RunConfig, data: FederatedDataset, model: ModelDef, **kw):
         super().__init__(config, data, model, **kw)
-        self.server_opt = make_server_optimizer(config.server)
+        self._server_step, self.server_opt = make_cached_server_step(config)
         self.server_opt_state = self.server_opt.init(self.global_vars["params"])
-        # program dedup (fedml_tpu/compile/): the server step's CODE is
-        # fully determined by the server config (the param tree enters as
-        # a jit shape class, not a program determinant) — one jit object
-        # serves every model and every API instance in the process
-        from fedml_tpu.compile import get_program_cache
-
-        # step_builder marker MUST be the module-level make_server_step —
-        # the transport server manager (fedavg_transport) keys the same
-        # program with it, so both sides dedup onto ONE executable
-        self._server_step = get_program_cache().get_or_build(
-            "server_opt",
-            {
-                "kind": "fedopt_server_step",
-                "server": config.server,
-                "step_builder": make_server_step,
-            },
-            lambda: jax.jit(make_server_step(self.server_opt)),
-        )
 
     def train_round(self, round_idx: int):
         old_vars = self.global_vars

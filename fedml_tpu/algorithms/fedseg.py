@@ -26,7 +26,7 @@ class FedSegAPI(FedAvgAPI):
         super().__init__(config, data, model, **kw)
         self.checkpoint_path = checkpoint_path
         self.best_miou = -1.0
-        self._predict = jax.jit(
+        self._predict = jax.jit(  # fedlint: disable=uncached-jit -- per-API-instance argmax-predict closure over self.model; eval-only long-tail path
             lambda v, x: jnp.argmax(self.model.apply(v, x, train=False)[0], -1)
         )
 

@@ -60,7 +60,16 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
         self.groups = resolve_groups(
             groups, data.num_clients, config.fed.group_num, config.seed
         )
-        self._avg = jax.jit(weighted_average)
+        # program dedup (fedml_tpu/compile/): weighted_average is a pure
+        # module-level fn — one jitted cross-group average per process
+        # instead of one per API instance (fedlint uncached-jit catch)
+        from fedml_tpu.compile import get_program_cache
+
+        self._avg = get_program_cache().get_or_build(
+            "hierarchical_cloud_avg",
+            {"kind": "hierarchical_cloud_avg", "fn": weighted_average},
+            lambda: jax.jit(weighted_average),
+        )
 
     def _group_round(self, round_idx: int, gi: int, members, sampled_set):
         """One group's ``group_comm_round`` sub-rounds from the current

@@ -295,11 +295,28 @@ def make_scaffold_cohort_round(
     cohort's [C, ...] control rows enter HBM. The in-program math after
     the gather is the same code, so a spilled run bit-matches the in-HBM
     run (pinned in tests/test_state_spill.py)."""
+    from fedml_tpu.compile import get_program_cache, model_fingerprint
+
     # donate the cohort rows (argnum 2): the host store keeps the durable
-    # copy; the device rows are consumed by the round
-    return jax.jit(
-        _make_scaffold_cohort_body(model, config, task, client_mode),
-        donate_argnums=(2,),
+    # copy; the device rows are consumed by the round. Same digest shape
+    # as make_scaffold_round: eta_g and 1/N are baked program constants.
+    return get_program_cache().get_or_build(
+        "scaffold_cohort_round",
+        {
+            "kind": "scaffold_cohort_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "mode": client_mode,
+            "parallelism": config.fed.client_parallelism,
+            "server": config.server,
+            "n_total": config.fed.client_num_in_total,
+        },
+        lambda: jax.jit(
+            _make_scaffold_cohort_body(model, config, task, client_mode),
+            donate_argnums=(2,),
+        ),
     )
 
 
@@ -397,7 +414,27 @@ def make_sharded_scaffold_cohort_round(
         out_specs=(P(), P(), data_spec, P()),
         check_vma=False,  # same stance as make_sharded_scaffold_round
     )
-    return jax.jit(sharded, donate_argnums=(2,))
+    from fedml_tpu.compile import (
+        get_program_cache,
+        mesh_fingerprint,
+        model_fingerprint,
+    )
+
+    return get_program_cache().get_or_build(
+        "sharded_scaffold_cohort_round",
+        {
+            "kind": "sharded_scaffold_cohort_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "parallelism": config.fed.client_parallelism,
+            "server": config.server,
+            "n_total": config.fed.client_num_in_total,
+            "mesh": mesh_fingerprint(mesh),
+        },
+        lambda: jax.jit(sharded, donate_argnums=(2,)),
+    )
 
 
 def make_sharded_scaffold_round(model: ModelDef, config: RunConfig, mesh, task: str = "classification", donate: bool = True):
@@ -506,7 +543,28 @@ def make_sharded_scaffold_round(model: ModelDef, config: RunConfig, mesh, task: 
         # mesh-invariance test pins sharded == single-chip bitwise-close
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(2,) if donate else ())
+    from fedml_tpu.compile import (
+        get_program_cache,
+        mesh_fingerprint,
+        model_fingerprint,
+    )
+
+    return get_program_cache().get_or_build(
+        "sharded_scaffold_round",
+        {
+            "kind": "sharded_scaffold_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "parallelism": config.fed.client_parallelism,
+            "server": config.server,
+            "n_total": config.fed.client_num_in_total,
+            "mesh": mesh_fingerprint(mesh),
+            "donate": donate,
+        },
+        lambda: jax.jit(sharded, donate_argnums=(2,) if donate else ()),
+    )
 
 
 class ScaffoldAPI(FedAvgAPI):

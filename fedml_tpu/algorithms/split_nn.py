@@ -87,7 +87,7 @@ class SplitNNAPI:
         self.opt_state = self.opt.init(
             {"bottom": self.bottom_vars["params"], "top": self.top_vars["params"]}
         )
-        self._step = jax.jit(self._make_step())
+        self._step = jax.jit(self._make_step())  # fedlint: disable=uncached-jit -- per-API-instance split step over opaque self state; long-tail driver outside the warmup/dedup path
 
     def _make_step(self):
         bottom, top, opt = self.bottom, self.top, self.opt

@@ -69,7 +69,7 @@ class VFLAPI:
         self.opt = optax.sgd(lr, momentum=0.9)
         self.params = [p.params for p in self.parties]
         self.opt_state = self.opt.init(self.params)
-        self._step = jax.jit(self._make_step())
+        self._step = jax.jit(self._make_step())  # fedlint: disable=uncached-jit -- per-API-instance VFL step over opaque self state; long-tail driver outside the warmup/dedup path
 
     def _make_step(self):
         parties = self.parties

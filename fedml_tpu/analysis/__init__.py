@@ -1,0 +1,42 @@
+"""Correctness tooling for the trace/compile boundary — fedlint, the
+digest-completeness fuzzer, and the runtime recompile sentinel.
+
+PyTorch-eager FL frameworks (the reference FedML) have no trace/compile
+boundary to violate; this JAX port has three new hazard classes, each of
+which has actually produced a silent bug here (see docs/ANALYSIS.md):
+
+1. **Static** — :mod:`fedml_tpu.analysis.lint` (fedlint): AST rules over
+   the package that flag closure-captured config baked into cached
+   programs without a digest field, bare ``jax.jit`` bypassing the
+   ProgramCache, host syncs and host nondeterminism inside traced
+   bodies, and ``repr``/``id`` values flowing into digests. Stdlib-only
+   (runs before/without jax) — the ci.sh gate.
+2. **Semantic** — :mod:`fedml_tpu.analysis.digest_audit`: for each
+   registered program factory, perturb one config field at a time,
+   lower with abstract inputs, and assert the digest splits whenever
+   the lowered program changes (the mechanized form of PR 4's manual
+   audit that caught the SCAFFOLD eta_g bug).
+3. **Runtime** — :mod:`fedml_tpu.analysis.sentinel`: XLA compile-event
+   accounting behind ``--recompile_budget`` and the
+   ``@pytest.mark.recompile_budget`` marker, so a cache-key instability
+   that recompiles every round trips an alarm instead of a slowdown.
+
+Entry point: ``python -m fedml_tpu.analysis [--fail-on-findings]
+[--digest-audit]``."""
+
+from fedml_tpu.analysis.lint import (
+    LintReport,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from fedml_tpu.analysis.rules import RULES, Finding
+
+__all__ = [
+    "Finding",
+    "LintReport",
+    "RULES",
+    "lint_paths",
+    "load_baseline",
+    "write_baseline",
+]

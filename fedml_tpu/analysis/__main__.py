@@ -1,0 +1,124 @@
+"""``python -m fedml_tpu.analysis`` — the CI face of the analysis layer.
+
+Default mode lints the fedml_tpu package (stdlib-only, no jax import —
+safe as the first ci.sh stage). ``--digest-audit`` additionally runs the
+digest-completeness fuzzer over every registered program factory (this
+DOES import jax and lowers programs; run it under the same
+JAX_PLATFORMS/XLA_FLAGS environment as the test tier).
+
+Exit codes: 0 clean; 1 unsuppressed findings (with --fail-on-findings)
+or digest-audit violations; 2 usage errors."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _package_root() -> str:
+    """The checkout root (the directory CONTAINING the fedml_tpu package)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def _default_baseline() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fedlint_baseline.json"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fedml_tpu.analysis",
+        description="fedlint static analysis + digest-completeness fuzzer "
+        "(docs/ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the fedml_tpu package)",
+    )
+    parser.add_argument(
+        "--fail-on-findings", action="store_true",
+        help="exit 1 when unsuppressed findings remain (the CI gate mode)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON of accepted finding fingerprints "
+        "(default: fedml_tpu/analysis/fedlint_baseline.json when present)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept the current findings into the baseline file and exit 0 "
+        "(requires review — an unreviewed baseline defeats the gate)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", default=None,
+        metavar="RULE", help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--digest-audit", action="store_true",
+        help="also run the digest-completeness fuzzer over all registered "
+        "program factories (imports jax)",
+    )
+    args = parser.parse_args(argv)
+
+    from fedml_tpu.analysis.lint import (
+        lint_paths,
+        load_baseline,
+        write_baseline,
+    )
+    from fedml_tpu.analysis.rules import RULES
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.name:18s} {rule.doc}")
+        return 0
+
+    pkg_root = _package_root()
+    paths = args.paths or [os.path.join(pkg_root, "fedml_tpu")]
+    baseline_path = args.baseline or _default_baseline()
+    baseline = (
+        load_baseline(baseline_path) if os.path.exists(baseline_path) else set()
+    )
+
+    report = lint_paths(
+        paths, baseline=baseline, rules=args.rules, base_dir=pkg_root
+    )
+    if args.write_baseline:
+        write_baseline(baseline_path, report.findings)
+        print(
+            f"fedlint: wrote {len(report.findings)} fingerprint(s) to "
+            f"{baseline_path} — review before committing"
+        )
+        return 0
+    print(report.render())
+
+    rc = 0
+    if report.findings and args.fail_on_findings:
+        rc = 1
+
+    if args.digest_audit:
+        from fedml_tpu.analysis.digest_audit import audit_all, default_specs
+
+        audits, violations = audit_all(default_specs())
+        for audit in audits:
+            print(audit.render())
+        if violations:
+            print(
+                f"digest-audit: {len(violations)} VIOLATION(S) — a config "
+                "perturbation changed the lowered program without changing "
+                "the digest (silent-wrong-numerics hazard)"
+            )
+            rc = 1
+        else:
+            print(f"digest-audit: {len(audits)} factory(ies) clean")
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,590 @@
+"""Digest-completeness fuzzer — the mechanized form of PR 4's manual
+factory audit.
+
+The ProgramCache's correctness contract is one implication: **if two
+configs produce different traced programs, their digests must differ.**
+(The converse — digest splits on irrelevant fields — only costs a
+duplicate compile, never numerics, and is allowed.) PR 4 verified the
+implication by hand and found SCAFFOLD baking ``eta_g`` and ``1/N`` into
+the traced round as constants while the digest ignored them: any
+full-suite run mixing two scaffold configs silently reused the wrong
+program. This module proves the implication per factory, on every tree:
+
+for each registered factory spec
+    build the base config's program          (in a FRESH ProgramCache)
+    for each single-field perturbation
+        build the perturbed program          (its own fresh cache)
+        if the digests differ             -> fine ("distinct")
+        else lower BOTH with abstract inputs
+            identical module text         -> fine ("merged-identical")
+            different module text         -> VIOLATION
+
+Everything stays abstract — ``jit(...).lower()`` over
+``jax.ShapeDtypeStruct`` trees traces but never compiles or executes,
+so the full audit over every factory runs in seconds on CPU.
+
+The fresh-cache-per-build discipline (``use_program_cache``) matters:
+built through the shared global cache, a digest collision would hand the
+perturbed build the BASE program object and there would be nothing left
+to compare — the collision is exactly what must be observed.
+
+``drop_digest_fields`` re-keys programs with named digest fields
+removed (via the ``CachedProgram.key_fields`` introspection hook):
+dropping ``server`` from the scaffold digest MUST make the audit fail
+on the ``server.server_lr`` perturbation — tests/test_analysis.py pins
+that the fuzzer really detects its target hazard class."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from fedml_tpu.config import RunConfig
+
+# Shared abstract-shape vocabulary: C clients, S local steps, B batch,
+# FEAT per-example features, NCLS classes, NTOT population size (kept in
+# sync with the base config below).
+S, B = 2, 8
+FEAT = (10,)
+NCLS = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Perturbation:
+    """One single-field config change. ``field`` is a dotted RunConfig
+    path ('train.lr', 'fed.epochs'); a leading '@' targets a factory
+    kwarg instead ('@lam', '@q')."""
+
+    field: str
+    value: Any
+
+
+@dataclasses.dataclass
+class PerturbResult:
+    field: str
+    status: str  # distinct | merged-identical | rejected | unlowerable | VIOLATION
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class FactoryAudit:
+    name: str
+    results: List[PerturbResult]
+
+    @property
+    def violations(self) -> List[PerturbResult]:
+        return [r for r in self.results if r.status == "VIOLATION"]
+
+    def render(self) -> str:
+        counts: Dict[str, int] = {}
+        for r in self.results:
+            counts[r.status] = counts.get(r.status, 0) + 1
+        summary = ", ".join(f"{k}:{v}" for k, v in sorted(counts.items()))
+        lines = [f"digest-audit {self.name}: {summary or 'no perturbations'}"]
+        lines.extend(
+            f"  VIOLATION {r.field}: {r.detail}" for r in self.violations
+        )
+        return "\n".join(lines)
+
+
+class DigestAuditError(AssertionError):
+    """At least one perturbation changed the lowered program without
+    changing the digest — the silent-wrong-numerics hazard."""
+
+
+@dataclasses.dataclass
+class FactorySpec:
+    """One registered program factory: how to build its CachedProgram
+    from a config and how to make abstract lower() inputs for it."""
+
+    name: str
+    build: Callable[[RunConfig, dict, Dict[str, Any]], Any]
+    args: Callable[[RunConfig, dict, Dict[str, Any]], tuple]
+    perturbations: List[Perturbation]
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    needs_mesh: bool = False
+
+
+def base_config() -> RunConfig:
+    """Tiny, CPU-lowerable base point in config space. client_parallelism
+    is pinned (not 'auto') so perturbing it is a pure one-field change."""
+    from fedml_tpu.config import DataConfig, FedConfig
+
+    return RunConfig(
+        data=DataConfig(batch_size=B),
+        fed=FedConfig(
+            client_num_in_total=6,
+            client_num_per_round=4,
+            epochs=1,
+            client_parallelism="vmap",
+        ),
+        model="lr",
+    )
+
+
+def config_replace(cfg: RunConfig, field: str, value: Any) -> RunConfig:
+    """Nested one-field dataclasses.replace ('train.lr' -> new value)."""
+    parts = field.split(".")
+    if len(parts) == 1:
+        return dataclasses.replace(cfg, **{parts[0]: value})
+    if len(parts) == 2:
+        section = getattr(cfg, parts[0])
+        return dataclasses.replace(
+            cfg, **{parts[0]: dataclasses.replace(section, **{parts[1]: value})}
+        )
+    raise ValueError(f"unsupported perturbation path {field!r}")
+
+
+# --------------------------------------------------------------------------
+# abstract input builders
+# --------------------------------------------------------------------------
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _gv_shapes(model):
+    import jax
+
+    return jax.eval_shape(model.init, jax.random.PRNGKey(0))
+
+
+def _cohort(cfg: RunConfig, C: int):
+    """(x, y, mask, num_samples, rngs) abstract round inputs."""
+    import numpy as np
+
+    return (
+        _sds((C, S, B) + FEAT, np.float32),
+        _sds((C, S, B), np.int32),
+        _sds((C, S, B), np.float32),
+        _sds((C,), np.float32),
+        _sds((C, 2), np.uint32),
+    )
+
+
+def _params_like(tree, lead=(), dtype=None):
+    import jax
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda s: _sds(tuple(lead) + tuple(s.shape), dtype or np.dtype(s.dtype)),
+        tree,
+    )
+
+
+def _model(ctx: dict):
+    if "model" not in ctx:
+        from fedml_tpu.models import create_model
+
+        ctx["model"] = create_model("lr", "synthetic", FEAT, NCLS)
+    return ctx["model"]
+
+
+def _mesh(ctx: dict):
+    if "mesh" not in ctx:
+        from fedml_tpu.parallel.mesh import make_mesh
+
+        ctx["mesh"] = make_mesh()
+    return ctx["mesh"]
+
+
+def _mesh_cohort_size(ctx: dict) -> int:
+    mesh = _mesh(ctx)
+    return max(int(mesh.size), 1) * 1
+
+
+# --------------------------------------------------------------------------
+# the factory registry
+# --------------------------------------------------------------------------
+
+_TRAIN_PERTURBS = [
+    Perturbation("train.lr", 0.31),
+    Perturbation("train.momentum", 0.9),
+    Perturbation("train.wd", 0.01),
+    Perturbation("train.prox_mu", 0.05),
+    Perturbation("train.compute_dtype", "bfloat16"),
+    Perturbation("train.client_optimizer", "adam"),
+    Perturbation("fed.epochs", 2),
+]
+_MODE_PERTURB = [Perturbation("fed.client_parallelism", "scan")]
+_SERVER_PERTURBS = [
+    Perturbation("server.server_lr", 0.5),
+    Perturbation("server.server_optimizer", "adam"),
+    Perturbation("server.server_momentum", 0.9),
+]
+# program-irrelevant fields — the audit should report merged-identical,
+# proving it tolerates benign digest merges instead of demanding splits
+_BENIGN_PERTURBS = [Perturbation("seed", 7), Perturbation("data.data_dir", "/x")]
+
+
+def default_specs() -> List[FactorySpec]:
+    import numpy as np
+
+    C = 4
+
+    def fedavg_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.fedavg import make_fedavg_round
+
+        return make_fedavg_round(_model(ctx), cfg).variant_for(None)
+
+    def fedavg_args(cfg, ctx, kw):
+        return (_gv_shapes(_model(ctx)),) + _cohort(cfg, C)
+
+    def multiround_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.fedavg import make_fedavg_multiround
+
+        return make_fedavg_multiround(_model(ctx), cfg, steps=S, bs=B)
+
+    def multiround_args(cfg, ctx, kw):
+        T, cap, n = 2, S * B, 48
+        return (
+            _gv_shapes(_model(ctx)),
+            _sds((n,) + FEAT, np.float32),
+            _sds((n,), np.int32),
+            _sds((T, C, cap), np.int32),
+            _sds((T, C, cap), np.float32),
+            _sds((T, C), np.float32),
+            _sds((T,), np.int32),
+            _sds((2,), np.uint32),
+        )
+
+    def fednova_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.fednova import make_fednova_round
+
+        return make_fednova_round(_model(ctx), cfg)
+
+    def qfedavg_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.qfedavg import make_qfedavg_round
+
+        return make_qfedavg_round(_model(ctx), cfg, q=kw.get("q", 1.0))
+
+    def scaffold_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.scaffold import make_scaffold_round
+
+        return make_scaffold_round(_model(ctx), cfg)
+
+    def scaffold_args(cfg, ctx, kw):
+        import numpy as np
+
+        gv = _gv_shapes(_model(ctx))
+        params = gv["params"]
+        N = cfg.fed.client_num_in_total
+        return (
+            gv,
+            _params_like(params, dtype=np.float32),
+            _params_like(params, lead=(N,), dtype=np.float32),
+            _sds((C,), np.int32),
+        ) + _cohort(cfg, C)
+
+    def scaffold_cohort_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.scaffold import make_scaffold_cohort_round
+
+        return make_scaffold_cohort_round(_model(ctx), cfg)
+
+    def scaffold_cohort_args(cfg, ctx, kw):
+        import numpy as np
+
+        gv = _gv_shapes(_model(ctx))
+        params = gv["params"]
+        return (
+            gv,
+            _params_like(params, dtype=np.float32),
+            _params_like(params, lead=(C,), dtype=np.float32),
+        ) + _cohort(cfg, C)
+
+    def ditto_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.ditto import make_ditto_round
+
+        return make_ditto_round(_model(ctx), cfg, lam=kw.get("lam", 0.1))
+
+    def ditto_args(cfg, ctx, kw):
+        import numpy as np
+
+        gv = _gv_shapes(_model(ctx))
+        N = cfg.fed.client_num_in_total
+        return (
+            gv,
+            _params_like(gv, lead=(N,)),
+            _sds((C,), np.int32),
+        ) + _cohort(cfg, C)
+
+    def ditto_cohort_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.ditto import make_ditto_cohort_round
+
+        return make_ditto_cohort_round(_model(ctx), cfg, lam=kw.get("lam", 0.1))
+
+    def ditto_cohort_args(cfg, ctx, kw):
+        gv = _gv_shapes(_model(ctx))
+        return (gv, _params_like(gv, lead=(C,))) + _cohort(cfg, C)
+
+    def server_step_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.fedopt import make_cached_server_step
+
+        prog, _opt = make_cached_server_step(cfg)
+        return prog
+
+    def server_step_args(cfg, ctx, kw):
+        import jax
+
+        from fedml_tpu.algorithms.fedopt import make_server_optimizer
+
+        gv = _gv_shapes(_model(ctx))
+        opt_state = jax.eval_shape(
+            make_server_optimizer(cfg.server).init, gv["params"]
+        )
+        return (gv, gv, opt_state)
+
+    def eval_build(cfg, ctx, kw):
+        from fedml_tpu.train.evaluate import make_eval_fn
+
+        return make_eval_fn(_model(ctx))
+
+    def eval_args(cfg, ctx, kw):
+        import numpy as np
+
+        return (
+            _gv_shapes(_model(ctx)),
+            _sds((S, B) + FEAT, np.float32),
+            _sds((S, B), np.int32),
+            _sds((S, B), np.float32),
+        )
+
+    def local_train_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.fedavg_transport import shared_local_train
+
+        return shared_local_train(_model(ctx), cfg, "classification")
+
+    def local_train_args(cfg, ctx, kw):
+        import numpy as np
+
+        return (
+            _gv_shapes(_model(ctx)),
+            _sds((S, B) + FEAT, np.float32),
+            _sds((S, B), np.int32),
+            _sds((S, B), np.float32),
+            _sds((2,), np.uint32),
+        )
+
+    def sharded_fedavg_build(cfg, ctx, kw):
+        from fedml_tpu.parallel.fedavg_sharded import make_sharded_fedavg_round
+
+        return make_sharded_fedavg_round(_model(ctx), cfg, _mesh(ctx))
+
+    def sharded_args(cfg, ctx, kw):
+        return (_gv_shapes(_model(ctx)),) + _cohort(cfg, _mesh_cohort_size(ctx))
+
+    def sharded_fednova_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.fednova import make_sharded_fednova_round
+
+        return make_sharded_fednova_round(_model(ctx), cfg, _mesh(ctx))
+
+    def sharded_scaffold_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.scaffold import make_sharded_scaffold_round
+
+        return make_sharded_scaffold_round(_model(ctx), cfg, _mesh(ctx))
+
+    def sharded_scaffold_args(cfg, ctx, kw):
+        import numpy as np
+
+        gv = _gv_shapes(_model(ctx))
+        params = gv["params"]
+        N = cfg.fed.client_num_in_total
+        Cm = _mesh_cohort_size(ctx)
+        return (
+            gv,
+            _params_like(params, dtype=np.float32),
+            _params_like(params, lead=(N,), dtype=np.float32),
+            _sds((Cm,), np.int32),
+        ) + _cohort(cfg, Cm)
+
+    return [
+        FactorySpec(
+            "fedavg_round", fedavg_build, fedavg_args,
+            _TRAIN_PERTURBS + _MODE_PERTURB + _BENIGN_PERTURBS,
+        ),
+        FactorySpec(
+            "fedavg_multiround", multiround_build, multiround_args,
+            _TRAIN_PERTURBS + _MODE_PERTURB,
+        ),
+        FactorySpec("fednova_round", fednova_build, fedavg_args, _TRAIN_PERTURBS),
+        FactorySpec(
+            "qfedavg_round", qfedavg_build, fedavg_args,
+            _TRAIN_PERTURBS + [Perturbation("@q", 2.0)],
+        ),
+        FactorySpec(
+            "scaffold_round", scaffold_build, scaffold_args,
+            _TRAIN_PERTURBS + _MODE_PERTURB + _SERVER_PERTURBS
+            + [Perturbation("fed.client_num_in_total", 9)],
+        ),
+        FactorySpec(
+            "scaffold_cohort_round", scaffold_cohort_build, scaffold_cohort_args,
+            _TRAIN_PERTURBS + _SERVER_PERTURBS
+            + [Perturbation("fed.client_num_in_total", 9)],
+        ),
+        FactorySpec(
+            "ditto_round", ditto_build, ditto_args,
+            _TRAIN_PERTURBS + [Perturbation("@lam", 0.5)],
+        ),
+        FactorySpec(
+            "ditto_cohort_round", ditto_cohort_build, ditto_cohort_args,
+            _TRAIN_PERTURBS + [Perturbation("@lam", 0.5)],
+        ),
+        FactorySpec(
+            "fedopt_server_step", server_step_build, server_step_args,
+            _SERVER_PERTURBS + _BENIGN_PERTURBS,
+        ),
+        FactorySpec("eval", eval_build, eval_args, _BENIGN_PERTURBS
+                    + [Perturbation("train.lr", 0.31)]),
+        FactorySpec(
+            "local_train", local_train_build, local_train_args, _TRAIN_PERTURBS
+        ),
+        FactorySpec(
+            "sharded_fedavg_round", sharded_fedavg_build, sharded_args,
+            _TRAIN_PERTURBS + _MODE_PERTURB, needs_mesh=True,
+        ),
+        FactorySpec(
+            "sharded_fednova_round", sharded_fednova_build, sharded_args,
+            [Perturbation("train.lr", 0.31), Perturbation("train.momentum", 0.9),
+             Perturbation("fed.epochs", 2)],
+            needs_mesh=True,
+        ),
+        FactorySpec(
+            "sharded_scaffold_round", sharded_scaffold_build,
+            sharded_scaffold_args,
+            [Perturbation("train.lr", 0.31), Perturbation("fed.epochs", 2)]
+            + _SERVER_PERTURBS
+            + [Perturbation("fed.client_num_in_total", 9)],
+            needs_mesh=True,
+        ),
+    ]
+
+
+# --------------------------------------------------------------------------
+# the audit itself
+# --------------------------------------------------------------------------
+
+
+def _build_fresh(spec: FactorySpec, cfg: RunConfig, ctx: dict, kw: Dict[str, Any]):
+    """Build the spec's program in a fresh ProgramCache (see module doc)."""
+    from fedml_tpu.compile import ProgramCache, use_program_cache
+
+    with use_program_cache(ProgramCache()):
+        return spec.build(cfg, ctx, kw)
+
+
+def _digest_of(prog, drop: FrozenSet[str]) -> Optional[str]:
+    if not drop or not getattr(prog, "key_fields", None):
+        return getattr(prog, "digest", None)
+    from fedml_tpu.compile import program_digest
+
+    return program_digest(
+        {k: v for k, v in prog.key_fields.items() if k not in drop}
+    )
+
+
+def _lowered_text(prog, args) -> str:
+    low = prog.lower(*args)
+    try:
+        text = low.as_text()
+    except Exception:  # pragma: no cover — very old jax
+        text = str(low.compiler_ir())
+    # strip location metadata — it can differ between two otherwise
+    # identical traces (closure line numbers)
+    return "\n".join(
+        ln for ln in text.splitlines() if not ln.lstrip().startswith("loc(")
+    )
+
+
+def audit_factory(
+    spec: FactorySpec,
+    cfg: Optional[RunConfig] = None,
+    ctx: Optional[dict] = None,
+    drop_digest_fields: FrozenSet[str] = frozenset(),
+) -> FactoryAudit:
+    """Run the completeness audit for one factory. Raises nothing —
+    returns the per-perturbation verdicts (callers decide severity)."""
+    cfg = cfg or base_config()
+    ctx = ctx if ctx is not None else {}
+    drop = frozenset(drop_digest_fields)
+    base_prog = _build_fresh(spec, cfg, ctx, dict(spec.kwargs))
+    base_digest = _digest_of(base_prog, drop)
+    base_text: Optional[str] = None
+    results: List[PerturbResult] = []
+    for pert in spec.perturbations:
+        kw = dict(spec.kwargs)
+        if pert.field.startswith("@"):
+            kw[pert.field[1:]] = pert.value
+            cfg2 = cfg
+        else:
+            cfg2 = config_replace(cfg, pert.field, pert.value)
+        try:
+            prog2 = _build_fresh(spec, cfg2, ctx, kw)
+        except Exception as e:  # noqa: BLE001 — guards ARE the protection
+            results.append(
+                PerturbResult(pert.field, "rejected", f"{type(e).__name__}: {e}")
+            )
+            continue
+        d2 = _digest_of(prog2, drop)
+        if base_digest is None or d2 is None:
+            results.append(
+                PerturbResult(
+                    pert.field, "VIOLATION",
+                    "program has no digest (bypassed factory?) — the audit "
+                    "cannot prove completeness",
+                )
+            )
+            continue
+        if d2 != base_digest:
+            results.append(PerturbResult(pert.field, "distinct"))
+            continue
+        # digest collision: the programs MUST be identical
+        try:
+            if base_text is None:
+                base_text = _lowered_text(base_prog, spec.args(cfg, ctx, dict(spec.kwargs)))
+            text2 = _lowered_text(prog2, spec.args(cfg2, ctx, kw))
+        except Exception as e:  # noqa: BLE001 — backend can't lower this combo
+            results.append(
+                PerturbResult(
+                    pert.field, "unlowerable", f"{type(e).__name__}: {e}"
+                )
+            )
+            continue
+        if text2 == base_text:
+            results.append(PerturbResult(pert.field, "merged-identical"))
+        else:
+            results.append(
+                PerturbResult(
+                    pert.field, "VIOLATION",
+                    f"perturbing {pert.field} -> {pert.value!r} changed the "
+                    "lowered program but not the digest "
+                    f"({(base_digest or '')[:12]}) — two configs would share "
+                    "one wrong executable",
+                )
+            )
+    return FactoryAudit(spec.name, results)
+
+
+def audit_all(
+    specs: Optional[List[FactorySpec]] = None,
+    cfg: Optional[RunConfig] = None,
+) -> Tuple[List[FactoryAudit], List[PerturbResult]]:
+    """Audit every registered factory; returns (audits, violations)."""
+    specs = specs if specs is not None else default_specs()
+    cfg = cfg or base_config()
+    ctx: dict = {}
+    audits = [audit_factory(s, cfg=cfg, ctx=ctx) for s in specs]
+    violations = [v for a in audits for v in a.violations]
+    return audits, violations
+
+
+def assert_digests_complete(specs=None) -> List[FactoryAudit]:
+    """Raise :class:`DigestAuditError` on any violation (pytest entry)."""
+    audits, violations = audit_all(specs)
+    if violations:
+        raise DigestAuditError(
+            "\n".join(a.render() for a in audits if a.violations)
+        )
+    return audits

@@ -1,0 +1,237 @@
+"""fedlint driver — walk .py files, run the rule registry, apply inline
+suppressions and the repo baseline, report.
+
+Stdlib-only (ast + json): the lint gate must run before — and without —
+jax, so ci.sh can fail fast on a hazard before paying any backend
+startup cost.
+
+Suppression syntax (applies to findings on the same line or the line
+directly below, so it works both as a trailing comment and as a
+stand-alone line above a multi-line statement)::
+
+    x = jax.jit(fn)  # fedlint: disable=uncached-jit -- one-shot probe
+    # fedlint: disable=host-sync,nondet-in-trace -- measurement harness
+    y = ...
+
+Everything after ``--`` is the REQUIRED justification: a suppression
+without one is itself reported (``bare-suppression``) — the triage
+discipline the analysis exists to enforce.
+
+Baseline: a JSON file of finding fingerprints (line-number free, see
+:meth:`fedml_tpu.analysis.rules.Finding.fingerprint`) accepted as known
+debt. ``--write-baseline`` regenerates it; the shipped baseline is
+EMPTY and reviewed — new findings must be fixed or suppressed inline
+with a justification, not silently baselined."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from fedml_tpu.analysis.rules import (
+    RULES,
+    FileContext,
+    Finding,
+    _attach_parents,
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fedlint:\s*disable=([A-Za-z0-9_,\- ]+?)(?:\s*--\s*(.*))?\s*$"
+)
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]          # unsuppressed, not baselined
+    suppressed: List[Finding]        # silenced by an inline justification
+    baselined: List[Finding]         # accepted debt from the baseline file
+    files_checked: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines.append(
+            f"fedlint: {len(self.findings)} finding(s), "
+            f"{len(self.suppressed)} suppressed, "
+            f"{len(self.baselined)} baselined, "
+            f"{self.files_checked} file(s) checked"
+        )
+        return "\n".join(lines)
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                out.extend(
+                    os.path.join(root, f) for f in files if f.endswith(".py")
+                )
+    return sorted(set(out))
+
+
+def _relpath(path: str, base: Optional[str]) -> str:
+    if base:
+        try:
+            return os.path.relpath(path, base).replace(os.sep, "/")
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+class _HelperIndex:
+    """Module-level function defs across the linted tree, plus per-module
+    import maps — the baked-constant rule follows bare-config helper
+    calls through these (one level, same package)."""
+
+    def __init__(self):
+        # abs path -> {function name: FunctionDef}
+        self.defs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        # abs path -> {imported name: (module dotted, original name)}
+        self.imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # module dotted name -> abs path
+        self.modules: Dict[str, str] = {}
+
+    def add(self, path: str, tree: ast.Module) -> None:
+        # parent links power the longest-attribute-chain dedup; helpers
+        # resolved cross-module are walked before their own FileContext
+        # exists, so annotate here
+        _attach_parents(tree)
+        funcs: Dict[str, ast.FunctionDef] = {}
+        imps: Dict[str, Tuple[str, str]] = {}
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                funcs[node.name] = node
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    imps[alias.asname or alias.name] = (node.module, alias.name)
+        self.defs[path] = funcs
+        self.imports[path] = imps
+        mod = _module_name(path)
+        if mod:
+            self.modules[mod] = path
+
+    def resolver(self, path: str):
+        def resolve(name: Optional[str]) -> Optional[ast.FunctionDef]:
+            if not name or "." in name:
+                return None
+            local = self.defs.get(path, {}).get(name)
+            if local is not None:
+                return local
+            imp = self.imports.get(path, {}).get(name)
+            if imp is None:
+                return None
+            mod, orig = imp
+            target = self.modules.get(mod)
+            if target is None:
+                return None
+            return self.defs.get(target, {}).get(orig)
+
+        return resolve
+
+
+def _module_name(path: str) -> Optional[str]:
+    """Dotted module name for a file inside a fedml_tpu checkout."""
+    parts = os.path.normpath(path).split(os.sep)
+    if "fedml_tpu" not in parts:
+        return None
+    idx = parts.index("fedml_tpu")
+    mod = parts[idx:]
+    mod[-1] = mod[-1][:-3]  # drop .py
+    if mod[-1] == "__init__":
+        mod = mod[:-1]
+    return ".".join(mod)
+
+
+def _suppressions(source: str) -> Dict[int, Tuple[Set[str], bool]]:
+    """line -> (suppressed rule names, has_justification)."""
+    out: Dict[int, Tuple[Set[str], bool]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out[i] = (rules, bool(m.group(2) and m.group(2).strip()))
+    return out
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path) as f:
+        doc = json.load(f)
+    return set(doc.get("findings", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    with open(path, "w") as f:
+        json.dump(
+            {"findings": sorted({fi.fingerprint() for fi in findings})},
+            f,
+            indent=2,
+        )
+        f.write("\n")
+
+
+def lint_paths(
+    paths: Sequence[str],
+    baseline: Optional[Set[str]] = None,
+    rules: Optional[Sequence[str]] = None,
+    base_dir: Optional[str] = None,
+) -> LintReport:
+    """Run fedlint over ``paths`` (files or directories). ``rules``
+    restricts to a subset of rule names; ``baseline`` is a set of
+    accepted fingerprints; ``base_dir`` makes reported paths relative."""
+    selected = [RULES[r] for r in rules] if rules else list(RULES.values())
+    files = _iter_py_files(paths)
+    index = _HelperIndex()
+    parsed: List[Tuple[str, ast.Module, str]] = []
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            raise SyntaxError(f"fedlint: cannot parse {path}: {e}") from e
+        index.add(path, tree)
+        parsed.append((path, tree, source))
+
+    report = LintReport([], [], [], files_checked=len(files))
+    baseline = baseline or set()
+    for path, tree, source in parsed:
+        rel = _relpath(path, base_dir)
+        ctx = FileContext(rel, tree, source, resolve_helper=index.resolver(path))
+        sup = _suppressions(source)
+        for rule in selected:
+            for finding in rule.check(ctx):
+                entry = sup.get(finding.line) or sup.get(finding.line - 1)
+                if entry is not None and (
+                    finding.rule in entry[0] or "all" in entry[0]
+                ):
+                    if not entry[1]:
+                        # suppression without a justification: keep the
+                        # silenced finding out, surface the discipline gap
+                        report.findings.append(
+                            Finding(
+                                "bare-suppression", rel, finding.line, 0,
+                                f"suppression of {finding.rule} has no "
+                                "justification — append '-- <reason>'",
+                                scope=finding.scope,
+                            )
+                        )
+                    report.suppressed.append(finding)
+                    continue
+                if finding.fingerprint() in baseline:
+                    report.baselined.append(finding)
+                    continue
+                report.findings.append(finding)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report

@@ -1,0 +1,710 @@
+"""fedlint rules — the JIT-hazard catalog, as AST checks.
+
+Every rule here encodes a bug CLASS that has actually bitten this
+codebase (or its ancestors) at the trace/compile boundary Frostig et
+al. 2018 describe: anything a Python closure captures at trace time
+becomes a constant of the compiled program, so the program-cache digest,
+the traced closure, and the dispatch path must be audited together.
+PR 4 found two instances by hand (SCAFFOLD baking ``eta_g``/``N`` into
+the traced round without digesting them; qfedavg returning bare ``jit``
+objects that bypassed the ProgramCache) — these rules find them
+mechanically, on every tree state.
+
+Rule ids are kebab-case and stable (baseline files and inline
+``# fedlint: disable=<rule>`` suppressions key on them):
+
+- ``uncached-jit``     — bare ``jax.jit`` in algorithms/ or parallel/
+  that neither feeds a ProgramCache builder nor wraps via
+  ``wrap_uncached`` (the qfedavg/sharded-fednova bug class: ``--warmup``
+  compiles into a throwaway object and dispatch recompiles).
+- ``baked-constant``   — a config value reachable from a ProgramCache
+  builder (hence baked into the traced program as a constant) that does
+  not appear in the factory's digest kwargs (the SCAFFOLD ``eta_g``
+  bug class: silent wrong numerics on digest collision).
+- ``host-sync``        — ``.item()`` / ``float()`` / ``np.asarray`` /
+  ``jax.device_get`` / ``print`` inside a traced round/train/eval body
+  (a device->host sync serializes the async dispatch pipeline — or
+  crashes at trace time after shipping).
+- ``nondet-in-trace``  — ``time.*`` / ``random.*`` / ``np.random.*``
+  inside traced code: executed at TRACE time, the drawn value is baked
+  into the program as a constant, so "random" silently means "random
+  once per compile" and runs are irreproducible across cache states.
+- ``repr-in-digest``   — ``repr()``/``id()``-derived values flowing
+  into ProgramCache key fields or ``*_fingerprint`` helpers: ``id()``
+  is never stable, ``repr`` only within a process — both poison any
+  cross-process digest use (ROADMAP's serialized-executable item).
+
+See docs/ANALYSIS.md for the catalog with examples and the suppression
+syntax. The checks are heuristic by design — conservative enough to be
+quiet on the blessed idioms (tests/test_analysis.py pins a negative
+case per rule) and loud on the minimal bad snippet (a positive case
+each)."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+# Names conventionally bound to a RunConfig in this repo — the roots the
+# baked-constant analysis tracks attribute chains from.
+CONFIG_ROOTS = ("config", "cfg")
+
+# Directories (relative to the package root) whose jit programs are the
+# training hot path — scope of uncached-jit / host-sync / nondet rules.
+HOT_DIRS = ("algorithms", "parallel", "train", "ops")
+JIT_RULE_DIRS = ("algorithms", "parallel")
+
+# Function names that are traced by convention in this codebase (round
+# bodies, local-train loops, scan bodies). Anything nested inside one —
+# or inside a function that is literally handed to jax.jit / jax.vmap /
+# jax.lax.scan / jax.shard_map — is "traced scope".
+TRACED_NAMES = frozenset({
+    "round_fn", "round_body", "local_train", "shard_body", "multi_fn",
+    "eval_fn", "step_body", "epoch_body", "epoch_fn", "sub_round",
+    "body", "vmapped", "scanned",
+})
+
+# Callables whose function-valued arguments end up traced.
+TRACING_WRAPPERS = frozenset({
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.lax.scan", "jax.lax.cond",
+    "jax.lax.while_loop", "jax.shard_map", "jax.lax.map",
+})
+
+HOST_SYNC_CALLS = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "print", "float",
+})
+
+NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    scope: str = ""  # dotted enclosing-def chain, for stable fingerprints
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity — baseline entries survive edits
+        elsewhere in the file."""
+        return f"{self.path}::{self.rule}::{self.scope}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+class FileContext:
+    """One parsed file plus the cross-file helper index lint.py builds."""
+
+    def __init__(
+        self,
+        path: str,
+        tree: ast.Module,
+        source: str,
+        resolve_helper: Optional[Callable[[str], Optional[ast.FunctionDef]]] = None,
+    ):
+        self.path = path
+        self.tree = tree
+        self.source = source
+        # name -> module-level FunctionDef (same module or followed import)
+        self.resolve_helper = resolve_helper or (lambda name: None)
+        _attach_parents(tree)
+
+    def in_dirs(self, dirs: Iterable[str]) -> bool:
+        parts = self.path.replace("\\", "/").split("/")
+        return any(d in parts for d in dirs)
+
+
+# --------------------------------------------------------------------------
+# AST utilities
+# --------------------------------------------------------------------------
+
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._fedlint_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_fedlint_parent", None)
+
+
+def ancestors(node: ast.AST):
+    p = parent(node)
+    while p is not None:
+        yield p
+        p = parent(p)
+
+
+def qual_name(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain ('jax.lax.scan'), else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def scope_chain(node: ast.AST) -> str:
+    names = [
+        a.name
+        for a in ancestors(node)
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+    ]
+    return ".".join(reversed(names))
+
+
+def _is_get_or_build(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute) and call.func.attr == "get_or_build"
+    )
+
+
+def _is_wrap_uncached(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute) and call.func.attr == "wrap_uncached"
+    )
+
+
+# --------------------------------------------------------------------------
+# Rule registry
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    check: Callable[[FileContext], List[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(name: str, doc: str):
+    def deco(fn):
+        RULES[name] = Rule(name=name, doc=doc, check=fn)
+        return fn
+
+    return deco
+
+
+# --------------------------------------------------------------------------
+# uncached-jit
+# --------------------------------------------------------------------------
+
+
+def _name_feeds_get_or_build(name: str, scope: ast.AST) -> bool:
+    """True when ``name`` appears as an argument of a get_or_build call
+    anywhere in ``scope`` — the assigned builder eventually reaches the
+    ProgramCache."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) and _is_get_or_build(n):
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                if isinstance(a, ast.Name) and a.id == name:
+                    return True
+    return False
+
+
+def _jit_is_blessed(call: ast.Call) -> bool:
+    """A jax.jit call is fine when its result provably reaches the
+    ProgramCache: inside a builder (``def builder`` / a function or
+    lambda assigned to a name that feeds a get_or_build call / the
+    builder argument of get_or_build) or as a direct wrap_uncached arg."""
+    prev: ast.AST = call
+    for anc in ancestors(call):
+        if isinstance(anc, ast.FunctionDef) and (
+            anc.name == "builder"
+            or _name_feeds_get_or_build(anc.name, _lexical_scope(anc))
+        ):
+            return True
+        if isinstance(anc, ast.Lambda):
+            lam_parent = parent(anc)
+            if isinstance(lam_parent, ast.Call) and _is_get_or_build(lam_parent):
+                return True
+            if isinstance(lam_parent, ast.Assign):
+                for t in lam_parent.targets:
+                    if isinstance(t, ast.Name) and (
+                        t.id == "builder"
+                        or _name_feeds_get_or_build(t.id, _lexical_scope(anc))
+                    ):
+                        return True
+        if isinstance(anc, ast.Call) and _is_wrap_uncached(anc) and prev in anc.args:
+            return True
+        prev = anc
+    return False
+
+
+@register(
+    "uncached-jit",
+    "bare jax.jit in algorithms/ or parallel/ bypassing the ProgramCache",
+)
+def check_uncached_jit(ctx: FileContext) -> List[Finding]:
+    if not ctx.in_dirs(JIT_RULE_DIRS):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        p = parent(node)
+        is_deco = (
+            isinstance(node, ast.Attribute)
+            and qual_name(node) == "jax.jit"
+            and isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node in p.decorator_list
+        )
+        if is_deco:
+            # bare decorator form: @jax.jit
+            out.append(
+                Finding(
+                    "uncached-jit", ctx.path, node.lineno, node.col_offset,
+                    "@jax.jit-decorated function bypasses the ProgramCache "
+                    "(dedup + AOT warmup); route it through "
+                    "get_program_cache().get_or_build/wrap_uncached",
+                    scope=scope_chain(node),
+                )
+            )
+            continue
+        if not (
+            isinstance(node, ast.Call) and qual_name(node.func) == "jax.jit"
+        ):
+            continue
+        if _jit_is_blessed(node):
+            continue
+        out.append(
+            Finding(
+                "uncached-jit", ctx.path, node.lineno, node.col_offset,
+                "bare jax.jit bypasses the ProgramCache: --warmup compiles "
+                "into a throwaway object and dispatch recompiles (the "
+                "qfedavg/sharded-fednova bug class); use "
+                "get_program_cache().get_or_build (describable program) or "
+                ".wrap_uncached (opaque closure)",
+                scope=scope_chain(node),
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# baked-constant
+# --------------------------------------------------------------------------
+
+
+def _config_paths(node: ast.AST, roots: Tuple[str, ...]) -> List[Tuple[str, ast.AST]]:
+    """All attribute chains under ``node`` rooted at a config name, as
+    (dotted path, innermost node) — e.g. ('config.server.server_lr', n).
+    Only the LONGEST chain per attribute expression is reported."""
+    out: List[Tuple[str, ast.AST]] = []
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Attribute):
+            continue
+        p = parent(n)
+        if isinstance(p, ast.Attribute) and p.value is n:
+            continue  # inner link of a longer chain
+        q = qual_name(n)
+        if q is None:
+            continue
+        root = q.split(".", 1)[0]
+        if root in roots:
+            out.append((q, n))
+    return out
+
+
+def _enclosing_functions(node: ast.AST) -> List[ast.AST]:
+    return [
+        a
+        for a in ancestors(node)
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+    ]
+
+
+class _ScopeIndex:
+    """Name -> definition lookup across the lexical scopes enclosing a
+    get_or_build call: local ``x = expr`` assignments, ``self.x = expr``
+    assignments, and nested ``def x``."""
+
+    def __init__(self, scopes: List[ast.AST]):
+        self.assigns: Dict[str, ast.AST] = {}
+        self.defs: Dict[str, ast.AST] = {}
+        for scope in reversed(scopes):  # innermost scope wins
+            body = getattr(scope, "body", [])
+            if isinstance(body, ast.AST):  # Lambda body is an expression
+                continue
+            for stmt in body:
+                self._index_stmt(stmt)
+
+    def _index_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.defs[stmt.name] = stmt
+            return
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    self.assigns[t.id] = stmt.value
+                elif isinstance(t, ast.Attribute) and (
+                    isinstance(t.value, ast.Name) and t.value.id == "self"
+                ):
+                    self.assigns[f"self.{t.attr}"] = stmt.value
+                elif isinstance(t, ast.Tuple):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            # tuple unpack: map every name to the full RHS
+                            self.assigns[el.id] = stmt.value
+        elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With, ast.Try)):
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.stmt):
+                    self._index_stmt(sub)
+
+
+def _collect_reachable_config_paths(
+    seed: ast.AST,
+    index: _ScopeIndex,
+    ctx: FileContext,
+    roots: Tuple[str, ...] = CONFIG_ROOTS,
+    _visited: Optional[Set[int]] = None,
+    _depth: int = 0,
+) -> List[Tuple[str, ast.AST]]:
+    """Config attribute paths reachable from ``seed`` (a builder
+    expression): direct ``config.a.b`` reads, reads inside local
+    functions the builder references, and — one level deep — reads
+    inside module-level helpers called with the bare config object."""
+    if _visited is None:
+        _visited = set()
+    if id(seed) in _visited or _depth > 6:
+        return []
+    _visited.add(id(seed))
+    out = list(_config_paths(seed, roots))
+    for n in ast.walk(seed):
+        # follow names to their local definitions (defs and assignments)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            target = index.defs.get(n.id) or index.assigns.get(n.id)
+            if target is not None and id(target) not in _visited:
+                out.extend(
+                    _collect_reachable_config_paths(
+                        target, index, ctx, roots, _visited, _depth + 1
+                    )
+                )
+        if isinstance(n, ast.Attribute) and qual_name(n) and qual_name(n).startswith("self."):
+            target = index.assigns.get(qual_name(n))
+            if target is not None and id(target) not in _visited:
+                out.extend(
+                    _collect_reachable_config_paths(
+                        target, index, ctx, roots, _visited, _depth + 1
+                    )
+                )
+        # follow helper calls that receive the bare config object —
+        # recursively, so a factory -> helper -> helper chain (scaffold's
+        # cohort body, ditto's fedavg body) is still audited
+        if isinstance(n, ast.Call):
+            params_hit: List[str] = []
+            callee = qual_name(n.func)
+            helper = ctx.resolve_helper(callee) if callee else None
+            if helper is None or id(helper) in _visited:
+                continue
+            helper_params = [a.arg for a in helper.args.args]
+            for i, a in enumerate(n.args):
+                if isinstance(a, ast.Name) and a.id in roots and i < len(helper_params):
+                    params_hit.append(helper_params[i])
+            for kw in n.keywords:
+                if (
+                    isinstance(kw.value, ast.Name)
+                    and kw.value.id in roots
+                    and kw.arg
+                ):
+                    params_hit.append(kw.arg)
+            if not params_hit:
+                continue
+            sub = _collect_reachable_config_paths(
+                helper,
+                _ScopeIndex([helper]),
+                ctx,
+                tuple(params_hit),
+                _visited,
+                _depth + 1,
+            )
+            for path, _pn in sub:
+                # rebase the helper's param name onto 'config' and report
+                # at the CALL site — the line the factory author can fix
+                rest = path.split(".", 1)
+                out.append(
+                    ("config" + ("." + rest[1] if len(rest) > 1 else ""), n)
+                )
+    return out
+
+
+def _covered_paths(
+    keydict: ast.Dict, index: _ScopeIndex, roots: Tuple[str, ...]
+) -> Set[str]:
+    """Config paths the digest covers: paths appearing anywhere in the
+    key dict's value expressions, plus the source paths of any local
+    names used as digest values (e.g. ``"mode": mode`` where
+    ``mode = ... config.fed.client_parallelism ...``)."""
+    covered: Set[str] = set()
+    worklist: List[ast.AST] = list(keydict.values)
+    visited: Set[int] = set()
+    while worklist:
+        node = worklist.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        for path, _ in _config_paths(node, roots):
+            covered.add(_rebase(path))
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                src = index.assigns.get(n.id)
+                if src is not None and id(src) not in visited:
+                    worklist.append(src)
+    return covered
+
+
+def _rebase(path: str) -> str:
+    """Normalize any config root alias ('cfg.train.lr') to 'config...'."""
+    parts = path.split(".", 1)
+    return "config" + ("." + parts[1] if len(parts) > 1 else "")
+
+
+def _is_covered(path: str, covered: Set[str]) -> bool:
+    p = _rebase(path)
+    if p == "config":
+        # the whole config object in the digest covers everything
+        return "config" in covered
+    while True:
+        if p in covered or "config" in covered:
+            return True
+        if "." not in p:
+            return False
+        p = p.rsplit(".", 1)[0]
+
+
+@register(
+    "baked-constant",
+    "config value baked into a cached program but absent from its digest",
+)
+def check_baked_constant(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_get_or_build(node)):
+            continue
+        if len(node.args) < 3:
+            continue
+        keydict, builder = node.args[1], node.args[2]
+        scopes = _enclosing_functions(node)
+        index = _ScopeIndex(scopes)
+        if not isinstance(keydict, ast.Dict):
+            out.append(
+                Finding(
+                    "baked-constant", ctx.path, node.lineno, node.col_offset,
+                    "get_or_build key fields are not a dict literal — "
+                    "fedlint cannot verify digest completeness",
+                    scope=scope_chain(node),
+                )
+            )
+            continue
+        covered = _covered_paths(keydict, index, CONFIG_ROOTS)
+        seen: Set[str] = set()
+        for path, ref in _collect_reachable_config_paths(builder, index, ctx):
+            rp = _rebase(path)
+            if rp in seen or _is_covered(rp, covered):
+                continue
+            seen.add(rp)
+            out.append(
+                Finding(
+                    "baked-constant", ctx.path,
+                    getattr(ref, "lineno", node.lineno),
+                    getattr(ref, "col_offset", node.col_offset),
+                    f"{rp} is reachable from this factory's builder (baked "
+                    "into the traced program as a constant) but no digest "
+                    "key field covers it — a digest collision across "
+                    "configs differing only in this value would reuse the "
+                    "wrong program (the SCAFFOLD eta_g bug class)",
+                    scope=scope_chain(node),
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# traced-scope detection (shared by host-sync and nondet-in-trace)
+# --------------------------------------------------------------------------
+
+
+def _lexical_scope(node: ast.AST) -> ast.AST:
+    """The scope a def lives in: nearest enclosing function, class body,
+    or the module. Used to resolve ``jax.jit(f)`` references lexically —
+    a method that merely SHARES a name with a jitted local function must
+    not be marked traced."""
+    for a in ancestors(node):
+        if isinstance(
+            a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef, ast.Module)
+        ):
+            return a
+    return node
+
+
+def _traced_roots(tree: ast.Module) -> Set[int]:
+    """ids of FunctionDef/Lambda nodes whose bodies are traced: decorated
+    with / passed to a tracing wrapper, or named like a round/train/eval
+    body (this repo's convention)."""
+    roots: Set[int] = set()
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            if node.name in TRACED_NAMES:
+                roots.add(id(node))
+            for deco in node.decorator_list:
+                dq = qual_name(deco if not isinstance(deco, ast.Call) else deco.func)
+                if dq in TRACING_WRAPPERS:
+                    roots.add(id(node))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = qual_name(node.func)
+        if q not in TRACING_WRAPPERS:
+            continue
+        # scopes visible from this call: the module and every enclosing
+        # function — a name reference can only resolve into one of these
+        visible = {id(tree)} | {
+            id(a)
+            for a in ancestors(node)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        }
+        for arg in node.args:
+            if isinstance(arg, ast.Lambda):
+                roots.add(id(arg))
+            elif isinstance(arg, ast.Name):
+                for d in defs.get(arg.id, []):
+                    if id(_lexical_scope(d)) in visible:
+                        roots.add(id(d))
+    return roots
+
+
+def _in_traced_scope(node: ast.AST, roots: Set[int]) -> bool:
+    return any(id(a) in roots for a in ancestors(node))
+
+
+@register(
+    "host-sync",
+    "device->host synchronization inside a traced round/train/eval body",
+)
+def check_host_sync(ctx: FileContext) -> List[Finding]:
+    if not ctx.in_dirs(HOT_DIRS):
+        return []
+    roots = _traced_roots(ctx.tree)
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = qual_name(node.func)
+        bad = None
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
+            bad = ".item()"
+        elif q in HOST_SYNC_CALLS:
+            if q == "float" and (
+                not node.args or isinstance(node.args[0], ast.Constant)
+            ):
+                continue  # float literal conversions are host-side sugar
+            bad = q
+        if bad is None or not _in_traced_scope(node, roots):
+            continue
+        out.append(
+            Finding(
+                "host-sync", ctx.path, node.lineno, node.col_offset,
+                f"{bad} inside a traced body forces a device->host sync "
+                "(or fails at trace time): it serializes the async "
+                "dispatch pipeline — keep host reads outside the jitted "
+                "round/train/eval program",
+                scope=scope_chain(node),
+            )
+        )
+    return out
+
+
+@register(
+    "nondet-in-trace",
+    "wall-clock or host RNG inside traced code (baked at trace time)",
+)
+def check_nondet(ctx: FileContext) -> List[Finding]:
+    if not ctx.in_dirs(HOT_DIRS):
+        return []
+    roots = _traced_roots(ctx.tree)
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = qual_name(node.func)
+        if q is None or not any(q.startswith(p) for p in NONDET_PREFIXES):
+            continue
+        if not _in_traced_scope(node, roots):
+            continue
+        out.append(
+            Finding(
+                "nondet-in-trace", ctx.path, node.lineno, node.col_offset,
+                f"{q} executes at TRACE time inside a jitted body: the "
+                "drawn value is baked into the compiled program as a "
+                "constant ('random once per compile'), and results silently "
+                "depend on cache state — use jax.random with explicit keys "
+                "or hoist the value to a program input",
+                scope=scope_chain(node),
+            )
+        )
+    return out
+
+
+@register(
+    "repr-in-digest",
+    "repr()/id()-derived value flowing into ProgramCache digest fields",
+)
+def check_repr_in_digest(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("repr", "id")
+        ):
+            continue
+        in_scope = False
+        prev: ast.AST = node
+        for anc in ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+                anc.name.endswith("_fingerprint")
+            ):
+                in_scope = True
+                break
+            if (
+                isinstance(anc, ast.Call)
+                and _is_get_or_build(anc)
+                and len(anc.args) >= 2
+                and prev is anc.args[1]
+            ):
+                in_scope = True
+                break
+            prev = anc
+        if not in_scope:
+            continue
+        fn = node.func.id
+        out.append(
+            Finding(
+                "repr-in-digest", ctx.path, node.lineno, node.col_offset,
+                f"{fn}()-derived value flows into program-digest fields: "
+                + (
+                    "id() is unique per object, never stable — the digest "
+                    "would split identical programs and can collide after "
+                    "address reuse"
+                    if fn == "id"
+                    else "repr is only guaranteed stable within one process "
+                    "— fine for the in-process ProgramCache, poison for any "
+                    "cross-process digest use (serialized-executable cache)"
+                ),
+                scope=scope_chain(node),
+            )
+        )
+    return out

@@ -1,0 +1,206 @@
+"""Runtime recompile sentinel — static analysis can only prove a hazard
+CLASS absent; this watches the live process for the symptom itself:
+unexpected XLA compilation.
+
+Two event streams feed it:
+
+- **backend compiles** — jax's ``/jax/core/compile/backend_compile_duration``
+  monitoring events, one per actual XLA compilation in the process
+  (including lazy recompiles on a new shape class, which the
+  ProgramCache never sees). One process-wide listener is installed on
+  first use and increments a global counter plus the
+  ``fedml_compile_backend_compiles`` Prometheus gauge; sentinels
+  snapshot-diff that counter, so N nested sentinels cost one listener.
+- **ProgramCache events** — build/hit/bypass/aot_compile from
+  :class:`fedml_tpu.compile.ProgramCache` listeners, recorded with their
+  program labels so a budget violation names WHICH programs compiled.
+
+``--recompile_budget N`` on the CLI runs the whole federation under a
+sentinel and raises :class:`RecompileBudgetExceeded` at the end when
+more than N backend compiles happened — the per-run compile-storm tripwire
+(a cache-key instability that recompiles every round burns exactly the
+budget this catches). The pytest marker ``@pytest.mark.recompile_budget(N)``
+plus the ``recompile_sentinel`` fixture (tests/conftest.py) give tests
+the same tripwire. Budgets are deliberately coarse upper bounds: tiny
+utility programs (``jnp.ones``, dtype converts) also compile, so a
+budget asserts "no storm", not an exact program count."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional, Tuple
+
+_BACKEND_EVENT_SUFFIX = "backend_compile_duration"
+
+_lock = threading.Lock()
+_backend_compiles = 0
+_listener_state = {"installed": None}  # None = not attempted
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """More XLA compiles happened than the declared budget allows."""
+
+
+def _on_jax_event(name: str, secs: float, **kw) -> None:
+    global _backend_compiles
+    if not name.endswith(_BACKEND_EVENT_SUFFIX):
+        return
+    with _lock:
+        _backend_compiles += 1
+        total = _backend_compiles
+    try:
+        from fedml_tpu.telemetry import get_registry
+
+        get_registry().gauge(
+            "fedml_compile_backend_compiles",
+            "XLA backend compilations observed in this process",
+        ).set(total)
+    except Exception:  # noqa: BLE001 — telemetry must not break compiles
+        pass
+
+
+def ensure_backend_listener() -> bool:
+    """Install the process-wide jax.monitoring listener (idempotent).
+    Returns False when this jax has no monitoring API — the sentinel
+    then degrades to ProgramCache-event counting."""
+    if _listener_state["installed"] is not None:
+        return _listener_state["installed"]
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_on_jax_event)
+        _listener_state["installed"] = True
+    except Exception:  # noqa: BLE001 — jaxlib without monitoring support
+        _listener_state["installed"] = False
+    return _listener_state["installed"]
+
+
+def backend_compile_count() -> int:
+    """Process-lifetime XLA backend compile count (0 until the listener
+    is installed by the first sentinel)."""
+    with _lock:
+        return _backend_compiles
+
+
+class RecompileSentinel:
+    """Snapshot-diff watcher over a region of execution.
+
+    >>> s = RecompileSentinel(budget=8, label="parity").start()
+    >>> ...  # run rounds
+    >>> s.stop(); s.check()   # raises RecompileBudgetExceeded on a storm
+    """
+
+    def __init__(self, budget: Optional[int] = None, label: str = "run"):
+        self.budget = budget if budget is None else int(budget)
+        self.label = label
+        self._start_backend = 0
+        self._stop_backend: Optional[int] = None
+        self._events: List[Tuple[str, str]] = []  # (kind, program label)
+        self._active = False
+        self._have_monitoring = False
+        self._cache = None  # the ProgramCache this sentinel subscribed to
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "RecompileSentinel":
+        if self._active:
+            return self
+        self._have_monitoring = ensure_backend_listener()
+        self._start_backend = backend_compile_count()
+        from fedml_tpu.compile import get_program_cache
+
+        # remember WHICH cache we subscribed to: a use_program_cache swap
+        # between start and stop must not leak the listener
+        self._cache = get_program_cache()
+        self._cache.add_listener(self._on_cache_event)
+        self._active = True
+        return self
+
+    def stop(self) -> "RecompileSentinel":
+        if not self._active:
+            return self
+        self._stop_backend = backend_compile_count()
+        if self._cache is not None:
+            self._cache.remove_listener(self._on_cache_event)
+            self._cache = None
+        self._active = False
+        return self
+
+    def __enter__(self) -> "RecompileSentinel":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _on_cache_event(self, kind: str, label: str, digest) -> None:
+        if kind in ("build", "bypass", "aot_compile"):
+            self._events.append((kind, label))
+
+    # -- accounting --------------------------------------------------------
+
+    def recompiles(self) -> int:
+        """Backend compiles observed since start() (falls back to
+        ProgramCache build/aot events when jax.monitoring is absent —
+        NOT bypass events: wrap_uncached wrappers compile nothing, so
+        they must not consume the budget)."""
+        if self._have_monitoring:
+            end = (
+                self._stop_backend
+                if self._stop_backend is not None
+                else backend_compile_count()
+            )
+            return end - self._start_backend
+        return sum(1 for k, _ in self._events if k in ("build", "aot_compile"))
+
+    def events(self) -> List[Tuple[str, str]]:
+        return list(self._events)
+
+    def exceeded(self) -> bool:
+        return self.budget is not None and self.recompiles() > self.budget
+
+    def describe(self) -> str:
+        n = self.recompiles()
+        labels = ", ".join(
+            f"{kind}:{label}" for kind, label in self._events[:12]
+        ) or "no ProgramCache builds — lazy shape-class recompiles"
+        budget = "∞" if self.budget is None else str(self.budget)
+        return (
+            f"recompile sentinel [{self.label}]: {n} XLA compile(s) "
+            f"(budget {budget}); program-cache events: {labels}"
+        )
+
+    def check(self) -> None:
+        if self.exceeded():
+            raise RecompileBudgetExceeded(self.describe())
+
+    def summary_row(self) -> dict:
+        """Flat MetricsLogger row — summary.json stays the CI oracle for
+        the recompile budget, not just the raised exception."""
+        row = {
+            "compile/recompiles": self.recompiles(),
+            "compile/program_builds": sum(
+                1 for k, _ in self._events if k == "build"
+            ),
+            "compile/program_bypasses": sum(
+                1 for k, _ in self._events if k == "bypass"
+            ),
+        }
+        if self.budget is not None:
+            row["compile/recompile_budget"] = self.budget
+        return row
+
+
+@contextlib.contextmanager
+def watch_recompiles(budget: Optional[int] = None, label: str = "region"):
+    """Context-manager form: stop + budget-check on clean exit (an
+    exception from the body propagates untouched — the sentinel never
+    masks the real failure)."""
+    sentinel = RecompileSentinel(budget=budget, label=label).start()
+    try:
+        yield sentinel
+    except BaseException:
+        sentinel.stop()
+        raise
+    sentinel.stop()
+    sentinel.check()

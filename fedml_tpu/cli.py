@@ -250,6 +250,14 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
                    "quarantine, advisory file lock). Pass a fresh "
                    "directory for a per-run cache; cache hit/miss/"
                    "quarantine counts land in summary.json (compile/*)")
+@click.option("--recompile_budget", type=int, default=None,
+              help="Fail the run when more than this many XLA compiles "
+                   "happen (fedml_tpu/analysis/sentinel.py) — the tripwire "
+                   "for cache-key instabilities that silently recompile "
+                   "every round. Counts EVERY backend compile incl. small "
+                   "utility programs, so pick a coarse upper bound; the "
+                   "observed count always lands in summary.json "
+                   "(compile/recompiles). Off by default")
 @click.option("--rank", type=int, default=None,
               help="runtime=grpc: this process's rank (0 = server, 1..K = "
                    "clients; ref main_fedavg_rpc.py --fl_worker_index)")
@@ -389,21 +397,41 @@ def _validate_compile(config, opt) -> None:
         )
 
 
-def _log_compile(logger, baseline, restore=None) -> None:
+def _log_compile(logger, baseline, restore=None, sentinel=None) -> None:
     """Forward the run's compile-cache activity (program dedup hits/misses
     + hardened persistent-layer counters) into summary.json — the CI
     oracle the ci.sh warmup smoke asserts on — then reinstate the
     pre-run persistent-cache binding (the row must be logged FIRST: it
     reads the run's installed cache). Called from the run() finally
     blocks so a crashed run can't leave its per-run cache installed in
-    a long-lived process; the restore itself is exception-proof."""
+    a long-lived process; the restore itself is exception-proof. A
+    --recompile_budget sentinel is stopped and its counters logged here
+    (observability first — the budget CHECK happens later, outside the
+    finally, so the raise can't mask the run's own failure)."""
     from fedml_tpu.compile import compile_summary_row
 
     try:
+        if sentinel is not None:
+            sentinel.stop()
+            logger.log(sentinel.summary_row())
         logger.log(compile_summary_row(baseline))
     finally:
         if restore is not None:
             restore()
+
+
+def _check_sentinel(sentinel) -> None:
+    """Enforce --recompile_budget after the run's telemetry has flushed:
+    exceeding the budget fails the CLI run loudly (exit code 1) with the
+    per-program compile events in the message."""
+    if sentinel is None:
+        return
+    from fedml_tpu.analysis.sentinel import RecompileBudgetExceeded
+
+    try:
+        sentinel.check()
+    except RecompileBudgetExceeded as e:
+        raise click.ClickException(str(e))
 
 
 def _checked_buffer_k(opt) -> int:
@@ -477,6 +505,7 @@ def build_config(opt) -> RunConfig:
         compile=CompileConfig(
             warmup=opt.get("warmup", False),
             cache_dir=str(opt.get("compile_cache_dir") or ""),
+            recompile_budget=opt.get("recompile_budget"),
         ),
         model=opt["model"],
         seed=opt["seed"],
@@ -499,6 +528,15 @@ def _telemetry_start(opt):
     state = {"exporter": None, "comm_baseline": get_comm_meter().snapshot()}
     if opt.get("prom_port") is not None:
         from fedml_tpu.telemetry import PrometheusExporter
+
+        # compile observability (satellite of fedml_tpu/analysis/): the
+        # ProgramCache publishes its hit/miss/bypass gauges on every
+        # event; the XLA backend-compile gauge needs the process-wide
+        # monitoring listener installed — do it whenever metrics are
+        # actually exported, not only under --recompile_budget
+        from fedml_tpu.analysis.sentinel import ensure_backend_listener
+
+        ensure_backend_listener()
 
         state["exporter"] = PrometheusExporter(port=opt["prom_port"]).start()
         click.echo(
@@ -615,6 +653,18 @@ def run(**opt):
     # long-lived process (CliRunner tests, sweeps) reports ITS cache
     # activity, not the process's lifetime totals
     compile_baseline = compile_snapshot()
+    sentinel = None
+    if config.compile.recompile_budget is not None:
+        # --recompile_budget: watch every XLA backend compile from here
+        # to the end of the run (fedml_tpu/analysis/sentinel.py); the
+        # check fires after telemetry flushes, via _check_sentinel
+        from fedml_tpu.analysis.sentinel import RecompileSentinel
+
+        if config.compile.recompile_budget < 0:
+            raise click.UsageError("--recompile_budget must be >= 0")
+        sentinel = RecompileSentinel(
+            budget=config.compile.recompile_budget, label="cli"
+        ).start()
     try:
         if opt["runtime"] in ("vmap", "mesh"):
             if config.comm.compression != "none":
@@ -752,7 +802,10 @@ def run(**opt):
                 _telemetry_finish(telemetry, opt, logger, health=grpc_health)
             finally:
                 _telemetry_finish(telemetry, opt, logger)
-                _log_compile(logger, compile_baseline, restore_compile_cache)
+                _log_compile(
+                    logger, compile_baseline, restore_compile_cache, sentinel
+                )
+            _check_sentinel(sentinel)
             logger.close()
             click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
             return None
@@ -785,7 +838,10 @@ def run(**opt):
                 # long-tail drivers have no per-client health registry; the
                 # trace/comm totals still flush (on success AND on a crash)
                 _telemetry_finish(telemetry, opt, logger)
-                _log_compile(logger, compile_baseline, restore_compile_cache)
+                _log_compile(
+                    logger, compile_baseline, restore_compile_cache, sentinel
+                )
+            _check_sentinel(sentinel)
             logger.close()
             click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
             return None
@@ -854,7 +910,10 @@ def run(**opt):
             # the compile row + cache restore ride the same backstop so a
             # crashed run can't leave its per-run cache installed
             _telemetry_finish(telemetry, opt, logger)
-            _log_compile(logger, compile_baseline, restore_compile_cache)
+            _log_compile(
+                logger, compile_baseline, restore_compile_cache, sentinel
+            )
+        _check_sentinel(sentinel)
         logger.close()
         click.echo(json.dumps({k: _jsonable(v) for k, v in (final or {}).items()}))
         return api
@@ -867,6 +926,8 @@ def run(**opt):
         # _log_compile are unaffected by the second call.
         if restore_compile_cache is not None:
             restore_compile_cache()
+        if sentinel is not None:
+            sentinel.stop()  # idempotent; drops the cache listener
         raise
 
 

@@ -41,6 +41,7 @@ from fedml_tpu.compile.program_cache import (
     ProgramCache,
     get_program_cache,
     hooks_cacheable,
+    use_program_cache,
 )
 from fedml_tpu.compile.warmup import warmup_api, warmup_local_train
 
@@ -60,6 +61,7 @@ __all__ = [
     "mesh_fingerprint",
     "model_fingerprint",
     "program_digest",
+    "use_program_cache",
     "warmup_api",
     "warmup_local_train",
 ]

@@ -124,14 +124,14 @@ def model_fingerprint(model) -> Dict[str, Any]:
     try:
         input_dtype = str(np.dtype(getattr(model, "input_dtype", np.float32)))
     except TypeError:
-        input_dtype = repr(getattr(model, "input_dtype", None))
+        input_dtype = repr(getattr(model, "input_dtype", None))  # fedlint: disable=repr-in-digest -- non-dtype fallback; in-process stability is the documented ProgramCache contract
     return {
         "name": getattr(model, "name", type(model).__name__),
         "module": (
             [
                 type(module).__module__,
                 type(module).__qualname__,
-                repr(module),
+                repr(module),  # fedlint: disable=repr-in-digest -- flax frozen-dataclass repr pins hyperparams; in-process-only stability is documented above
             ]
             if module is not None
             else None

@@ -27,12 +27,13 @@ executable is built from the exact same lowering either way."""
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from fedml_tpu.compile.digest import call_signature, program_digest
-from fedml_tpu.telemetry import get_tracer
+from fedml_tpu.telemetry import get_registry, get_tracer
 
 
 class CachedProgram:
@@ -50,10 +51,17 @@ class CachedProgram:
         label: str,
         digest: Optional[str] = None,
         cache: Optional["ProgramCache"] = None,
+        key_fields: Optional[Dict[str, Any]] = None,
     ):
         self.fn = fn
         self.label = label
         self.digest = digest
+        # The exact key_fields dict this program was registered under —
+        # introspection surface for the digest-completeness fuzzer
+        # (fedml_tpu/analysis/digest_audit.py recomputes digests with
+        # fields deliberately dropped to prove the audit catches the
+        # scaffold eta_g bug class). None for bypassed programs.
+        self.key_fields = key_fields
         self._cache = cache
         self._aot: Dict[tuple, Any] = {}
         self._aot_stats: Dict[tuple, dict] = {}
@@ -118,7 +126,7 @@ class CachedProgram:
         }
         self._aot_stats[sig] = st
         if self._cache is not None:
-            self._cache._note_compile_time(dt)
+            self._cache._note_compile_time(dt, label=self.label, digest=self.digest)
         return dict(st)
 
 
@@ -133,6 +141,54 @@ class ProgramCache:
         self.misses = 0
         self.bypassed = 0
         self.compile_s = 0.0  # accumulated measured (AOT) compile seconds
+        # compile-event listeners (fedml_tpu/analysis/sentinel.py): called
+        # OUTSIDE the lock as listener(kind, label, digest) with kind in
+        # {"build", "hit", "bypass", "aot_compile"} — "build" = a new jit
+        # object was constructed (a cache miss), "hit" = a dedup hit,
+        # "bypass" = an uncacheable wrap, "aot_compile" = a warmup
+        # actually compiled an executable.
+        self._listeners: List[Callable[[str, str, Optional[str]], None]] = []
+
+    def add_listener(self, fn: Callable[[str, str, Optional[str]], None]) -> None:
+        """Subscribe to compile events (see ``_listeners``). Listeners
+        must be fast and must not raise — they run on the caller's
+        thread inside factory construction paths."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _emit(self, kind: str, label: str, digest: Optional[str]) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(kind, label, digest)
+            except Exception:  # noqa: BLE001 — observers never break a build
+                import logging
+
+                logging.exception("program-cache listener failed")
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        """Mirror the cache counters into the Prometheus registry
+        (telemetry/metrics.py) so the recompile picture is scrapeable
+        live, not only visible in the end-of-run summary.json row."""
+        try:
+            snap = self.stats()
+            reg = get_registry()
+            for key in ("hits", "misses", "bypassed", "programs"):
+                reg.gauge(
+                    f"fedml_compile_cache_{key}",
+                    "ProgramCache activity (fedml_tpu/compile/)",
+                ).set(snap[key])
+        except Exception:  # noqa: BLE001 — telemetry must not break builds
+            pass
 
     def get_or_build(
         self, label: str, key_fields: Dict[str, Any], builder: Callable[[], Callable]
@@ -147,19 +203,26 @@ class ProgramCache:
             prog = self._programs.get(digest)
             if prog is not None:
                 self.hits += 1
-                return prog
+        if prog is not None:
+            self._emit("hit", label, digest)
+            return prog
         # build outside the lock: builders only wrap jax.jit (compilation
         # itself stays lazy), so a racing duplicate build is cheap and the
         # second one below is discarded
         fn = builder()
+        built = False
         with self._lock:
             prog = self._programs.get(digest)
             if prog is None:
-                prog = CachedProgram(fn, label, digest=digest, cache=self)
+                prog = CachedProgram(
+                    fn, label, digest=digest, cache=self, key_fields=key_fields
+                )
                 self._programs[digest] = prog
                 self.misses += 1
+                built = True
             else:
                 self.hits += 1
+        self._emit("build" if built else "hit", label, digest)
         return prog
 
     def wrap_uncached(self, label: str, fn: Callable) -> CachedProgram:
@@ -167,11 +230,21 @@ class ProgramCache:
         closures), still counting it and giving it the warmup surface."""
         with self._lock:
             self.bypassed += 1
+        self._emit("bypass", label, None)
         return CachedProgram(fn, label, cache=self)
 
-    def _note_compile_time(self, dt: float) -> None:
+    def iter_programs(self) -> List[CachedProgram]:
+        """Snapshot of the registered (deduped) programs — the digest
+        fuzzer's enumeration surface."""
+        with self._lock:
+            return list(self._programs.values())
+
+    def _note_compile_time(
+        self, dt: float, label: str = "?", digest: Optional[str] = None
+    ) -> None:
         with self._lock:
             self.compile_s += float(dt)
+        self._emit("aot_compile", label, digest)
 
     def stats(self) -> dict:
         with self._lock:
@@ -220,3 +293,21 @@ def get_program_cache() -> ProgramCache:
     session-scoped ``program_cache`` pytest fixture exposes this same
     object, so test modules share each other's compiles)."""
     return _GLOBAL
+
+
+@contextlib.contextmanager
+def use_program_cache(cache: ProgramCache):
+    """Temporarily swap the process-wide cache for ``cache`` (restored on
+    exit, even on error). The digest-completeness fuzzer
+    (fedml_tpu/analysis/digest_audit.py) builds each perturbed config's
+    program in a FRESH cache so colliding digests cannot silently hand
+    back the base program instead of invoking the factory's builder —
+    the collision is exactly what the audit must observe. Not
+    thread-safe: meant for single-threaded audit/test harnesses only."""
+    global _GLOBAL
+    prev = _GLOBAL
+    _GLOBAL = cache
+    try:
+        yield cache
+    finally:
+        _GLOBAL = prev

@@ -205,6 +205,13 @@ class CompileConfig:
     # default matches tests/conftest.py: aggressive thresholds (0.3-0.5 s)
     # corrupt the heap on this jaxlib (ROADMAP "compile-cache hygiene").
     min_compile_time_s: float = 2.0
+    # Recompile budget (fedml_tpu/analysis/sentinel.py): fail the run when
+    # more than this many XLA backend compiles happen — the tripwire for
+    # cache-key instabilities that silently recompile every round. Counts
+    # EVERY backend compile (including small utility programs), so budgets
+    # are coarse upper bounds asserting "no compile storm", not exact
+    # program counts. None = unlimited (no sentinel).
+    recompile_budget: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -128,7 +128,35 @@ def make_hierarchical_sharded_round(
         in_specs=(P(), spec, spec, spec, spec, spec),
         out_specs=(P(), P()),
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    # program dedup (fedml_tpu/compile/): fedlint uncached-jit caught this
+    # factory returning a bare jit object. The sub-round count R and the
+    # group/client axis sizes are SHAPE classes (they ride in on the
+    # [R, G, C, ...] batch), not program constants — the mesh fingerprint
+    # pins the topology. An opaque local_train_fn bypasses the registry.
+    from fedml_tpu.compile import (
+        get_program_cache,
+        mesh_fingerprint,
+        model_fingerprint,
+    )
+
+    cache = get_program_cache()
+    builder = lambda: jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    if local_train_fn is not None:
+        return cache.wrap_uncached("hierarchical_sharded_round", builder())
+    return cache.get_or_build(
+        "hierarchical_sharded_round",
+        {
+            "kind": "hierarchical_sharded_round",
+            "model": model_fingerprint(model),
+            "train": config.train,
+            "epochs": config.fed.epochs,
+            "task": task,
+            "mesh": mesh_fingerprint(mesh),
+            "donate": donate,
+        },
+        builder,
+    )
 
 
 class HierarchicalShardedAPI(FedAvgAPI):

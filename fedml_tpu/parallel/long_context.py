@@ -144,4 +144,4 @@ def make_sp_train_step(
         params = variables["params"]
         return params, opt.init(params)
 
-    return init_fn, jax.jit(step)
+    return init_fn, jax.jit(step)  # fedlint: disable=uncached-jit -- bespoke long-context training step closed over mesh/opt; built once per benchmark run

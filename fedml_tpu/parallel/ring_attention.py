@@ -102,7 +102,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "seq", causal: bool = False
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )
-    return jax.jit(fn)
+    return jax.jit(fn)  # fedlint: disable=uncached-jit -- bespoke ring-attention kernel wrapper closed over the mesh; built once per benchmark run
 
 
 def full_attention(q, k, v, causal: bool = False):

@@ -63,3 +63,32 @@ def pytest_configure(config):
         "markers",
         "slow: long-running test — fast tier deselects with -m 'not slow'",
     )
+    config.addinivalue_line(
+        "markers",
+        "recompile_budget(n): with the recompile_sentinel fixture, fail "
+        "the test when more than n XLA backend compiles happen during it "
+        "(fedml_tpu/analysis/sentinel.py). Budgets are coarse upper "
+        "bounds — every backend compile counts, including small utility "
+        "programs — sized to catch per-round recompile storms while "
+        "passing standalone runs (where no earlier test pre-built the "
+        "shared programs).",
+    )
+
+
+@pytest.fixture
+def recompile_sentinel(request):
+    """Runtime recompile tripwire (fedml_tpu/analysis/sentinel.py): pair
+    with ``@pytest.mark.recompile_budget(n)`` — the test fails when the
+    body triggers more than n XLA backend compiles. Without the marker
+    the fixture only observes (``sentinel.recompiles()``)."""
+    from fedml_tpu.analysis.sentinel import RecompileSentinel
+
+    marker = request.node.get_closest_marker("recompile_budget")
+    budget = int(marker.args[0]) if marker and marker.args else None
+    sentinel = RecompileSentinel(
+        budget=budget, label=request.node.name
+    ).start()
+    yield sentinel
+    sentinel.stop()
+    if sentinel.exceeded():
+        pytest.fail(sentinel.describe())

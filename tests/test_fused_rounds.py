@@ -56,7 +56,9 @@ def _cfg(fused_rounds, comm_round=8, freq=100):
 
 
 @pytest.mark.parametrize("ragged", [False, True])
-def test_fused_matches_eager(ragged):
+@pytest.mark.recompile_budget(60)  # standalone worst case ~36; a per-round
+# recompile storm across the two 8-round runs would blow well past this
+def test_fused_matches_eager(ragged, recompile_sentinel):
     data, model = _data(ragged), _model()
     eager = FedAvgAPI(_cfg(1), data, model)
     assert eager._store is not None, "device store required for this test"

@@ -326,7 +326,11 @@ def test_fedbuff_fault_starvation_raises_instead_of_hanging():
     "policy,factor",
     [("uniform", 1.0), ("weighted", 1.5), ("power_of_choice", 1.0)],
 )
-def test_selection_parity_simulation_vs_transport(policy, factor):
+@pytest.mark.recompile_budget(60)  # standalone worst case ~50 across all
+# three params; a cache-key instability recompiling per round would not fit
+def test_selection_parity_simulation_vs_transport(
+    policy, factor, recompile_sentinel
+):
     """Same seed + config ⇒ byte-identical per-round selected-client sets
     in the vmap simulator and the loopback transport federation.
 

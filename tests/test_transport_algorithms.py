@@ -5,6 +5,7 @@ the same prox-term local loss)."""
 
 import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
 from fedml_tpu.config import (
@@ -51,7 +52,9 @@ def _assert_matches(sim_vars, server_vars):
         )
 
 
-def test_loopback_fedopt_matches_simulator():
+@pytest.mark.recompile_budget(60)  # standalone worst case ~41; the sim and
+# transport must SHARE their programs (ProgramCache), not recompile per side
+def test_loopback_fedopt_matches_simulator(recompile_sentinel):
     from fedml_tpu.algorithms.fedopt import FedOptAPI
 
     cfg, data, model_def = _fixture(
