@@ -929,6 +929,107 @@ def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=6, async_steps=18):
     }
 
 
+def _process_cold_start(comm_round=1):
+    """Time-to-first-round of a FRESH PROCESS, with and without the
+    serialized-executable cache (fedml_tpu/compile/executable_cache.py —
+    ROADMAP item 1 zero-cold-start). Three subprocess arms over the
+    north-star config family (femnist-synth CNN), each a 1-round run
+    whose wall clock IS startup + compile + first round:
+
+    - ``no_cache``       — the baseline cold process (every compile paid);
+    - ``cold_populate``  — first process over an empty shared cache dir:
+      pays the compiles AND exports executables + HLO entries;
+    - ``warm_from_disk`` — a fresh process over the populated dir. Runs
+      under ``--recompile_budget 0``, so the arm FAILS unless it really
+      dispatched with zero XLA compiles (the zero-cold-start contract).
+
+    CPU subprocesses like the fedbuff section (a TPU cannot be shared
+    with the bench's own process): the subject is framework+compile
+    cold-start mechanics, not chip speed."""
+    import subprocess
+    import sys
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    cache_dir = tempfile.mkdtemp(prefix="fedml_tpu_xc_bench_")
+    base = [
+        sys.executable, "-m", "fedml_tpu", "--algorithm", "fedavg",
+        "--model", "cnn", "--dataset", "femnist_synth",
+        "--client_num_in_total", "32", "--client_num_per_round", "4",
+        "--comm_round", str(comm_round), "--epochs", "1",
+        "--batch_size", "20", "--pad_bucket", "4",
+        "--frequency_of_the_test", "100", "--seed", "0",
+    ]
+    cached = [
+        "--warmup", "--executable_cache", cache_dir,
+        "--compile_cache_dir", cache_dir, "--compile_cache_min_s", "0",
+    ]
+    arms = [
+        ("no_cache", ["--recompile_budget", "10000"]),
+        ("cold_populate", cached + ["--recompile_budget", "10000"]),
+        ("warm_from_disk", cached + ["--recompile_budget", "0"]),
+    ]
+    out = {
+        "setup": (
+            f"femnist_synth CNN, 32 clients, {comm_round} round(s); one "
+            "fresh CPU subprocess per arm; wall_s = whole process "
+            "(startup + compile/deserialize + first round)"
+        ),
+    }
+    import shutil
+
+    scratch = [cache_dir]
+    try:
+        for name, extra in arms:
+            log_dir = tempfile.mkdtemp(prefix=f"fedml_tpu_cold_{name}_")
+            scratch.append(log_dir)
+            t0 = time.perf_counter()
+            p = subprocess.run(
+                base + extra + ["--log_dir", log_dir],
+                capture_output=True, text=True, timeout=600, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            wall = time.perf_counter() - t0
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"{name} arm exited {p.returncode}: "
+                    f"{(p.stderr or p.stdout)[-800:]}"
+                )
+            row = {"wall_s": round(wall, 2)}
+            try:
+                with open(os.path.join(log_dir, "summary.json")) as f:
+                    summary = json.load(f)
+                for key in (
+                    "compile/recompiles", "compile/deserialize_hits",
+                    "compile/executable_puts", "compile/warmup_s",
+                ):
+                    if key in summary:
+                        row[key.split("/")[-1]] = summary[key]
+            except OSError:
+                pass
+            out[name] = row
+        out["cold_start_speedup"] = round(
+            out["no_cache"]["wall_s"] / out["warm_from_disk"]["wall_s"], 2
+        )
+        try:
+            import pathlib
+
+            out["cache_dir_mb"] = round(
+                sum(
+                    f.stat().st_size
+                    for f in pathlib.Path(cache_dir).glob("*.ftpc")
+                ) / 1e6, 2,
+            )
+        except OSError:
+            pass
+    finally:
+        for d in scratch:  # _fedbuff_async's cleanup discipline
+            shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def _flagship_bf16(comm_round=60, target=None, eval_every=10):
     """The accuracy-GATED flagship bf16 row (VERDICT r3 Next #1 / r4 Next
     #2): the production FedAvg round on the transformer LM (6L/8H/768d,
@@ -1172,7 +1273,7 @@ class _Emitter:
         "north_star_eager_trainloop", "north_star_fused",
         "bf16_cross_silo_resnet56", "flash_attention_s8192",
         "mxu_validation", "scale_100k_clients", "scale_100k_stateful",
-        "fedbuff_async",
+        "fedbuff_async", "process_cold_start",
     )
 
     def __init__(self, t0: float, detail_path: str):
@@ -1293,6 +1394,8 @@ def _sec_digest(key: str, v) -> str:
         return f"{v['flash_over_xla_speedup']}x vs xla"
     if "async_over_sync_update_throughput" in v:
         return f"{v['async_over_sync_update_throughput']}x updates"
+    if "cold_start_speedup" in v:
+        return f"{v['cold_start_speedup']}x cold-start"
     if "mmap_over_ram_slowdown" in v:
         return f"mmap {v['mmap_over_ram_slowdown']}x"
     if "spill_over_hbm_slowdown" in v:
@@ -1628,6 +1731,9 @@ def main():
     def s_scale_state():
         emitter.update({"scale_100k_stateful": _scale_100k_stateful()})
 
+    def s_cold_start():
+        emitter.update({"process_cold_start": _process_cold_start()})
+
     if tiny:
         # CI mode (tests/test_bench_resilience.py): a fast real section,
         # then a sleeper the kill-test murders mid-flight. Proves the
@@ -1687,6 +1793,7 @@ def main():
             ("femnist_lda", s_femnist_lda, 170, 500),
             ("trainloop", s_trainloop, 125, 300),
             ("fedbuff_async", s_fedbuff, 60, 240),
+            ("process_cold_start", s_cold_start, 80, 420),
             ("flash_attention", s_flash, 80, 240),
             ("scale", s_scale, 140, 480),
             ("scale_stateful", s_scale_state, 60, 300),

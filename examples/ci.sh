@@ -155,6 +155,43 @@ print(f"  compile ok: warmup compile {s1['compile/compile_s']:.2f}s -> "
 PY
 rm -rf "$CCDIR" "$CLOG1" "$CLOG2"
 
+echo "== zero-cold-start smoke: two fresh processes, one shared cache dir (docs/COMPILE.md) =="
+# North-star config family (femnist-synth CNN), run twice as SEPARATE
+# processes over one cache dir carrying both the hardened HLO cache and
+# the serialized-executable store. Process 2 must dispatch its ENTIRE run
+# with zero XLA compiles — the PR-5 sentinel enforces it for free via
+# --recompile_budget 0 (exit 1 on any compile) — with byte-identical
+# numerics and strictly lower wall time.
+ZCDIR=$(mktemp -d); ZL1=$(mktemp -d); ZL2=$(mktemp -d)
+ZCFG="--algorithm fedavg --model cnn --dataset femnist_synth \
+  --client_num_in_total 16 --client_num_per_round 2 --comm_round 1 \
+  --epochs 1 --batch_size 20 --pad_bucket 4 --frequency_of_the_test 100 \
+  --warmup --executable_cache $ZCDIR --compile_cache_dir $ZCDIR \
+  --compile_cache_min_s 0"
+Z0=$(date +%s.%N)
+python -m fedml_tpu $ZCFG --recompile_budget 500 --log_dir "$ZL1" > /dev/null
+Z1=$(date +%s.%N)
+python -m fedml_tpu $ZCFG --recompile_budget 0 --log_dir "$ZL2" > /dev/null
+Z2=$(date +%s.%N)
+python - "$ZL1" "$ZL2" "$Z0" "$Z1" "$Z2" <<'PY'
+import json, sys
+s1 = json.load(open(f"{sys.argv[1]}/summary.json"))
+s2 = json.load(open(f"{sys.argv[2]}/summary.json"))
+w1 = float(sys.argv[4]) - float(sys.argv[3])
+w2 = float(sys.argv[5]) - float(sys.argv[4])
+assert s1["compile/recompiles"] > 0, s1          # run 1 really compiled
+assert s1["compile/executable_puts"] > 0, s1     # ...and exported executables
+assert s2["compile/recompiles"] == 0, s2         # zero cold start (sentinel-verified)
+assert s2["compile/deserialize_hits"] > 0, s2    # programs came from disk
+assert s2["Train/Loss"] == s1["Train/Loss"]      # warm-from-disk numerics identical
+assert s2["Test/Loss"] == s1["Test/Loss"]
+assert w2 < w1, (w1, w2)                         # strictly lower wall time
+print(f"  zero-cold-start ok: {w1:.1f}s cold -> {w2:.1f}s warm-from-disk, "
+      f"{int(s2['compile/deserialize_hits'])} executable(s) deserialized, "
+      f"0 recompiles")
+PY
+rm -rf "$ZCDIR" "$ZL1" "$ZL2"
+
 echo "== CLI smoke: recompile-budget sentinel =="
 # a sane budget passes; budget 0 must fail loudly (exit 1) — both
 # directions of the tripwire (fedml_tpu/analysis/sentinel.py)

@@ -562,12 +562,20 @@ class FedAvgAPI:
         """AOT-compile this run's programs before round 0
         (``jit(...).lower(...).compile()`` — fedml_tpu/compile/warmup.py):
         the round program for ``start_round``'s cohort shapes (the fused
-        chunk program when the planner would fuse), the eval program, and
-        the server-optimizer step when present. Emits ``compile``
-        telemetry spans and forwards per-program compile seconds + XLA
-        cost analysis (flops/bytes) through ``log_fn`` into summary.json.
-        Executes nothing — warm runs are numerically identical to cold
-        runs (tests/test_compile.py)."""
+        chunk program when the planner would fuse), EVERY other
+        (steps, bs) shape class the partition can produce (derived via
+        ``bucket_steps`` over all client sizes — EAGER rounds 1..R never
+        hit a lazy shape-bucket compile; fused chunk programs beyond
+        ``start_round``'s, and classes past the 32-class warmup cap,
+        still compile lazily — compile/warmup.py), the eval program, and the
+        server-optimizer step when present. When a persistent executable
+        cache is installed, warmed programs load from / export to disk,
+        so a fresh process warms with zero backend compiles. Emits
+        ``compile`` telemetry spans and forwards per-program compile
+        seconds + XLA cost analysis (flops/bytes) through ``log_fn`` into
+        summary.json. Executes nothing — warm runs are numerically
+        identical to cold runs, and warm-from-disk runs byte-identical to
+        warm-in-process runs (tests/test_compile.py)."""
         from fedml_tpu.compile import warmup_api
 
         return warmup_api(self, log_fn=log_fn or self.log_fn)

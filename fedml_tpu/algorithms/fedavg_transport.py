@@ -970,10 +970,12 @@ def run_federation(
     plan-swapped-mid-startup drift); its counters land in summary.json
     and the server's health registry.
 
-    ``warmup=True`` AOT-compiles the shared local-train program for the
-    round-0 cohort's shape classes BEFORE any worker thread starts — the
-    warmup barrier that lets ``deadline_s`` rounds begin with compilation
-    already paid instead of racing a cold compile."""
+    ``warmup=True`` AOT-compiles the shared local-train program for every
+    shape class the partition can produce BEFORE any worker thread starts
+    — the warmup barrier that lets ``deadline_s`` rounds begin with
+    compilation already paid instead of racing a cold compile, in every
+    round (not just round 0 — partition_shape_classes in data/base.py is
+    the enumeration contract)."""
     from fedml_tpu.scheduler import FaultInjector, overprovisioned_k
 
     K = overprovisioned_k(
@@ -1016,7 +1018,10 @@ def run_federation(
             config,
             data,
             server.global_vars,
-            server.scheduler.select(0, k=K),  # memoized: send_init_msg reuses it
+            # client_ids=None: warm every shape class the PARTITION can
+            # produce, not just round 0's cohort — later rounds' cohorts
+            # must never race a lazy shape-bucket compile against the
+            # deadline (the round-0-only coverage this replaces)
             log_fn=log_fn,
         )
     make_trainer = trainer_factory or (

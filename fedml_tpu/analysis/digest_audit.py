@@ -32,7 +32,24 @@ to compare — the collision is exactly what must be observed.
 removed (via the ``CachedProgram.key_fields`` introspection hook):
 dropping ``server`` from the scaffold digest MUST make the audit fail
 on the ``server.server_lr`` perturbation — tests/test_analysis.py pins
-that the fuzzer really detects its target hazard class."""
+that the fuzzer really detects its target hazard class.
+
+Perturbation lists are AUTO-DERIVED from the RunConfig dataclass tree
+(:func:`auto_perturbations`): every leaf is perturbed with a
+type-appropriate changed value, so a newly added config knob — a
+CompileConfig field, a new TrainConfig hyperparameter — is audited by
+default, against every registered factory, without anyone editing a
+list. Leaves classified in :data:`KNOWN_BENIGN` (run structure, host
+bucketing, transport wire, the compile-runtime knobs themselves) are
+still audited every run, but against one representative spec instead of
+the full factory fan-out, which keeps the audit's runtime bounded.
+
+Collision comparisons hold the abstract input shapes FIXED at the base
+config's: two configs whose digests collide share one jit object, and
+the jit layer already compiles per input shape, so a field that only
+changes which shapes get dispatched is harmless — the hazard is a
+config value baked into the trace as a CONSTANT, which same-shape
+lowering exposes."""
 
 from __future__ import annotations
 
@@ -197,27 +214,135 @@ def _mesh_cohort_size(ctx: dict) -> int:
 
 
 # --------------------------------------------------------------------------
+# auto-derived perturbations (the RunConfig dataclass tree IS the list)
+# --------------------------------------------------------------------------
+#
+# The lists used to be hand-curated per factory, which meant a NEW config
+# knob was only audited if someone remembered to add it. Now every leaf
+# of the RunConfig tree is perturbed by default; a field is only excluded
+# from the full per-factory fan-out by being classified below — and the
+# classified-benign leaves are still audited every run, on one
+# representative spec, to prove the classification stays true.
+
+# Choice-typed leaves where "default + noise" is not a legal value — the
+# perturbed value must be a DIFFERENT member of the field's choice set.
+_CHOICE_VALUES: Dict[str, Any] = {
+    "data.partition_method": "homo",
+    "train.client_optimizer": "adam",
+    "train.compute_dtype": "bfloat16",
+    "train.augment": "crop_flip",
+    "fed.client_parallelism": "scan",
+    "fed.selection": "weighted",
+    "fed.state_store": "mmap",
+    "server.server_optimizer": "adam",
+    "comm.compression": "int8",
+    "model": "mlp",
+}
+
+# Leaves that cannot change any REGISTERED factory's program: run
+# structure, host-side data/bucketing knobs, transport wire options,
+# scheduler/fault plumbing, and the compile-runtime knobs themselves
+# (cache dirs, budgets — they steer WHEN programs compile, never what
+# they compute). "model" is here for a harness reason, not a semantic
+# one: every spec builds from the fixture's FIXED ModelDef (_model), so
+# the cfg.model string cannot reach a factory in this harness either
+# way — model-identity completeness is covered separately by
+# model_fingerprint entering every factory digest (pinned by
+# test_model_fingerprint_distinguishes_architectures and the factory
+# dedup tests), not by this leaf. Audited on the representative spec
+# each run (expected
+# status: merged-identical/rejected, never VIOLATION) instead of fanning
+# out over all ~14 factories, which bounds audit time. A leaf absent
+# from BOTH this set and the tree is impossible; a NEW unclassified leaf
+# — e.g. the next CompileConfig knob — fans out over every factory by
+# default, which is the point.
+KNOWN_BENIGN = frozenset({
+    "model", "seed",
+    "data.dataset", "data.data_dir", "data.partition_method",
+    "data.partition_alpha", "data.batch_size", "data.pad_bucket",
+    "data.device_cache",
+    "fed.client_num_per_round", "fed.comm_round",
+    "fed.frequency_of_the_test", "fed.ci", "fed.group_num",
+    "fed.group_comm_round", "fed.selection", "fed.overprovision_factor",
+    "fed.fault_plan", "fed.deadline_s", "fed.min_clients",
+    "fed.fused_rounds", "fed.eval_on_clients", "fed.async_buffer_k",
+    "fed.async_staleness_exp", "fed.async_server_lr", "fed.state_store",
+    "fed.state_budget_bytes", "fed.state_dir",
+    "comm.compression", "comm.topk_frac", "comm.error_feedback",
+    "comm.secure_agg",
+    "mesh.client_shards", "mesh.axis_name",
+    "compile.warmup", "compile.cache_dir", "compile.min_compile_time_s",
+    "compile.executable_cache", "compile.recompile_budget",
+})
+
+
+def runconfig_leaves(cfg: Optional[RunConfig] = None) -> List[Tuple[str, Any]]:
+    """Every (dotted path, current value) leaf of the RunConfig tree —
+    one nesting level, matching the config's section.field shape."""
+    cfg = cfg or base_config()
+    out: List[Tuple[str, Any]] = []
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(v):
+            for sf in dataclasses.fields(v):
+                out.append((f"{f.name}.{sf.name}", getattr(v, sf.name)))
+        else:
+            out.append((f.name, v))
+    return out
+
+
+def perturbed_value(path: str, value: Any) -> Any:
+    """A type-appropriate SINGLE-field change for a leaf: choice members
+    for enum-ish strings, flipped bools, nudged numbers. Any change
+    works — the audit only needs the perturbed program to differ when
+    the field matters."""
+    if path in _CHOICE_VALUES:
+        return _CHOICE_VALUES[path]
+    if isinstance(value, bool):
+        return not value
+    if isinstance(value, int):
+        return value + 3
+    if isinstance(value, float):
+        return value * 2 + 0.015625
+    if isinstance(value, str):
+        return value + "_x"
+    if value is None:  # Optional[...] leaves (recompile_budget, shards)
+        return 7
+    raise TypeError(f"unperturbable RunConfig leaf {path!r}: {value!r}")
+
+
+def auto_perturbations(
+    cfg: Optional[RunConfig] = None,
+) -> Tuple[List[Perturbation], List[Perturbation]]:
+    """Derive the audit's perturbation lists from the RunConfig tree:
+    ``(fanout, benign)`` — ``fanout`` (every unclassified leaf) runs
+    against EVERY registered factory; ``benign`` (the KNOWN_BENIGN
+    classification) runs against the representative spec only."""
+    fanout: List[Perturbation] = []
+    benign: List[Perturbation] = []
+    for path, value in runconfig_leaves(cfg):
+        pert = Perturbation(path, perturbed_value(path, value))
+        (benign if path in KNOWN_BENIGN else fanout).append(pert)
+    return fanout, benign
+
+
+# --------------------------------------------------------------------------
 # the factory registry
 # --------------------------------------------------------------------------
 
+_AUTO_FANOUT, _AUTO_BENIGN = auto_perturbations()
 _TRAIN_PERTURBS = [
-    Perturbation("train.lr", 0.31),
-    Perturbation("train.momentum", 0.9),
-    Perturbation("train.wd", 0.01),
-    Perturbation("train.prox_mu", 0.05),
-    Perturbation("train.compute_dtype", "bfloat16"),
-    Perturbation("train.client_optimizer", "adam"),
-    Perturbation("fed.epochs", 2),
+    p for p in _AUTO_FANOUT
+    if p.field.startswith("train.") or p.field == "fed.epochs"
 ]
-_MODE_PERTURB = [Perturbation("fed.client_parallelism", "scan")]
-_SERVER_PERTURBS = [
-    Perturbation("server.server_lr", 0.5),
-    Perturbation("server.server_optimizer", "adam"),
-    Perturbation("server.server_momentum", 0.9),
+_MODE_PERTURB = [
+    p for p in _AUTO_FANOUT if p.field == "fed.client_parallelism"
 ]
-# program-irrelevant fields — the audit should report merged-identical,
-# proving it tolerates benign digest merges instead of demanding splits
-_BENIGN_PERTURBS = [Perturbation("seed", 7), Perturbation("data.data_dir", "/x")]
+_SERVER_PERTURBS = [p for p in _AUTO_FANOUT if p.field.startswith("server.")]
+# classified-benign leaves — the representative spec re-proves every run
+# that they merge identically (the audit tolerates benign digest merges
+# instead of demanding splits)
+_BENIGN_PERTURBS = list(_AUTO_BENIGN)
 
 
 def default_specs() -> List[FactorySpec]:
@@ -400,64 +525,60 @@ def default_specs() -> List[FactorySpec]:
             _sds((Cm,), np.int32),
         ) + _cohort(cfg, Cm)
 
+    # Every spec audits the FULL auto-derived fan-out (every unclassified
+    # RunConfig leaf) — the hand-curated per-factory subsets this
+    # replaces silently exempted new knobs. Factory-kwarg perturbations
+    # (@q, @lam) ride along where the factory takes them; the
+    # representative fedavg_round spec additionally re-proves the
+    # KNOWN_BENIGN classification each run.
     return [
         FactorySpec(
             "fedavg_round", fedavg_build, fedavg_args,
-            _TRAIN_PERTURBS + _MODE_PERTURB + _BENIGN_PERTURBS,
+            _AUTO_FANOUT + _BENIGN_PERTURBS,
         ),
         FactorySpec(
             "fedavg_multiround", multiround_build, multiround_args,
-            _TRAIN_PERTURBS + _MODE_PERTURB,
+            _AUTO_FANOUT,
         ),
-        FactorySpec("fednova_round", fednova_build, fedavg_args, _TRAIN_PERTURBS),
+        FactorySpec("fednova_round", fednova_build, fedavg_args, _AUTO_FANOUT),
         FactorySpec(
             "qfedavg_round", qfedavg_build, fedavg_args,
-            _TRAIN_PERTURBS + [Perturbation("@q", 2.0)],
+            _AUTO_FANOUT + [Perturbation("@q", 2.0)],
         ),
         FactorySpec(
-            "scaffold_round", scaffold_build, scaffold_args,
-            _TRAIN_PERTURBS + _MODE_PERTURB + _SERVER_PERTURBS
-            + [Perturbation("fed.client_num_in_total", 9)],
+            "scaffold_round", scaffold_build, scaffold_args, _AUTO_FANOUT,
         ),
         FactorySpec(
-            "scaffold_cohort_round", scaffold_cohort_build, scaffold_cohort_args,
-            _TRAIN_PERTURBS + _SERVER_PERTURBS
-            + [Perturbation("fed.client_num_in_total", 9)],
+            "scaffold_cohort_round", scaffold_cohort_build,
+            scaffold_cohort_args, _AUTO_FANOUT,
         ),
         FactorySpec(
             "ditto_round", ditto_build, ditto_args,
-            _TRAIN_PERTURBS + [Perturbation("@lam", 0.5)],
+            _AUTO_FANOUT + [Perturbation("@lam", 0.5)],
         ),
         FactorySpec(
             "ditto_cohort_round", ditto_cohort_build, ditto_cohort_args,
-            _TRAIN_PERTURBS + [Perturbation("@lam", 0.5)],
+            _AUTO_FANOUT + [Perturbation("@lam", 0.5)],
         ),
         FactorySpec(
             "fedopt_server_step", server_step_build, server_step_args,
-            _SERVER_PERTURBS + _BENIGN_PERTURBS,
+            _AUTO_FANOUT,
         ),
-        FactorySpec("eval", eval_build, eval_args, _BENIGN_PERTURBS
-                    + [Perturbation("train.lr", 0.31)]),
+        FactorySpec("eval", eval_build, eval_args, _AUTO_FANOUT),
         FactorySpec(
-            "local_train", local_train_build, local_train_args, _TRAIN_PERTURBS
+            "local_train", local_train_build, local_train_args, _AUTO_FANOUT
         ),
         FactorySpec(
             "sharded_fedavg_round", sharded_fedavg_build, sharded_args,
-            _TRAIN_PERTURBS + _MODE_PERTURB, needs_mesh=True,
+            _AUTO_FANOUT, needs_mesh=True,
         ),
         FactorySpec(
             "sharded_fednova_round", sharded_fednova_build, sharded_args,
-            [Perturbation("train.lr", 0.31), Perturbation("train.momentum", 0.9),
-             Perturbation("fed.epochs", 2)],
-            needs_mesh=True,
+            _AUTO_FANOUT, needs_mesh=True,
         ),
         FactorySpec(
             "sharded_scaffold_round", sharded_scaffold_build,
-            sharded_scaffold_args,
-            [Perturbation("train.lr", 0.31), Perturbation("fed.epochs", 2)]
-            + _SERVER_PERTURBS
-            + [Perturbation("fed.client_num_in_total", 9)],
-            needs_mesh=True,
+            sharded_scaffold_args, _AUTO_FANOUT, needs_mesh=True,
         ),
     ]
 
@@ -540,11 +661,19 @@ def audit_factory(
         if d2 != base_digest:
             results.append(PerturbResult(pert.field, "distinct"))
             continue
-        # digest collision: the programs MUST be identical
+        # digest collision: the programs MUST be identical — compared at
+        # the BASE config's abstract shapes. A collision means both
+        # configs share ONE jit object, and the jit layer compiles per
+        # input shape anyway, so a field that only changes which shapes
+        # get dispatched (a lead-axis count sourcing an argument shape)
+        # is harmless; lowering the perturbed program at the perturbed
+        # shapes would flag exactly that and drown the real hazard —
+        # config values baked into the trace as CONSTANTS (the scaffold
+        # eta_g / 1/N class), which same-shape lowering still exposes.
         try:
             if base_text is None:
                 base_text = _lowered_text(base_prog, spec.args(cfg, ctx, dict(spec.kwargs)))
-            text2 = _lowered_text(prog2, spec.args(cfg2, ctx, kw))
+            text2 = _lowered_text(prog2, spec.args(cfg, ctx, dict(spec.kwargs)))
         except Exception as e:  # noqa: BLE001 — backend can't lower this combo
             results.append(
                 PerturbResult(
@@ -571,12 +700,33 @@ def audit_all(
     specs: Optional[List[FactorySpec]] = None,
     cfg: Optional[RunConfig] = None,
 ) -> Tuple[List[FactoryAudit], List[PerturbResult]]:
-    """Audit every registered factory; returns (audits, violations)."""
+    """Audit every registered factory; returns (audits, violations).
+
+    A fan-out field whose perturbation is REJECTED by every factory is
+    itself a violation: it means the derived value is illegal everywhere
+    (typically a new choice-typed leaf missing from ``_CHOICE_VALUES``),
+    so the leaf is silently unaudited — the exact failure mode
+    auto-derivation exists to prevent."""
     specs = specs if specs is not None else default_specs()
     cfg = cfg or base_config()
     ctx: dict = {}
     audits = [audit_factory(s, cfg=cfg, ctx=ctx) for s in specs]
     violations = [v for a in audits for v in a.violations]
+    by_field: Dict[str, set] = {}
+    for a in audits:
+        for r in a.results:
+            by_field.setdefault(r.field, set()).add(r.status)
+    for field, statuses in sorted(by_field.items()):
+        if statuses == {"rejected"}:
+            violations.append(
+                PerturbResult(
+                    field, "VIOLATION",
+                    "perturbation rejected by EVERY factory — the leaf is "
+                    "effectively unaudited; give it a legal alternative "
+                    "value in _CHOICE_VALUES (or classify it KNOWN_BENIGN "
+                    "with justification)",
+                )
+            )
     return audits, violations
 
 
@@ -584,7 +734,12 @@ def assert_digests_complete(specs=None) -> List[FactoryAudit]:
     """Raise :class:`DigestAuditError` on any violation (pytest entry)."""
     audits, violations = audit_all(specs)
     if violations:
-        raise DigestAuditError(
-            "\n".join(a.render() for a in audits if a.violations)
+        per_factory = {id(v) for a in audits for v in a.violations}
+        lines = [a.render() for a in audits if a.violations]
+        lines.extend(
+            f"digest-audit GLOBAL: VIOLATION {v.field}: {v.detail}"
+            for v in violations
+            if id(v) not in per_factory
         )
+        raise DigestAuditError("\n".join(lines))
     return audits

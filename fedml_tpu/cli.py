@@ -250,14 +250,33 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
                    "quarantine, advisory file lock). Pass a fresh "
                    "directory for a per-run cache; cache hit/miss/"
                    "quarantine counts land in summary.json (compile/*)")
+@click.option("--executable_cache", type=click.Path(path_type=Path), default=None,
+              help="Persist SERIALIZED AOT executables at this directory "
+                   "(compile/executable_cache.py, served through the "
+                   "hardened store): --warmup exports every executable it "
+                   "compiles, and a fresh process deserializes its whole "
+                   "warmup set instead of compiling — zero-cold-start "
+                   "restarts/replicas/CI shards. Keyed by program digest "
+                   "+ shape class + environment fingerprint, so jaxlib/"
+                   "backend/code skew recompiles cleanly. Deserialize "
+                   "counts land in summary.json (compile/deserialize_*)")
+@click.option("--compile_cache_min_s", type=float, default=2.0,
+              help="Only persist HLO compiles at least this slow into "
+                   "--compile_cache_dir (default 2.0 — the conservative "
+                   "threshold tests/conftest.py uses). 0 persists every "
+                   "compile: combined with --executable_cache this is the "
+                   "zero-cold-start setting where a repeat process "
+                   "reports compile/recompiles == 0")
 @click.option("--recompile_budget", type=int, default=None,
               help="Fail the run when more than this many XLA compiles "
                    "happen (fedml_tpu/analysis/sentinel.py) — the tripwire "
                    "for cache-key instabilities that silently recompile "
-                   "every round. Counts EVERY backend compile incl. small "
-                   "utility programs, so pick a coarse upper bound; the "
-                   "observed count always lands in summary.json "
-                   "(compile/recompiles). Off by default")
+                   "every round. Counts every ACTUAL backend compile incl. "
+                   "small utility programs (persistent-cache hits and "
+                   "deserialized executables are not compiles and don't "
+                   "count — a fully warm process passes budget 0), so pick "
+                   "a coarse upper bound; the observed count always lands "
+                   "in summary.json (compile/recompiles). Off by default")
 @click.option("--rank", type=int, default=None,
               help="runtime=grpc: this process's rank (0 = server, 1..K = "
                    "clients; ref main_fedavg_rpc.py --fl_worker_index)")
@@ -505,6 +524,8 @@ def build_config(opt) -> RunConfig:
         compile=CompileConfig(
             warmup=opt.get("warmup", False),
             cache_dir=str(opt.get("compile_cache_dir") or ""),
+            min_compile_time_s=opt.get("compile_cache_min_s", 2.0),
+            executable_cache=str(opt.get("executable_cache") or ""),
             recompile_budget=opt.get("recompile_budget"),
         ),
         model=opt["model"],
@@ -647,6 +668,22 @@ def run(**opt):
             config.compile.cache_dir,
             min_compile_time_secs=config.compile.min_compile_time_s,
         )
+    if config.compile.executable_cache:
+        # serialized-executable store (zero-cold-start): like the HLO
+        # cache above, installed run-scoped with a composed restore so a
+        # crashed/embedded run can't leave it bound process-wide
+        from fedml_tpu.compile import install_run_executable_cache
+
+        _, _restore_exec = install_run_executable_cache(
+            config.compile.executable_cache
+        )
+        _restore_hlo = restore_compile_cache
+
+        def restore_compile_cache() -> None:  # noqa: F811 — composed restore
+            _restore_exec()
+            if _restore_hlo is not None:
+                _restore_hlo()
+
     from fedml_tpu.compile import compile_snapshot
 
     # baseline for the summary.json compile row: a run embedded in a

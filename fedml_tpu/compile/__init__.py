@@ -23,6 +23,14 @@ dimension. This package manages it as one first-class layer:
 See docs/COMPILE.md for the keying/integrity model and the
 observability contract (``compile/*`` keys in summary.json)."""
 
+from fedml_tpu.compile.executable_cache import (
+    ExecutableCache,
+    environment_fingerprint,
+    install_executable_cache,
+    install_run_executable_cache,
+    installed_executable_cache,
+    supports_serialization,
+)
 from fedml_tpu.compile.digest import (
     call_signature,
     canonical,
@@ -47,20 +55,26 @@ from fedml_tpu.compile.warmup import warmup_api, warmup_local_train
 
 __all__ = [
     "CachedProgram",
+    "ExecutableCache",
     "HardenedFileCache",
     "ProgramCache",
     "call_signature",
     "canonical",
     "compile_snapshot",
     "compile_summary_row",
+    "environment_fingerprint",
     "get_program_cache",
     "hooks_cacheable",
+    "install_executable_cache",
     "install_hardened_cache",
     "install_run_cache",
+    "install_run_executable_cache",
     "installed_cache",
+    "installed_executable_cache",
     "mesh_fingerprint",
     "model_fingerprint",
     "program_digest",
+    "supports_serialization",
     "use_program_cache",
     "warmup_api",
     "warmup_local_train",
@@ -68,23 +82,30 @@ __all__ = [
 
 
 def compile_snapshot() -> dict:
-    """Point-in-time counters of both compile-cache layers (baseline for
+    """Point-in-time counters of every compile-cache layer (baseline for
     :func:`compile_summary_row`, so a run embedded in a long-lived
     process reports ITS activity, not the process's lifetime totals)."""
     snap = {"programs": get_program_cache().stats()}
     hard = installed_cache()
     if hard is not None:
         snap["persistent"] = hard.stats()
+    execs = installed_executable_cache()
+    if execs is not None:
+        snap["executables"] = execs.stats()
     return snap
 
 
 def compile_summary_row(baseline: dict = None) -> dict:
     """Flat ``{"compile/...": value}`` MetricsLogger row combining the
-    in-process program cache and (when installed) the hardened
-    persistent layer — summary.json stays the single CI oracle."""
+    in-process program cache, the hardened persistent HLO layer, and the
+    serialized-executable store (when installed) — summary.json stays
+    the single CI oracle."""
     base = baseline or {}
     row = get_program_cache().summary_row(baseline=base.get("programs"))
     hard = installed_cache()
     if hard is not None:
         row.update(hard.summary_row(baseline=base.get("persistent")))
+    execs = installed_executable_cache()
+    if execs is not None:
+        row.update(execs.summary_row(baseline=base.get("executables")))
     return row

@@ -139,7 +139,22 @@ class HardenedFileCache:
             return None
         return payload
 
-    def _quarantine(self, p: pathlib.Path) -> None:
+    def quarantine_entry(self, key: str) -> None:
+        """Move ``key``'s entry into quarantine/. The executable layer
+        (executable_cache.py) calls this when an entry passes the byte
+        integrity check but fails SEMANTIC verification — a mismatched
+        embedded environment fingerprint, or a payload this jax cannot
+        deserialize — so the forensics-preserving quarantine discipline
+        covers both corruption classes."""
+        self._quarantine(
+            self._entry_path(key), reason="failed semantic verification"
+        )
+
+    def _quarantine(
+        self,
+        p: pathlib.Path,
+        reason: str = "failed integrity verification",
+    ) -> None:
         with self._mu:
             self.quarantined += 1
         try:
@@ -147,15 +162,15 @@ class HardenedFileCache:
             dest = self._qdir / f"{p.name}.{os.getpid()}.{time.time_ns()}"
             os.replace(p, dest)
             logging.warning(
-                "compile cache entry %s failed integrity verification — "
-                "quarantined to %s; the program recompiles", p.name, dest,
+                "compile cache entry %s %s — quarantined to %s; the "
+                "program recompiles", p.name, reason, dest,
             )
         except OSError:
             # a racing process already moved/removed it — that's fine,
             # the entry is gone either way
             logging.warning(
-                "compile cache entry %s failed integrity verification and "
-                "could not be quarantined (already removed?)", p.name,
+                "compile cache entry %s %s and could not be quarantined "
+                "(already removed?)", p.name, reason,
             )
 
     # -- CacheInterface --
@@ -226,13 +241,17 @@ class HardenedFileCache:
                 with self._mu:
                     self.evicted += 1
 
-    def put(self, key: str, value: bytes) -> None:
+    def put(self, key: str, value: bytes) -> bool:
+        """Write an entry; returns True only when THIS call persisted it
+        (False: a first writer already holds the slot, or the write
+        failed — callers reporting export counters must not count those
+        as successes). jax's CacheInterface ignores the return value."""
         p = self._entry_path(key)
         blob = self._frame(bytes(value))
         tmp = p.with_name(f".tmp.{os.getpid()}.{p.name}")
         with self._flock():
             if p.exists():
-                return  # first writer wins (stock LRUCache semantics)
+                return False  # first writer wins (stock LRUCache semantics)
             try:
                 with open(tmp, "wb") as f:
                     f.write(blob)
@@ -243,10 +262,11 @@ class HardenedFileCache:
                 logging.warning("compile cache write %s failed: %s", p, e)
                 with contextlib.suppress(OSError):
                     os.unlink(tmp)
-                return
+                return False
             self._evict_if_needed(keep=p)
         with self._mu:
             self.puts += 1
+        return True
 
     def stats(self) -> dict:
         with self._mu:

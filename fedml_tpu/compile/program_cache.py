@@ -65,11 +65,107 @@ class CachedProgram:
         self._cache = cache
         self._aot: Dict[tuple, Any] = {}
         self._aot_stats: Dict[tuple, dict] = {}
+        # signatures already probed against the persistent executable
+        # store (executable_cache.py) — each shape class pays at most one
+        # disk lookup, hit or miss
+        self._exec_probed: set = set()
+        # lazy-probe circuit breaker: every dispatch that computes a
+        # signature while NOTHING has been adopted burns one unit —
+        # probed-miss or repeat call alike — so after a few calls an
+        # installed-but-empty store stops taxing the hot path with
+        # per-call tree_flatten (e.g. the whole test suite under the
+        # conftest session store). warmup() still probes regardless, and
+        # any adoption re-arms the signature path via the non-empty _aot.
+        self._exec_probe_budget = 4
+
+    def _exec_cache(self):
+        """The installed persistent executable store, when this program
+        is eligible for it (a canonical digest is the cross-process half
+        of the key — bypassed/opaque programs have none and never
+        persist)."""
+        if self.digest is None:
+            return None
+        from fedml_tpu.compile.executable_cache import (
+            installed_executable_cache,
+        )
+
+        return installed_executable_cache()
+
+    def _load_serialized(self, sig, tracer=None):
+        """Try to adopt a persisted executable for ``sig``; returns its
+        stats row or None. On a hit the executable enters the same AOT
+        dispatch map warmup fills, so a warm-from-disk run takes exactly
+        the dispatch path a warm-in-process run takes (byte-identical
+        numerics — the executable IS the one a compile would build,
+        pinned by tests/test_compile.py)."""
+        cache = self._exec_cache()
+        if cache is None:
+            return None
+        t0 = time.perf_counter()
+        exe = cache.load(self.digest, sig)
+        if exe is None:
+            return None
+        dt = time.perf_counter() - t0
+        flops = bytes_accessed = None
+        try:
+            ca = exe.cost_analysis()
+            if isinstance(ca, list):  # older jax returns [dict]
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0)) or None
+            bytes_accessed = float(ca.get("bytes accessed", 0.0)) or None
+        except Exception:  # noqa: BLE001 — no cost model on this backend
+            pass
+        self._aot[sig] = exe
+        st = {
+            "compile_s": 0.0,
+            "flops": flops,
+            "bytes": bytes_accessed,
+            "aot_cache_hit": False,
+            "deserialized": True,
+            "deserialize_s": dt,
+        }
+        self._aot_stats[sig] = st
+        if self._cache is not None:
+            self._cache._note_deserialize(dt, label=self.label, digest=self.digest)
+        if tracer is not None:
+            # zero-duration marker span: the deserialize replaced a compile
+            with tracer.span(
+                "compile", program=self.label, aot=True, deserialized=True
+            ):
+                pass
+        return st
 
     def __call__(self, *args, **kwargs):
-        if self._aot and not kwargs:
+        if not kwargs and (
+            self._aot
+            or (self._exec_probe_budget > 0 and self._exec_cache() is not None)
+        ):
             sig = call_signature(args)
             exe = self._aot.get(sig)
+            if exe is None and self._exec_probe_budget > 0:
+                if sig not in self._exec_probed:
+                    # lazy dispatch of a shape class nobody warmed:
+                    # before paying a compile, probe the persistent
+                    # executable store once — a fresh process whose
+                    # predecessor warmed this (program, shape class)
+                    # dispatches with zero compiles
+                    self._exec_probed.add(sig)
+                    try:
+                        if self._load_serialized(sig) is not None:
+                            exe = self._aot.get(sig)
+                    except Exception:  # noqa: BLE001 — the store must
+                        import logging  # never break a dispatch
+
+                        logging.exception(
+                            "executable-cache probe failed for %r",
+                            self.label,
+                        )
+                if exe is None and not self._aot:
+                    # nothing adopted so far: burn breaker budget per
+                    # CALL (not per class) so a program whose store
+                    # entries don't exist stops paying call_signature
+                    # after a handful of dispatches
+                    self._exec_probe_budget -= 1
             if exe is not None:
                 try:
                     return exe(*args)
@@ -104,6 +200,15 @@ class CachedProgram:
             # re-bill the first run's compile seconds in its summary rows
             return dict(st, compile_s=0.0, aot_cache_hit=True)
         tracer = tracer or get_tracer()
+        # zero-cold-start path: a predecessor process may have persisted
+        # this exact (program digest, shape class, environment) —
+        # deserialize it instead of compiling (executable_cache.py; the
+        # environment fingerprint guarantees skew lands here as a clean
+        # miss, never as wrong numerics)
+        self._exec_probed.add(sig)
+        st = self._load_serialized(sig, tracer=tracer)
+        if st is not None:
+            return dict(st)
         t0 = time.perf_counter()
         with tracer.span("compile", program=self.label, aot=True):
             compiled = self.fn.lower(*args).compile()
@@ -127,6 +232,19 @@ class CachedProgram:
         self._aot_stats[sig] = st
         if self._cache is not None:
             self._cache._note_compile_time(dt, label=self.label, digest=self.digest)
+        exec_cache = self._exec_cache()
+        if exec_cache is not None:
+            # export the executable so the NEXT process deserializes
+            # instead of compiling (best-effort; save() warns on programs
+            # this jaxlib cannot serialize)
+            try:
+                exec_cache.save(self.digest, sig, compiled)
+            except Exception:  # noqa: BLE001 — persistence must not
+                import logging  # break warmup
+
+                logging.exception(
+                    "persisting executable for %r failed", self.label
+                )
         return dict(st)
 
 
@@ -141,12 +259,19 @@ class ProgramCache:
         self.misses = 0
         self.bypassed = 0
         self.compile_s = 0.0  # accumulated measured (AOT) compile seconds
+        # zero-cold-start accounting (executable_cache.py): programs
+        # adopted from the persistent executable store instead of
+        # compiled, and the seconds spent deserializing them
+        self.deserialize_hits = 0
+        self.deserialize_s = 0.0
         # compile-event listeners (fedml_tpu/analysis/sentinel.py): called
         # OUTSIDE the lock as listener(kind, label, digest) with kind in
-        # {"build", "hit", "bypass", "aot_compile"} — "build" = a new jit
-        # object was constructed (a cache miss), "hit" = a dedup hit,
-        # "bypass" = an uncacheable wrap, "aot_compile" = a warmup
-        # actually compiled an executable.
+        # {"build", "hit", "bypass", "aot_compile", "aot_deserialize"} —
+        # "build" = a new jit object was constructed (a cache miss),
+        # "hit" = a dedup hit, "bypass" = an uncacheable wrap,
+        # "aot_compile" = a warmup actually compiled an executable,
+        # "aot_deserialize" = a PERSISTED executable was adopted instead
+        # of compiling (the sentinel must not count these).
         self._listeners: List[Callable[[str, str, Optional[str]], None]] = []
 
     def add_listener(self, fn: Callable[[str, str, Optional[str]], None]) -> None:
@@ -246,6 +371,17 @@ class ProgramCache:
             self.compile_s += float(dt)
         self._emit("aot_compile", label, digest)
 
+    def _note_deserialize(
+        self, dt: float, label: str = "?", digest: Optional[str] = None
+    ) -> None:
+        """A persisted executable replaced a compile. Emitted as its own
+        event kind — the recompile sentinel must NOT count it (nothing
+        compiled; that is the whole point)."""
+        with self._lock:
+            self.deserialize_hits += 1
+            self.deserialize_s += float(dt)
+        self._emit("aot_deserialize", label, digest)
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -254,6 +390,8 @@ class ProgramCache:
                 "bypassed": self.bypassed,
                 "programs": len(self._programs),
                 "compile_s": self.compile_s,
+                "deserialize_hits": self.deserialize_hits,
+                "deserialize_s": self.deserialize_s,
             }
 
     def summary_row(self, baseline: Optional[dict] = None) -> dict:
@@ -267,6 +405,10 @@ class ProgramCache:
             "compile/cache_bypassed": snap["bypassed"] - base.get("bypassed", 0),
             "compile/programs": snap["programs"],
             "compile/compile_s": snap["compile_s"] - base.get("compile_s", 0.0),
+            "compile/deserialize_hits": snap["deserialize_hits"]
+            - base.get("deserialize_hits", 0),
+            "compile/deserialize_s": snap["deserialize_s"]
+            - base.get("deserialize_s", 0.0),
         }
 
     def reset(self) -> None:
@@ -274,6 +416,8 @@ class ProgramCache:
             self._programs.clear()
             self.hits = self.misses = self.bypassed = 0
             self.compile_s = 0.0
+            self.deserialize_hits = 0
+            self.deserialize_s = 0.0
 
 
 def hooks_cacheable(*hooks) -> bool:

@@ -21,13 +21,26 @@ Covered programs, matching what ``FedAvgAPI.train`` dispatches first:
 - the round program — the eager round-fn variant for round
   ``start_round``'s cohort shapes, or the fused multi-round chunk
   program when ``fused_rounds`` applies;
+- **every other (steps, bs) shape class the partition can produce**
+  (:func:`fedml_tpu.data.base.partition_shape_classes` — the cohort
+  bucket only reads the max member's count, so the reachable classes
+  are exactly the per-client singleton buckets), including both
+  ``may_pad`` round variants where the partition makes both reachable —
+  so rounds 1..R never hit a lazy shape-bucket compile, no matter which
+  cohorts the scheduler draws;
 - the eval program at the cached test-batch shapes;
 - the server-optimizer step (FedOpt family), when present.
 
-Later shape classes (a differently-bucketed cohort, the second
-``may_pad`` variant) still compile lazily on first dispatch — warmup
-covers the round-0 cold start, not every program the run may ever
-build."""
+With a persistent executable cache installed
+(compile/executable_cache.py), every warmed program is additionally
+serialized to disk — so the NEXT process deserializes its whole warmup
+set instead of compiling it (zero-cold-start serving).
+
+Remaining lazy compiles: fused multi-round chunk programs beyond round
+``start_round``'s (chunk lengths depend on eval boundaries and class
+changes — enumerating them up front would compile chunk shapes most
+runs never dispatch), and cohorts reshaped mid-run by participation
+faults (a fault-shrunk cohort is a different client-axis size)."""
 
 from __future__ import annotations
 
@@ -62,10 +75,176 @@ def _warm_one(rows: dict, label: str, fn, args, tracer) -> None:
         return
     rows[f"compile/{label}_compile_s"] = st["compile_s"]
     rows[f"compile/{label}_aot_cache_hit"] = bool(st.get("aot_cache_hit"))
+    if st.get("deserialized"):
+        # the program came from the persistent executable store — nothing
+        # compiled (compile_s is 0 by contract); the row says so, so a
+        # warm-from-disk run's summary is distinguishable from a hit
+        rows[f"compile/{label}_deserialized"] = True
+        rows[f"compile/{label}_deserialize_s"] = st.get("deserialize_s", 0.0)
     if st.get("flops"):
         rows[f"compile/{label}_flops"] = st["flops"]
     if st.get("bytes"):
         rows[f"compile/{label}_bytes"] = st["bytes"]
+
+
+# Pre-enumeration cap: full-batch mode (batch_size=-1) makes bs the
+# cohort max, so a ragged partition can yield one class per DISTINCT
+# client size — compiling them all would turn warmup into a multi-hour
+# stall over shapes most runs never dispatch. Classes are warmed
+# most-populous first and the skip is LOGGED (never silent).
+_MAX_WARM_CLASSES = 32
+
+
+def _classes_by_population(
+    counts, batch_size: int, pad_bucket: int, cohort: int = 1
+):
+    """Partition shape classes ordered by member count (descending) —
+    under the warm cap, the classes that cover the most clients (and so
+    the most future cohorts) compile first.
+
+    ``cohort`` filters UNREACHABLE classes: a class is a cohort's shape
+    only when its defining client is the cohort MAX, which needs at
+    least ``cohort`` clients at-or-below that size to draw from (without
+    replacement). With counts=[8,100,100,100] and cohort=4 every cohort
+    contains a 100-sample client, so the size-8 singleton class can
+    never be dispatched — warming it would waste compile time and cache
+    entries. Callers with fault-shrinkable cohorts pass cohort=1 (a
+    shrunk cohort CAN make small classes reachable)."""
+    from fedml_tpu.data.base import bucket_steps, partition_shape_classes
+
+    classes = partition_shape_classes(counts, batch_size, pad_bucket)
+    population: dict = {}
+    class_max: dict = {}
+    for n in counts:
+        k = bucket_steps([int(n)], batch_size, pad_bucket)[:2]
+        population[k] = population.get(k, 0) + 1
+        class_max[k] = max(class_max.get(k, 0), int(n))
+    sorted_counts = sorted(int(n) for n in counts)
+    import bisect
+
+    def reachable(k) -> bool:
+        return bisect.bisect_right(sorted_counts, class_max[k]) >= cohort
+
+    ordered = sorted(
+        ((k, v) for k, v in classes.items() if reachable(k)),
+        key=lambda kv: (-population[kv[0]], kv[0]),
+    )
+    skipped = max(0, len(ordered) - _MAX_WARM_CLASSES)
+    if skipped:
+        logging.warning(
+            "shape-class pre-enumeration capped: warming the %d most-"
+            "populous of %d classes (%d skipped — they will compile "
+            "lazily on first dispatch). A class count this high usually "
+            "means batch_size=-1 (full-batch mode) over a ragged "
+            "partition; consider pad_bucket to collapse classes.",
+            _MAX_WARM_CLASSES, len(ordered), skipped,
+        )
+    return ordered[:_MAX_WARM_CLASSES], skipped
+
+
+def _class_may_pad_variants(fulls, st: int, bs: int, cohort: int):
+    """Which ``may_pad`` round variants are reachable for shape class
+    ``(st, bs)`` given the partition's per-client full-step counts
+    (``ceil(n/bs)`` per client). A client can join an (st, bs) cohort iff
+    its full-step count <= st; the cohort pads iff any member underfills
+    the bucketed step count. ``may_pad=False`` needs a whole cohort of
+    exact fills; ``True`` needs one underfill (its own bucket rounding,
+    or a smaller ride-along client)."""
+    members = [f for f in fulls if f <= st]
+    exact = sum(1 for f in members if f == st)
+    variants = []
+    if exact >= cohort:
+        variants.append(False)
+    if any(f < st for f in members):
+        variants.append(True)
+    return variants or [None]
+
+
+def _warm_partition_classes(api, rows: dict, tracer, r0: int) -> None:
+    """Pre-enumerate and AOT-compile the eager round program for EVERY
+    (steps, bs) shape class the partition can produce — not just round
+    ``r0``'s — so later rounds whose cohorts bucket differently dispatch
+    a warmed executable instead of paying a lazy compile (ROADMAP item 1:
+    every later-round shape bucket used to compile lazily at dispatch).
+
+    Synthetic all-zero batches drive the lowering (only shapes/dtypes
+    enter ``lower()``); they pass through ``api._place_batch`` so mesh
+    runtimes warm against the exact shardings their dispatches carry.
+    Round ``r0``'s class was already warmed from its real batch — the
+    re-warm here is a free per-signature hit that only labels the row."""
+    import jax
+    import numpy as np
+
+    from fedml_tpu.data.base import ClientBatch
+
+    cfg = api.config
+    data = api.data
+    counts = [int(n) for n in api._client_counts(range(data.num_clients))]
+    cohort = len(api._round_plan(r0)[0])
+    # participation faults shrink cohorts mid-run, which can make classes
+    # reachable that full cohorts never produce — enumerate as if cohorts
+    # could be singletons then
+    faults = getattr(api, "faults", None)
+    reach_cohort = (
+        1
+        if faults is not None and faults.plan.has_participation_faults()
+        else cohort
+    )
+    classes, skipped = _classes_by_population(
+        counts, cfg.data.batch_size, cfg.data.pad_bucket,
+        cohort=reach_cohort,
+    )
+    if skipped:
+        rows["compile/warm_classes_skipped"] = skipped
+    feat = tuple(data.client_x[0].shape[1:])
+    lab = tuple(data.client_y[0].shape[1:])
+    xdt, ydt = data.client_x[0].dtype, data.client_y[0].dtype
+    fn = api.round_fn
+    variant_for = getattr(fn, "variant_for", None)
+    can_vary = bool(getattr(fn, "supports_may_pad", False))
+    rng = jax.random.fold_in(api.rng, r0 + 1)  # shape-only: (2,) uint32
+    store = getattr(api, "_store", None)
+    for (st, bs), _rep in classes:
+        if store is not None:
+            # the HBM-store round-batch program (gather + reshape) is a
+            # per-class dispatch too — warm it, or round 1..R's first
+            # cohort in this class pays ITS lazy compile instead
+            from fedml_tpu.data.device_store import gather_program
+
+            _warm_one(
+                rows,
+                f"gather_s{st}b{bs}",
+                gather_program(st, bs),
+                (
+                    store.flat_x,
+                    store.flat_y,
+                    np.zeros((cohort, st * bs), np.int32),
+                    np.zeros((cohort, st * bs), np.float32),
+                ),
+                tracer,
+            )
+        batch = ClientBatch(
+            x=np.zeros((cohort, st, bs) + feat, xdt),
+            y=np.zeros((cohort, st, bs) + lab, ydt),
+            mask=np.ones((cohort, st, bs), np.float32),
+            num_samples=np.ones((cohort,), np.float32),
+        )
+        placed = api._place_batch(batch, rng)
+        if can_vary:
+            fulls = [-(-n // bs) for n in counts]
+            variants = _class_may_pad_variants(fulls, st, bs, cohort)
+        else:
+            variants = [None]
+        for mp in variants:
+            f = variant_for(mp) if variant_for is not None else fn
+            suffix = {False: "_nopad", True: "_pad"}.get(mp, "")
+            _warm_one(
+                rows,
+                f"round_s{st}b{bs}{suffix}",
+                f,
+                (api.global_vars, *placed),
+                tracer,
+            )
 
 
 def warmup_api(api, log_fn: Optional[Callable[[dict], None]] = None) -> dict:
@@ -108,6 +287,17 @@ def warmup_api(api, log_fn: Optional[Callable[[dict], None]] = None) -> dict:
             _warm_one(
                 rows, "round_fused", fn, (api.global_vars, *rest), tracer
             )
+            # fused runs still dispatch EAGER rounds (single-round chunks
+            # at eval boundaries, class changes under vmap) — enumerate
+            # the partition's eager classes too; only chunk programs
+            # beyond this one's (length × class combinations) stay lazy
+            try:
+                _warm_partition_classes(api, rows, tracer, r0)
+            except Exception as e:  # noqa: BLE001
+                logging.warning(
+                    "shape-class pre-enumeration failed: %s", e
+                )
+                rows["compile/class_enum_error"] = f"{type(e).__name__}: {e}"
         else:
             sampled = api._round_plan(r0)[0]
             batch = api._round_batch(sampled, r0)
@@ -122,6 +312,15 @@ def warmup_api(api, log_fn: Optional[Callable[[dict], None]] = None) -> dict:
             if variant_for is not None:
                 fn = variant_for(api._round_may_pad(r0))
             _warm_one(rows, "round", fn, (api.global_vars, *placed), tracer)
+            # every OTHER shape class the partition can produce — rounds
+            # 1..R must never pay a lazy shape-bucket compile
+            try:
+                _warm_partition_classes(api, rows, tracer, r0)
+            except Exception as e:  # noqa: BLE001 — enumeration must not
+                logging.warning(  # kill the run; r0 is already warm
+                    "shape-class pre-enumeration failed: %s", e
+                )
+                rows["compile/class_enum_error"] = f"{type(e).__name__}: {e}"
         # -- eval program at the cached test-batch shapes --
         if getattr(api, "eval_fn", None) is not None and hasattr(
             api, "_eval_batches"
@@ -152,13 +351,18 @@ def warmup_local_train(
     config,
     data,
     global_vars,
-    client_ids,
+    client_ids=None,
     log_fn: Optional[Callable[[dict], None]] = None,
 ) -> dict:
     """Warm a transport federation's shared local-train program for every
-    distinct shape class among ``client_ids`` (the round-0 cohort) — the
-    warmup *barrier* that lets ``--deadline_s`` rounds start with
-    compilation already paid instead of racing a cold compile.
+    distinct shape class in the partition — the warmup *barrier* that
+    lets ``deadline_s`` rounds start with compilation already paid
+    instead of racing a cold compile, for EVERY round's cohort (the
+    pre-PR-8 version only covered round 0's, so a later round whose
+    client bucketed differently still raced a lazy compile against the
+    deadline). ``client_ids`` restricts the enumeration (legacy round-0
+    behavior); None — the default — derives the warmup set from the
+    whole partition via :func:`partition_shape_classes`.
 
     Shape classes are derived exactly the way ``LocalTrainer._train``
     derives them (``stack_clients`` of one client at the configured
@@ -167,24 +371,26 @@ def warmup_local_train(
     import jax
     import numpy as np
 
-    from fedml_tpu.data.base import bucket_steps, stack_clients
+    from fedml_tpu.data.base import stack_clients
 
     tracer = get_tracer()
     rows: dict = {}
     t0 = time.perf_counter()
-    seen = set()
+    if client_ids is None:
+        client_ids = range(data.num_clients)
+    client_ids = list(client_ids)
+    counts = [len(data.client_y[int(cid)]) for cid in client_ids]
+    classes, skipped = _classes_by_population(
+        counts, config.data.batch_size, config.data.pad_bucket
+    )
+    if skipped:
+        rows["compile/warm_classes_skipped"] = skipped
     with tracer.span("warmup", programs="local_train"):
-        for cid in client_ids:
-            n = len(data.client_y[int(cid)])
-            klass = bucket_steps(
-                [n], config.data.batch_size, config.data.pad_bucket
-            )[:2]
-            if klass in seen:
-                continue
-            seen.add(klass)
+        for (steps, bs), rep in classes:
+            cid = int(client_ids[rep])
             batch = stack_clients(
                 data,
-                [int(cid)],
+                [cid],
                 config.data.batch_size,
                 seed=0,  # values are irrelevant — only shapes enter lower()
                 pad_bucket=config.data.pad_bucket,
@@ -192,7 +398,7 @@ def warmup_local_train(
             rng = jax.random.PRNGKey(0)
             _warm_one(
                 rows,
-                f"local_train_s{klass[0]}b{klass[1]}",
+                f"local_train_s{steps}b{bs}",
                 shared_train,
                 (
                     global_vars,

@@ -203,14 +203,27 @@ class CompileConfig:
     cache_dir: str = ""
     # Only persist compiles at least this slow. The conservative 2 s
     # default matches tests/conftest.py: aggressive thresholds (0.3-0.5 s)
-    # corrupt the heap on this jaxlib (ROADMAP "compile-cache hygiene").
+    # corrupted the heap on this jaxlib under the STOCK cache (ROADMAP
+    # "compile-cache hygiene"); the hardened store tolerates 0 (the
+    # zero-cold-start CI stage runs it), but the default stays safe.
     min_compile_time_s: float = 2.0
+    # Persistent SERIALIZED-EXECUTABLE store (compile/executable_cache.py)
+    # served through the hardened store: --warmup exports every AOT
+    # executable it compiles, keyed by (program digest, shape class,
+    # environment fingerprint), and a fresh process deserializes its
+    # whole warmup set instead of compiling it — zero-cold-start serving.
+    # Version/backend/code skew lands on a different key (clean miss,
+    # recompile), never wrong numerics. "" = off.
+    executable_cache: str = ""
     # Recompile budget (fedml_tpu/analysis/sentinel.py): fail the run when
     # more than this many XLA backend compiles happen — the tripwire for
     # cache-key instabilities that silently recompile every round. Counts
-    # EVERY backend compile (including small utility programs), so budgets
-    # are coarse upper bounds asserting "no compile storm", not exact
-    # program counts. None = unlimited (no sentinel).
+    # every ACTUAL backend compile (including small utility programs —
+    # but NOT persistent-cache hits or deserialized executables, which
+    # compile nothing: a fully warm process passes budget 0, the
+    # zero-cold-start CI gate). Budgets are coarse upper bounds asserting
+    # "no compile storm", not exact program counts. None = unlimited (no
+    # sentinel).
     recompile_budget: Optional[int] = None
 
 

@@ -66,6 +66,24 @@ def bucket_steps(ns: Sequence[int], batch_size: int, pad_bucket: int):
     return steps, bs, steps * bs
 
 
+def partition_shape_classes(counts, batch_size: int, pad_bucket: int):
+    """Every (steps, bs) jit-shape class this partition can produce, as
+    ``{(steps, bs): first client index in that class}``.
+
+    A cohort's class is :func:`bucket_steps` of its members' counts, and
+    the bucket math only reads ``max(ns)`` — which is always SOME
+    client's count — so the reachable classes are exactly the per-client
+    singleton buckets. This is the warmup pre-enumeration contract
+    (compile/warmup.py): AOT-compiling the round/local-train program for
+    each class here means rounds 1..R never hit a lazy shape-bucket
+    compile, no matter which cohorts the scheduler draws."""
+    classes: Dict[tuple, int] = {}
+    for i, n in enumerate(counts):
+        klass = bucket_steps([int(n)], batch_size, pad_bucket)[:2]
+        classes.setdefault(klass, i)
+    return classes
+
+
 @dataclasses.dataclass
 class ClientBatch:
     """Dense, device-ready data for a set of sampled clients.

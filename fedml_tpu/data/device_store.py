@@ -46,15 +46,49 @@ def fits_on_device(data: FederatedDataset) -> bool:
     return total <= cap
 
 
-@jax.jit
 def _gather(flat_x, flat_y, idx, mask):
     """Gather + zero padded slots (padded indices point at row 0; zeroing
-    keeps the result bit-identical to host stack_clients, which zero-pads)."""
+    keeps the result bit-identical to host stack_clients, which zero-pads).
+    Plain traced function: the fused multi-round scan inlines it inside
+    its own program, and :func:`gather_program` wraps it (plus the
+    per-class reshape) for the eager per-round dispatch."""
     x = jnp.take(flat_x, idx, axis=0)
     y = jnp.take(flat_y, idx, axis=0)
     mx = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim))
     my = mask.reshape(mask.shape + (1,) * (y.ndim - mask.ndim))
     return x * mx.astype(x.dtype), y * my.astype(y.dtype)
+
+
+def gather_program(steps: int, bs: int):
+    """The eager round-batch program for one (steps, bs) shape class:
+    gather + zero-pad + reshape to [C, S, B, ...] as ONE ProgramCache-
+    routed jit. Routing it through the cache (instead of the bare
+    module-level jit it used to be, plus three eager reshapes) means (a)
+    the AOT warmup pre-enumeration can compile it per class up front —
+    the reshape ops were separate lazy dispatches warmup could not reach
+    — and (b) it persists through the executable cache like every other
+    round program (zero-cold-start)."""
+    from fedml_tpu.compile import get_program_cache
+
+    def builder():
+        def fn(flat_x, flat_y, idx, mask):
+            x, y = _gather(flat_x, flat_y, idx, mask)
+            C = idx.shape[0]
+            feat = flat_x.shape[1:]
+            lab = flat_y.shape[1:]
+            return (
+                x.reshape((C, steps, bs) + feat),
+                y.reshape((C, steps, bs) + lab),
+                mask.reshape((C, steps, bs)),
+            )
+
+        return jax.jit(fn)
+
+    return get_program_cache().get_or_build(
+        "device_store_gather",
+        {"kind": "device_store_gather", "steps": steps, "bs": bs},
+        builder,
+    )
 
 
 class DeviceDataStore:
@@ -118,14 +152,12 @@ class DeviceDataStore:
             client_indices, batch_size, seed=seed, pad_bucket=pad_bucket,
             shuffle=shuffle,
         )
-        C = len(client_indices)
-        mask_dev = jnp.asarray(mask)
-        x, y = _gather(self.flat_x, self.flat_y, jnp.asarray(idx), mask_dev)
-        feat = self.flat_x.shape[1:]
-        lab = self.flat_y.shape[1:]
+        x, y, mask_dev = gather_program(steps, bs)(
+            self.flat_x, self.flat_y, jnp.asarray(idx), jnp.asarray(mask)
+        )
         return ClientBatch(
-            x=x.reshape((C, steps, bs) + feat),
-            y=y.reshape((C, steps, bs) + lab),
-            mask=mask_dev.reshape((C, steps, bs)),
+            x=x,
+            y=y,
+            mask=mask_dev,
             num_samples=np.array(ns, dtype=np.float32),
         )

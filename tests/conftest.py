@@ -46,6 +46,33 @@ install_hardened_cache(
     "/tmp/fedml_tpu_jax_cache_v3", min_compile_time_secs=2.0
 )
 
+# Serialized-executable store (fedml_tpu/compile/executable_cache.py),
+# session-scoped: every AOT warmup in the suite exports its executable,
+# and any later build of the same (program digest, shape class) — another
+# test module after a cache reset, a CLI-runner run, a REPEAT pytest
+# invocation on this machine — deserializes it instead of recompiling, so
+# test modules stop re-paying each other's compiles. Safe by keying: the
+# environment fingerprint includes a content hash of the fedml_tpu
+# source, so editing ANY .py file invalidates every entry (clean miss,
+# recompile) — persisted executables can never go stale against the code.
+from fedml_tpu.compile import install_executable_cache  # noqa: E402
+
+# uid-keyed path + 0700 on creation: entries are pickles (a code-trust
+# boundary — see the executable_cache module docstring), so the session
+# store must never be a world-writable shared /tmp directory another
+# user could pre-seed.
+install_executable_cache(f"/tmp/fedml_tpu_exec_cache_v1_u{os.getuid()}")
+
+
+@pytest.fixture(scope="session")
+def executable_cache():
+    """The session's installed serialized-executable store (None when
+    this jaxlib cannot serialize AOT executables — tests that need it
+    should skip)."""
+    from fedml_tpu.compile import installed_executable_cache
+
+    return installed_executable_cache()
+
 
 @pytest.fixture(scope="session")
 def program_cache():

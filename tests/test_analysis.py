@@ -634,3 +634,108 @@ def test_backend_compile_gauge_exported():
     _force_backend_compile()
     text = get_registry().render()
     assert "fedml_compile_backend_compiles" in text
+
+
+# ---------------------------------------------------------------------------
+# digest fuzzer: auto-derived perturbation lists (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_auto_perturbations_cover_every_runconfig_leaf():
+    """Every leaf of the RunConfig dataclass tree is perturbed — either in
+    the full per-factory fan-out or in the representative-spec benign
+    list. A NEW config knob (e.g. the CompileConfig fields this PR adds)
+    is therefore audited by default, with no list to edit."""
+    from fedml_tpu.analysis.digest_audit import (
+        auto_perturbations,
+        runconfig_leaves,
+    )
+
+    fanout, benign = auto_perturbations()
+    covered = {p.field for p in fanout} | {p.field for p in benign}
+    leaves = {path for path, _ in runconfig_leaves()}
+    assert covered == leaves
+    # the zero-cold-start knobs land in the audit automatically
+    assert "compile.executable_cache" in covered
+    assert "compile.min_compile_time_s" in covered
+    # program-shaping leaves fan out over every factory, not just one
+    fan_fields = {p.field for p in fanout}
+    assert {"train.lr", "train.compute_dtype", "fed.epochs",
+            "fed.client_parallelism", "server.server_lr"} <= fan_fields
+
+
+def test_known_benign_classification_has_no_stale_entries():
+    """KNOWN_BENIGN must stay a subset of the live RunConfig tree — a
+    renamed/removed field would otherwise silently exempt nothing while
+    looking like it exempts something."""
+    from fedml_tpu.analysis.digest_audit import (
+        KNOWN_BENIGN,
+        runconfig_leaves,
+    )
+
+    leaves = {path for path, _ in runconfig_leaves()}
+    assert KNOWN_BENIGN <= leaves, sorted(KNOWN_BENIGN - leaves)
+
+
+def test_perturbed_value_changes_every_leaf():
+    """The derived perturbation value differs from the default for every
+    leaf (a no-op perturbation would audit nothing)."""
+    from fedml_tpu.analysis.digest_audit import (
+        perturbed_value,
+        runconfig_leaves,
+    )
+
+    for path, value in runconfig_leaves():
+        assert perturbed_value(path, value) != value, path
+
+
+def test_auto_perturbed_choice_fields_stay_buildable():
+    """Choice-typed leaves get a legal alternative member (an illegal
+    value would turn every audit row into 'rejected' and prove
+    nothing): the perturbed fedavg config must still build."""
+    from fedml_tpu.analysis.digest_audit import (
+        _CHOICE_VALUES,
+        base_config,
+        config_replace,
+    )
+    from fedml_tpu.config import (
+        CLIENT_OPTIMIZERS,
+        PARTITION_METHODS,
+        SERVER_OPTIMIZERS,
+    )
+
+    choices = {
+        "train.client_optimizer": CLIENT_OPTIMIZERS,
+        "server.server_optimizer": SERVER_OPTIMIZERS,
+        "data.partition_method": PARTITION_METHODS,
+        "fed.client_parallelism": ("vmap", "scan", "auto"),
+        "train.compute_dtype": ("float32", "bfloat16"),
+    }
+    cfg = base_config()
+    for path, allowed in choices.items():
+        assert _CHOICE_VALUES[path] in allowed, path
+        config_replace(cfg, path, _CHOICE_VALUES[path])  # must not raise
+
+
+def test_audit_flags_perturbation_rejected_by_every_factory():
+    """A fan-out leaf whose perturbed value is ILLEGAL everywhere (a new
+    choice-typed knob missing from _CHOICE_VALUES) must surface as a
+    violation — rejected-by-all means unaudited, the exact hole
+    auto-derivation exists to close."""
+    import dataclasses as dc
+
+    from fedml_tpu.analysis.digest_audit import (
+        Perturbation,
+        audit_all,
+        default_specs,
+    )
+
+    spec = [s for s in default_specs() if s.name == "scaffold_round"][0]
+    # scaffold's plain-SGD guard rejects momentum; as the ONLY spec in
+    # the registry that makes the field rejected-by-every-factory
+    lone = dc.replace(spec, perturbations=[Perturbation("train.momentum", 0.9)])
+    _, violations = audit_all([lone])
+    assert any(
+        v.field == "train.momentum" and "EVERY factory" in v.detail
+        for v in violations
+    ), violations

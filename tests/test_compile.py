@@ -324,7 +324,13 @@ def test_warmup_fused_chunk_memo_and_parity():
     warm = FedAvgAPI(cfg, data, model)
     warm.start_round = 1
     rows = warm.warmup(log_fn=lambda r: None)
-    assert rows.get("compile/round_fused_compile_s", 0) > 0
+    # the chunk program was warmed: either really compiled, or adopted
+    # from the session executable store (a REPEAT pytest session
+    # deserializes what the previous one exported — compile_s is then 0
+    # by contract and the _deserialized row says so)
+    assert rows.get("compile/round_fused_compile_s", 0) > 0 or rows.get(
+        "compile/round_fused_deserialized"
+    ), rows
     assert (1, 4) in warm._warm_fused  # plan memo populated by warmup...
     warm.train()
     assert not warm._warm_fused  # ...and consumed at dispatch
@@ -492,3 +498,360 @@ def test_install_run_cache_restores_previous_binding(tmp_path):
     restore()
     assert installed_cache() is prev
     assert jax.config.jax_compilation_cache_dir == prev_dir
+
+
+# ---------------------------------------------------------------------------
+# serialized executable cache: zero-cold-start persistence (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def _exec_jit():
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda v: jnp.sin(v) @ v.T)
+
+
+def _exec_prog(digest_key, pc=None):
+    pc = pc or ProgramCache()
+    return pc.get_or_build("p", {"k": digest_key}, _exec_jit), pc
+
+
+def test_executable_cache_warmup_roundtrip(tmp_path):
+    """Cold warmup compiles + persists; a FRESH program object with the
+    same canonical digest warms by DESERIALIZING (compile_s == 0), and
+    dispatches byte-identically — the executable on disk IS the one a
+    compile would have built."""
+    from fedml_tpu.compile import install_run_executable_cache
+
+    x = np.arange(36, dtype=np.float32).reshape(6, 6) / 11
+    cache, restore = install_run_executable_cache(str(tmp_path))
+    try:
+        if cache is None:
+            pytest.skip("this jaxlib cannot serialize AOT executables")
+        prog1, _ = _exec_prog("xc-roundtrip")
+        st1 = prog1.warmup(x)
+        assert st1["compile_s"] > 0 and not st1.get("deserialized")
+        assert cache.stats()["puts"] == 1
+        r1 = np.asarray(prog1(x))
+
+        prog2, pc2 = _exec_prog("xc-roundtrip")
+        st2 = prog2.warmup(x)
+        assert st2["deserialized"] is True
+        assert st2["compile_s"] == 0.0
+        assert st2["deserialize_s"] > 0
+        assert pc2.stats()["deserialize_hits"] == 1
+        np.testing.assert_array_equal(r1, np.asarray(prog2(x)))
+        # summary keys: the ProgramCache row carries the headline counters
+        row = pc2.summary_row()
+        assert row["compile/deserialize_hits"] == 1
+        assert row["compile/deserialize_s"] > 0
+    finally:
+        restore()
+
+
+def test_executable_cache_lazy_dispatch_adopts_from_disk(tmp_path):
+    """A shape class nobody warmed in THIS process still dispatches with
+    zero compiles when a predecessor persisted it: the first call per
+    signature probes the store before paying a compile."""
+    from fedml_tpu.compile import install_run_executable_cache
+
+    x = np.arange(16, dtype=np.float32).reshape(4, 4) / 7
+    cache, restore = install_run_executable_cache(str(tmp_path))
+    try:
+        if cache is None:
+            pytest.skip("this jaxlib cannot serialize AOT executables")
+        prog1, _ = _exec_prog("xc-lazy")
+        prog1.warmup(x)
+        r1 = np.asarray(prog1(x))
+        prog2, pc2 = _exec_prog("xc-lazy")
+        r2 = np.asarray(prog2(x))  # no warmup — plain dispatch
+        np.testing.assert_array_equal(r1, r2)
+        assert pc2.stats()["deserialize_hits"] == 1
+        assert prog2._aot  # adopted into the AOT dispatch map
+    finally:
+        restore()
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "bit_rot", "env_skew"])
+def test_executable_cache_poisoned_entry_quarantined_and_recompiles(
+    tmp_path, corruption
+):
+    """The three poisoning classes of the new on-disk format — torn
+    write/truncation, bit rot, and a wrong environment fingerprint
+    (version skew / a cache dir copied across machines) — must all
+    quarantine the entry and RECOMPILE to identical numerics, never
+    deserialize a wrong executable (the acceptance-criteria mirror of
+    PR 4's corrupt-entry contract)."""
+    import pickle
+
+    from fedml_tpu.compile import install_run_executable_cache
+
+    x = np.arange(25, dtype=np.float32).reshape(5, 5) / 9
+    cache, restore = install_run_executable_cache(str(tmp_path))
+    try:
+        if cache is None:
+            pytest.skip("this jaxlib cannot serialize AOT executables")
+        prog1, _ = _exec_prog("xc-poison")
+        prog1.warmup(x)
+        r1 = np.asarray(prog1(x))
+        (entry,) = tmp_path.glob("xc-*.ftpc")
+        blob = entry.read_bytes()
+        if corruption == "truncate":
+            entry.write_bytes(blob[: len(blob) // 2])
+        elif corruption == "bit_rot":
+            rot = bytearray(blob)
+            rot[-1] ^= 0xFF
+            entry.write_bytes(bytes(rot))
+        else:  # env_skew: valid frame + pickle, mismatched fingerprint
+            payload = HardenedFileCache._verify(blob)
+            doc = pickle.loads(payload)
+            doc["env"] = dict(doc["env"], jaxlib="0.0.0-skew")
+            entry.write_bytes(
+                HardenedFileCache._frame(
+                    pickle.dumps(doc, protocol=pickle.HIGHEST_PROTOCOL)
+                )
+            )
+
+        prog2, _ = _exec_prog("xc-poison")
+        st2 = prog2.warmup(x)
+        # the poisoned entry must NOT have been adopted: a real compile
+        assert not st2.get("deserialized")
+        assert st2["compile_s"] > 0
+        np.testing.assert_array_equal(r1, np.asarray(prog2(x)))
+        stats = cache.stats()
+        assert stats["quarantined"] + stats["store"]["quarantined"] >= 1
+        assert (tmp_path / "quarantine").exists()
+    finally:
+        restore()
+
+
+def test_environment_fingerprint_pins_version_and_code():
+    """The fingerprint carries everything that must match for a persisted
+    executable to be safe here — jax/jaxlib versions, backend, topology,
+    lowering-relevant flags, and a hash of the package source (a code
+    edit must invalidate every entry)."""
+    from fedml_tpu.compile import environment_fingerprint
+
+    env = environment_fingerprint()
+    for key in ("jax", "jaxlib", "backend", "device_kind", "device_count",
+                "threefry_partitionable", "xla_flags", "code"):
+        assert key in env, key
+    assert len(env["code"]) == 64  # sha256 over the package source
+    assert env == environment_fingerprint()  # stable within a process
+
+
+def test_executable_cache_key_separates_environments(tmp_path):
+    """Environment skew lands on a DIFFERENT key — a cache dir shared by
+    two jaxlib versions never even reads the other's entries."""
+    from fedml_tpu.compile.executable_cache import ExecutableCache
+
+    c1 = ExecutableCache(str(tmp_path))
+    c2 = ExecutableCache(str(tmp_path))
+    sig = (("treedef"), ((4, 4), "float32"))
+    k1 = c1.key_for("d" * 64, sig)
+    c2._env_doc = dict(c1._env() or {}, jaxlib="0.0.0-skew")
+    assert c2.key_for("d" * 64, sig) != k1
+    assert c1.key_for("d" * 64, sig) == k1  # deterministic
+
+
+def test_wrap_uncached_programs_never_persist(tmp_path):
+    """Opaque (bypassed) programs have no canonical digest — they must
+    not enter the executable store (an over-merged key would be silent
+    wrong numerics, exactly the class the digest discipline exists
+    for)."""
+    from fedml_tpu.compile import install_run_executable_cache
+
+    x = np.ones((4,), np.float32)
+    cache, restore = install_run_executable_cache(str(tmp_path))
+    try:
+        if cache is None:
+            pytest.skip("this jaxlib cannot serialize AOT executables")
+        prog = ProgramCache().wrap_uncached("opaque", _exec_jit())
+        prog.warmup(np.ones((2, 2), np.float32))
+        _ = prog(np.ones((2, 2), np.float32))
+        assert cache.stats()["puts"] == 0
+        assert not list(tmp_path.glob("xc-*.ftpc"))
+    finally:
+        restore()
+
+
+# ---------------------------------------------------------------------------
+# shape-class pre-enumeration: no lazy compiles after round 0 (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def _multiclass_data(sizes=(8, 33, 90)):
+    """A partition spanning len(sizes) distinct bucket_steps classes at
+    batch_size=8 (steps 1 / 8 / 16 — pinned below)."""
+    rng = np.random.default_rng(0)
+    from fedml_tpu.data.base import FederatedDataset
+
+    return FederatedDataset(
+        name="multiclass",
+        client_x=[rng.normal(size=(n, 5)).astype(np.float32) for n in sizes],
+        client_y=[rng.integers(0, 3, size=(n,)).astype(np.int32) for n in sizes],
+        test_x=rng.normal(size=(20, 5)).astype(np.float32),
+        test_y=rng.integers(0, 3, size=(20,)).astype(np.int32),
+        num_classes=3,
+    )
+
+
+def _multiclass_cfg():
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=3, client_num_per_round=1, comm_round=8,
+            epochs=1, frequency_of_the_test=1,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+
+
+def test_partition_shape_classes_enumerates_singleton_buckets():
+    from fedml_tpu.data.base import partition_shape_classes
+
+    classes = partition_shape_classes([8, 33, 90], 8, 1)
+    assert set(classes) == {(1, 8), (8, 8), (16, 8)}
+    assert classes[(1, 8)] == 0 and classes[(16, 8)] == 2
+
+
+@pytest.fixture
+def warmed_multiclass_api(program_cache):
+    """A warmed API over a >=3-shape-class partition, plus a completed
+    cold run of the identical config — so every utility program (metric
+    packing, RNG folds, the flush concat) is already compiled and the
+    recompile budget below measures EXACTLY the lazy shape-bucket
+    compiles warmup is supposed to have eliminated."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    data, cfg = _multiclass_data(), _multiclass_cfg()
+    model = _model()
+    cold = FedAvgAPI(cfg, data, model)
+    cold.train()
+    # sanity: the round-seeded draws really visit all three classes
+    visited = {cold._round_plan(r)[0][0] for r in range(cfg.fed.comm_round)}
+    assert visited == {0, 1, 2}, visited
+    warm = FedAvgAPI(cfg, data, model)
+    rows = warm.warmup(log_fn=lambda r: None)
+    # the warmup set was derived from the PARTITION, not round 0's cohort
+    for klass in ("s1b8", "s8b8", "s16b8"):
+        assert f"compile/round_{klass}_compile_s" in rows, sorted(rows)
+    return cold, warm
+
+
+@pytest.mark.recompile_budget(0)
+def test_no_lazy_shape_bucket_compiles_after_warmup(
+    warmed_multiclass_api, recompile_sentinel
+):
+    """ISSUE 8 acceptance: a multi-round run whose client sizes span >= 3
+    bucket_steps classes runs with a post-warmup recompile budget of ZERO
+    — rounds 1..R never hit a lazy shape-bucket compile (the fixture runs
+    before the sentinel starts, so the budget window is exactly
+    post-warmup) — and stays byte-identical to the cold run."""
+    cold, warm = warmed_multiclass_api
+    warm.train()
+    _tree_equal(cold.global_vars, warm.global_vars)
+
+
+def test_warmup_local_train_covers_whole_partition():
+    """The transport warmup barrier enumerates every shape class in the
+    partition (client_ids=None default), not just round 0's cohort — a
+    later round's differently-bucketed client must not race a lazy
+    compile against the deadline."""
+    from fedml_tpu.compile import warmup_local_train
+    from fedml_tpu.algorithms.fedavg_transport import shared_local_train
+
+    data, cfg = _multiclass_data(), _multiclass_cfg()
+    model = _model()
+    gv = model.init(__import__("jax").random.PRNGKey(0))
+    rows = warmup_local_train(
+        shared_local_train(model, cfg, "classification"), cfg, data, gv
+    )
+    labels = {k for k in rows if k.startswith("compile/local_train_s")}
+    assert {
+        "compile/local_train_s1b8_compile_s",
+        "compile/local_train_s8b8_compile_s",
+        "compile/local_train_s16b8_compile_s",
+    } <= labels, sorted(labels)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: zero-cold-start across REAL process boundaries (subprocesses)
+# ---------------------------------------------------------------------------
+
+_XC_E2E_PROG = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from fedml_tpu.compile import ProgramCache, install_executable_cache
+from fedml_tpu.analysis.sentinel import RecompileSentinel
+cache = install_executable_cache(sys.argv[1])
+if cache is None:
+    print(json.dumps({"unsupported": True})); raise SystemExit(0)
+s = RecompileSentinel().start()
+pc = ProgramCache()
+prog = pc.get_or_build(
+    "p", {"k": "xc-e2e"}, lambda: jax.jit(lambda v: jnp.sin(v) @ v.T)
+)
+x = np.arange(64 * 64, dtype=np.float32).reshape(64, 64) / 4096.0
+st = prog.warmup(x)
+r = np.asarray(prog(x))
+s.stop()
+print(json.dumps({
+    "stats": cache.stats(), "deserialized": bool(st.get("deserialized")),
+    "recompiles": s.recompiles(), "sum": float(r.sum()),
+}))
+"""
+
+
+def _run_xc_e2e(cache_dir):
+    out = subprocess.run(
+        [sys.executable, "-c", _XC_E2E_PROG, str(cache_dir)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_e2e_executable_cache_zero_cold_start_and_poison_recovery(tmp_path):
+    """Three fresh processes over one executable-cache dir: (1) cold
+    warmup compiles + persists; (2) a FRESH PROCESS deserializes instead
+    of compiling — zero backend compiles, identical numerics (the
+    zero-cold-start contract); (3) after on-disk corruption the loader
+    quarantines and recompiles to the same numerics — never a wrong
+    executable."""
+    r1 = _run_xc_e2e(tmp_path)
+    if r1.get("unsupported"):
+        pytest.skip("this jaxlib cannot serialize AOT executables")
+    assert r1["stats"]["puts"] >= 1 and not r1["deserialized"]
+    assert r1["recompiles"] >= 1
+    r2 = _run_xc_e2e(tmp_path)
+    assert r2["deserialized"] is True
+    assert r2["stats"]["hits"] >= 1
+    assert r2["recompiles"] == 0, r2
+    assert r2["sum"] == r1["sum"]
+    for p in pathlib.Path(tmp_path).glob("xc-*.ftpc"):
+        blob = p.read_bytes()
+        p.write_bytes(blob[: len(blob) // 2])
+    r3 = _run_xc_e2e(tmp_path)
+    assert not r3["deserialized"]
+    assert r3["stats"]["quarantined"] + r3["stats"]["store"]["quarantined"] >= 1
+    assert r3["sum"] == r1["sum"]
+
+
+def test_class_enumeration_skips_unreachable_classes():
+    """A class whose bucket has fewer clients at-or-below it than the
+    cohort size can never be a cohort max (sampling without replacement)
+    — warmup must not waste compiles and cache entries on it; a
+    shrinkable (cohort=1) enumeration keeps it."""
+    from fedml_tpu.compile.warmup import _classes_by_population
+
+    counts = [8, 100, 100, 100]
+    full, _ = _classes_by_population(counts, 8, 1, cohort=4)
+    assert (1, 8) not in dict(full)           # unreachable at cohort 4
+    assert len(full) == 1                      # only the 100-sample class
+    single, _ = _classes_by_population(counts, 8, 1, cohort=1)
+    assert (1, 8) in dict(single)              # reachable as a singleton
