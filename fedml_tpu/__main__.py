@@ -1,3 +1,15 @@
-from fedml_tpu.cli import main
+import sys
 
-main()
+# `python -m fedml_tpu serve ...` — the multi-tenant service subcommand
+# (fedml_tpu/serve/). Dispatched here by argv inspection so the single-run
+# surface stays exactly `python -m fedml_tpu --algorithm ...` (turning the
+# CLI into a click group would have broken every existing invocation).
+if len(sys.argv) > 1 and sys.argv[1] == "serve":
+    from fedml_tpu.serve.cli import serve_main
+
+    del sys.argv[1]
+    serve_main()
+else:
+    from fedml_tpu.cli import main
+
+    main()

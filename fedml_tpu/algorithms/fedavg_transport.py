@@ -270,6 +270,13 @@ class FedAvgServerManager(ServerManager):
         )
         self._stall_last_count = -1
         self._stall_strikes = 0
+        # graceful per-tenant drain (fedml_tpu/serve/): when set, the
+        # round that is currently open completes normally and the
+        # federation then FINISHes instead of broadcasting the next round;
+        # _federation_done marks the FINISH having happened, so a late
+        # request_stop cannot fabricate an extra zero-upload round
+        self._stop_requested = False
+        self._federation_done = False
         self.abandoned_rounds = 0
         self.dropped_uploads = 0  # late round-tagged uploads discarded
         self._dead_workers: set = set()  # peers whose broadcasts failed
@@ -316,6 +323,26 @@ class FedAvgServerManager(ServerManager):
         self.health.detach()
         super().finish()
 
+    def request_stop(self, drain: bool = True) -> None:
+        """Graceful per-tenant stop (fedml_tpu/serve/): ``drain=True``
+        lets the currently-open round complete (its cohort's work is not
+        thrown away) and FINISHes the fleet instead of broadcasting the
+        next round; ``drain=False`` additionally closes the open round
+        immediately with whatever uploads have arrived (the zero-upload
+        carry-over path applies — the model survives unchanged). Safe
+        from any thread EXCEPT this server's own message handlers (it
+        takes the round lock); handlers set ``_stop_requested`` directly
+        instead."""
+        self._stop_requested = True
+        if drain:
+            return
+        with self._round_lock:
+            # a federation that already FINISHed (naturally or via an
+            # earlier stop) has no open round: completing again would log
+            # a spurious zero-upload row and re-broadcast FINISH
+            if not self._federation_done:
+                self._complete_round()
+
     def _broadcast(self, msg: Message) -> bool:
         """Send a server->client message, tolerating a dead peer: a client
         process that crashed mid-federation must not take the server FSM
@@ -346,16 +373,21 @@ class FedAvgServerManager(ServerManager):
             return False
 
     def send_init_msg(self):
-        """Sample round-0 clients, broadcast w0 (ref send_init_msg :20-28)."""
+        """Sample the opening round's clients, broadcast the model (ref
+        send_init_msg :20-28). The opening round is ``self.round_idx`` —
+        0 unless a session resume poured a checkpoint in first
+        (fedml_tpu/serve/session.py), in which case the scheduler's
+        restored memo re-selects the in-flight cohort byte-identically."""
         self._t0 = time.monotonic()
-        sampled = self.scheduler.select(0, k=self.worker_num)
-        self._round_span = self._tracer.start_span("round", round=0)
-        with self._tracer.span("broadcast", round=0):
+        r = self.round_idx
+        sampled = self.scheduler.select(r, k=self.worker_num)
+        self._round_span = self._tracer.start_span("round", round=r)
+        with self._tracer.span("broadcast", round=r):
             for worker, client_idx in enumerate(sampled, start=1):
                 msg = Message(MT.S2C_INIT_CONFIG, 0, worker)
                 msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
                 msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
-                msg.add_params(MT.ARG_ROUND_IDX, 0)
+                msg.add_params(MT.ARG_ROUND_IDX, r)
                 self._assigned[worker] = (int(client_idx), time.monotonic())
                 self._broadcast(msg)
         self._arm_deadline()
@@ -765,7 +797,8 @@ class FedAvgServerManager(ServerManager):
             self._round_span.end()
             self._round_span = None
         self.round_idx += 1
-        if self.round_idx >= self.config.fed.comm_round:
+        if self.round_idx >= self.config.fed.comm_round or self._stop_requested:
+            self._federation_done = True
             for worker in range(1, self.worker_num + 1):
                 self._broadcast(Message(MT.FINISH, 0, worker))
             self.finish()
@@ -975,118 +1008,27 @@ def run_federation(
     — the warmup barrier that lets ``deadline_s`` rounds begin with
     compilation already paid instead of racing a cold compile, in every
     round (not just round 0 — partition_shape_classes in data/base.py is
-    the enumeration contract)."""
-    from fedml_tpu.scheduler import FaultInjector, overprovisioned_k
+    the enumeration contract).
 
-    K = overprovisioned_k(
-        config.fed.client_num_per_round,
-        config.fed.overprovision_factor,
-        config.fed.client_num_in_total,
-    )
-    injector = FaultInjector.from_config(config, tracer=get_tracer())
-    if (
-        injector is not None
-        and injector.plan.has_participation_faults()
-        and not config.fed.deadline_s
-    ):
-        raise ValueError(
-            "fault_plan can drop uploads (dropout_p/crash_at_round) but "
-            "deadline_s is 0: the server's all-received barrier would "
-            "wait forever — set FedConfig.deadline_s/min_clients"
-        )
-    server = FedAvgServerManager(
+    This is now a thin blocking wrapper over
+    :class:`fedml_tpu.serve.FedSession` — the long-lived multi-tenant
+    service runs N of these sessions concurrently in one process; this
+    entry point keeps the classic one-shot semantics (and, having no
+    TelemetryScope of its own, the process-global telemetry) intact."""
+    from fedml_tpu.serve.session import FedSession
+
+    return FedSession(
         config,
-        comm_factory(0),
+        data,
         model,
-        data=data,
+        algorithm="fedavg",
+        comm_factory=comm_factory,
         task=task,
-        worker_num=K,
         log_fn=log_fn,
+        trainer_factory=trainer_factory,
         server_opt=server_opt,
-        faults=injector,
-    )
-    if injector is not None:
-        # the injector predates the server (the server's stall valve reads
-        # its plan); point its fault accounting at the server's registry
-        injector.health = server.health
-    shared_train = shared_local_train(model, config, task)
-    if warmup and trainer_factory is None:
-        from fedml_tpu.compile import warmup_local_train
-
-        warmup_local_train(
-            shared_train,
-            config,
-            data,
-            server.global_vars,
-            # client_ids=None: warm every shape class the PARTITION can
-            # produce, not just round 0's cohort — later rounds' cohorts
-            # must never race a lazy shape-bucket compile against the
-            # deadline (the round-0-only coverage this replaces)
-            log_fn=log_fn,
-        )
-    make_trainer = trainer_factory or (
-        lambda rank: LocalTrainer(
-            config, data, model, task, local_train_fn=shared_train
-        )
-    )
-    # one shared error-feedback store: residuals are keyed by client id and
-    # the sampler re-assigns clients to ranks each round
-    from fedml_tpu.core.compression import TopKErrorFeedback
-
-    shared_ef = TopKErrorFeedback.maybe_from_config(config.comm)
-    if shared_ef is not None and config.fed.deadline_s:
-        # depth guard (not just a CLI nicety): a quorum round can discard a
-        # late upload AFTER the client cleared its residual — that mass
-        # would be permanently lost
-        raise ValueError(
-            "error_feedback cannot be combined with deadline_s quorum "
-            "rounds: a dropped late upload loses residual-cleared mass"
-        )
-    clients = [
-        FedAvgClientManager(
-            config, comm_factory(rank), rank, make_trainer(rank),
-            ef=shared_ef, faults=injector,
-        )
-        for rank in range(1, K + 1)
-    ]
-    errors: List[BaseException] = []
-
-    def guarded_run(c):
-        # A dead client would stall the server's all-received barrier
-        # forever; surface the failure by stopping the server loop.
-        try:
-            c.run()
-        except BaseException as e:  # noqa: BLE001
-            errors.append(e)
-            server.finish()
-
-    threads = [
-        threading.Thread(target=guarded_run, args=(c,), daemon=True)
-        for c in clients
-    ]
-    for t in threads:
-        t.start()
-    server.send_init_msg()
-    server.run()  # blocks until FINISH or a client failure stops the loop
-    if getattr(server, "deadline_error", None) is not None:
-        for c in clients:
-            c.finish()
-        raise RuntimeError("server deadline path failed") from server.deadline_error
-    if errors:
-        # release the surviving client threads before raising — they would
-        # otherwise park on inbox.get() for the process lifetime.
-        for c in clients:
-            c.finish()
-        raise RuntimeError("client actor failed") from errors[0]
-    for t in threads:
-        t.join(timeout=60)
-        if t.is_alive():
-            raise RuntimeError("client thread failed to finish")
-    if injector is not None:
-        # run-level fault accounting into the metrics stream (summary.json
-        # records the injected faults — the CI oracle contract)
-        server.log_fn(injector.summary_row())
-    return server
+        warmup=warmup,
+    ).run()
 
 
 def run_loopback_federation(
@@ -1121,10 +1063,15 @@ def run_shm_federation(
     sock_dir: Optional[str] = None,
     server_opt: bool = False,
     warmup: bool = False,
+    namespace: str = "",
 ):
     """Federation over the shared-memory local transport (TRPC-equivalent,
     ref trpc_comm_manager.py:25-114): bulk tensors ride POSIX shared memory,
-    only tiny control records cross the per-rank UNIX sockets."""
+    only tiny control records cross the per-rank UNIX sockets.
+
+    ``namespace`` prefixes the socket names — REQUIRED to be unique per
+    federation when two concurrent runs share an explicit ``sock_dir``
+    (the serve path's sessions generate their own; see ShmCommManager)."""
     import tempfile
 
     from fedml_tpu.core.shm_comm import ShmCommManager
@@ -1134,7 +1081,9 @@ def run_shm_federation(
             config,
             data,
             model,
-            lambda rank: ShmCommManager(rank, sock_dir or d),
+            lambda rank: ShmCommManager(
+                rank, sock_dir or d, namespace=namespace
+            ),
             task=task,
             log_fn=log_fn,
             server_opt=server_opt,

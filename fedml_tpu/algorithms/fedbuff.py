@@ -55,7 +55,7 @@ from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message, MessageType as MT
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.models import ModelDef
-from fedml_tpu.algorithms.fedavg_transport import LocalTrainer, shared_local_train
+from fedml_tpu.algorithms.fedavg_transport import LocalTrainer
 from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
 from fedml_tpu.train.evaluate import evaluate, make_eval_fn
 
@@ -96,6 +96,7 @@ class FedBuffServerManager(ServerManager):
         task: str = "classification",
         worker_num: Optional[int] = None,
         log_fn=None,
+        max_workers: Optional[int] = None,
     ):
         super().__init__(comm, rank=0)
         if config.fed.async_buffer_k <= 0:
@@ -106,6 +107,26 @@ class FedBuffServerManager(ServerManager):
         self.task = task
         self.log_fn = log_fn or (lambda m: None)
         self.worker_num = worker_num or config.fed.client_num_per_round
+        # Elastic-fleet cap (fedml_tpu/serve/): C2S_JOIN from a rank
+        # beyond the current fleet is accepted while the live worker
+        # count is below this, refused with FINISH past it (backpressure
+        # — the join is the admission point, so an over-subscribed tenant
+        # sheds load at the door instead of queueing unbounded
+        # assignments). None = the initial fleet is also the cap.
+        self.max_workers = (
+            int(max_workers) if max_workers is not None else self.worker_num
+        )
+        self.joins_accepted = 0
+        self.joins_refused = 0
+        self.leaves = 0
+        # graceful stop (serve drain semantics, docs/SERVING.md): when
+        # set, the next upload path shuts the federation down — after a
+        # final flush of the partial buffer when _drain_on_stop (buffered
+        # client work becomes one last server step), discarding it
+        # otherwise. Handlers may set the flags directly; request_stop()
+        # additionally applies them inline when called from outside.
+        self._stop_requested = False
+        self._drain_on_stop = True
         self.version = 0  # server model version t
         self.server_steps = 0  # buffer flushes so far
         self._dispatch_counter = 0
@@ -222,10 +243,123 @@ class FedBuffServerManager(ServerManager):
         self.register_message_receive_handler(
             MT.C2S_SEND_MODEL, self._on_delta_from_client
         )
+        self.register_message_receive_handler(MT.C2S_JOIN, self._on_join)
+        self.register_message_receive_handler(MT.C2S_LEAVE, self._on_leave)
 
     def finish(self):
         self.health.detach()  # see FedAvgServerManager.finish
         super().finish()
+
+    # -- elastic fleet membership (fedml_tpu/serve/) --
+    def _live_worker_count(self) -> int:
+        """Caller holds _lock."""
+        dead = sum(1 for w in self._dead_workers if 1 <= w <= self.worker_num)
+        return self.worker_num - dead
+
+    def _on_join(self, msg: Message):
+        with self._lock:
+            sender = msg.get_sender_id()
+            if self._finished:
+                # late joiner against a drained tenant: answer FINISH so
+                # the worker exits instead of parking on its inbox
+                try:
+                    self.send_message(Message(MT.FINISH, 0, sender))
+                except Exception:  # noqa: BLE001 — dead peer
+                    pass
+                return
+            alive = (
+                sender <= self.worker_num and sender not in self._dead_workers
+            )
+            if not alive and self._live_worker_count() >= self.max_workers:
+                # backpressure: the fleet is at capacity — refuse at the
+                # door (FINISH) rather than admit a worker whose uploads
+                # would only deepen the staleness tail. The refused rank
+                # is recorded dead FIRST (same lock, ordered before the
+                # counter an unlocked observer may poll): if a later
+                # admission grows worker_num past it, _live_worker_count
+                # must not count this never-admitted phantom as live.
+                self._dead_workers.add(sender)
+                self.joins_refused += 1
+                logging.info(
+                    "join from rank %d refused: fleet at max_workers=%d",
+                    sender, self.max_workers,
+                )
+                try:
+                    self.send_message(Message(MT.FINISH, 0, sender))
+                except Exception:  # noqa: BLE001 — dead peer
+                    pass
+                return
+            self._dead_workers.discard(sender)
+            self.worker_num = max(self.worker_num, sender)
+            self.joins_accepted += 1
+            self._dispatch(sender)
+
+    def _on_leave(self, msg: Message):
+        with self._lock:
+            sender = msg.get_sender_id()
+            # no more dispatches to this rank: mark it dead (a later JOIN
+            # from the same rank revives it) and forget its outstanding
+            # assignment — async has no barrier, the assignment simply
+            # evaporates and the next upload from anyone refills the buffer
+            self._dead_workers.add(sender)
+            self._outstanding.pop(sender, None)
+            self._dispatch_times.pop(sender, None)
+            self.leaves += 1
+
+    # -- graceful stop / rolling-checkpoint surface (fedml_tpu/serve/) --
+    def _shutdown(self):
+        """FINISH the fleet and stop this server's loop. Caller holds
+        _lock (or is the constructor-less starvation path, same thread)."""
+        self._finished = True
+        for worker in range(1, self.worker_num + 1):
+            if worker in self._dead_workers:
+                continue
+            try:
+                self.send_message(Message(MT.FINISH, 0, worker))
+            except Exception:  # noqa: BLE001 — dead peer at shutdown
+                pass
+        self.finish()
+
+    def request_stop(self, drain: bool = True, defer: bool = False) -> None:
+        """Stop this tenant: ``drain=True`` flushes whatever deltas are
+        buffered as one final (partial) server step before FINISHing the
+        fleet — buffered client work is never thrown away; ``drain=False``
+        discards the buffer. ``defer=True`` only sets the flags (safe
+        from inside this server's own handlers — e.g. a rolling-
+        checkpoint log_fn stopping the session at a chosen step); the
+        next upload applies them. In-flight local trainings are answered
+        by the FINISH already in each worker's inbox."""
+        self._drain_on_stop = bool(drain)
+        self._stop_requested = True
+        if defer:
+            return
+        with self._lock:
+            if self._finished:
+                return
+            if self._drain_on_stop and self._buffer:
+                self._flush()
+            if not self._finished:
+                self._shutdown()
+
+    def checkpoint_state(self) -> dict:
+        """The server's algorithm-private state for the checkpoint
+        ``algo`` slot (utils/checkpoint.py): model version, step count,
+        and the dispatch counter. The dispatch stream is pure in
+        (seed, counter), so a resumed session re-mints the in-flight
+        assignments byte-identically — the async analog of the sync
+        scheduler's selection memo. Rolling checkpoints are taken at
+        flush boundaries (the buffer is empty when log_fn runs), so no
+        buffered deltas need persisting."""
+        return {
+            "version": np.asarray(self.version, np.int64),
+            "server_steps": np.asarray(self.server_steps, np.int64),
+            "dispatch_counter": np.asarray(self._dispatch_counter, np.int64),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self.version = int(np.asarray(state["version"]))
+        self.server_steps = int(np.asarray(state["server_steps"]))
+        self._dispatch_counter = int(np.asarray(state["dispatch_counter"]))
 
     # -- aggregation --
     def _on_delta_from_client(self, msg: Message):
@@ -258,13 +392,13 @@ class FedBuffServerManager(ServerManager):
                         self._decline_streak,
                     )
                     self.fault_starved = True
-                    self._finished = True
-                    for worker in range(1, self.worker_num + 1):
-                        try:
-                            self.send_message(Message(MT.FINISH, 0, worker))
-                        except Exception:  # noqa: BLE001 — dead peer
-                            pass
-                    self.finish()
+                    self._shutdown()
+                    return
+                if self._stop_requested:
+                    if self._drain_on_stop and self._buffer:
+                        self._flush()
+                    if not self._finished:
+                        self._shutdown()
                     return
                 self._dispatch(sender)
                 return
@@ -313,6 +447,16 @@ class FedBuffServerManager(ServerManager):
             self.staleness_seen.append(tau)
             if len(self._buffer) >= self.config.fed.async_buffer_k:
                 self._flush()
+            if self._stop_requested and not self._finished:
+                # deferred stop (request_stop(defer=True), e.g. a rolling-
+                # checkpoint log_fn killing the session at a chosen step):
+                # drain flushes the partial buffer as one last step,
+                # hard-stop discards it; either way the fleet FINISHes now
+                if self._drain_on_stop and self._buffer:
+                    self._flush()
+                if not self._finished:
+                    self._shutdown()
+                return
             if not self._finished:
                 self._dispatch(msg.get_sender_id())
 
@@ -373,13 +517,7 @@ class FedBuffServerManager(ServerManager):
                     "staleness_hist": {str(k): v for k, v in sorted(hist.items())},
                 }
             )
-            self._finished = True
-            for worker in range(1, self.worker_num + 1):
-                try:
-                    self.send_message(Message(MT.FINISH, 0, worker))
-                except Exception:  # noqa: BLE001 — dead peer at shutdown
-                    pass
-            self.finish()
+            self._shutdown()
 
 
 class FedBuffClientManager(ClientManager):
@@ -423,6 +561,14 @@ class FedBuffClientManager(ClientManager):
         if orphan_deadline_s is not None:
             self.ORPHAN_DEADLINE_S = float(orphan_deadline_s)
         self._got_finish = False
+        # graceful leave (fedml_tpu/serve/ elastic fleets): when set, the
+        # NEXT dispatch is answered with C2S_LEAVE instead of training —
+        # the server stops dispatching to this rank and this worker's
+        # receive loop ends. Leaving on a dispatch boundary (not mid-
+        # train) keeps the protocol simple: the worker never abandons an
+        # upload the server is accounting for.
+        self._leave_requested = False
+        self.left = False
         # assignment dedupe: the server restates a worker's OUTSTANDING
         # assignment when it sees a duplicate upload (at-least-once
         # recovery). If this worker already handled that tag, the restated
@@ -486,8 +632,23 @@ class FedBuffClientManager(ClientManager):
         )
         self.finish()
 
+    def request_leave(self) -> None:
+        """Ask this worker to leave the fleet at its next dispatch (see
+        ``_leave_requested``). Safe from any thread."""
+        self._leave_requested = True
+
     def _on_model(self, msg: Message):
         self._disarm_liveness()
+        if self._leave_requested:
+            out = Message(MT.C2S_LEAVE, self.rank, 0)
+            out.add_params(MT.ARG_ROUND_IDX, int(msg.get(MT.ARG_ROUND_IDX)))
+            try:
+                self.send_message(out)
+            except Exception:  # noqa: BLE001 — a dead server can't
+                pass  # object to us leaving
+            self.left = True
+            self.finish()
+            return
         tag = int(msg.get(MT.ARG_ROUND_IDX))
         if tag == self._last_handled_tag:
             # restated assignment we already completed (see above) — but
@@ -597,84 +758,24 @@ def run_fedbuff_federation(
 ):
     """One-process async federation: 1 server + worker_num client actors in
     threads over any BaseCommManager (structure mirrors
-    fedavg_transport.run_federation)."""
-    from fedml_tpu.telemetry import get_tracer as _get_tracer
-    from fedml_tpu.scheduler import FaultInjector
+    fedavg_transport.run_federation).
 
-    K = config.fed.client_num_per_round
-    server = FedBuffServerManager(
-        config, comm_factory(0), model, data=data, task=task,
-        worker_num=K, log_fn=log_fn,
-    )
-    injector = FaultInjector.from_config(
-        config, health=server.health, tracer=_get_tracer()
-    )
-    # THE shared transport local-train program (fedavg_transport): deduped
-    # through the ProgramCache, so a fedbuff fleet shares the sync
-    # transports' compile instead of jitting its own throwaway copy
-    # (fedlint uncached-jit caught the bare jax.jit that used to be here)
-    shared_train = shared_local_train(model, config, task)
-    clients = [
-        FedBuffClientManager(
-            config,
-            comm_factory(rank),
-            rank,
-            LocalTrainer(config, data, model, task, local_train_fn=shared_train),
-            faults=injector,
-        )
-        for rank in range(1, K + 1)
-    ]
-    errors: List[BaseException] = []
+    Thin blocking wrapper over :class:`fedml_tpu.serve.FedSession` — the
+    long-lived service (fedml_tpu/serve/) runs N FedBuff sessions
+    concurrently with elastic join/leave and rolling checkpoints; this
+    entry point keeps the classic run-to-completion semantics (and the
+    process-global telemetry) intact."""
+    from fedml_tpu.serve.session import FedSession
 
-    def guarded_run(c):
-        try:
-            c.run()
-        except BaseException as e:  # noqa: BLE001
-            errors.append(e)
-            server.finish()
-
-    threads = [
-        threading.Thread(target=guarded_run, args=(c,), daemon=True)
-        for c in clients
-    ]
-    for t in threads:
-        t.start()
-    server.send_init_msg()
-    server.run()  # blocks until the last server step or a client failure
-    if errors:
-        for c in clients:
-            c.finish()
-        raise RuntimeError("async client actor failed") from errors[0]
-    for c in clients:
-        c.finish()  # idempotent: unblocks any worker still parked on its inbox
-    for t in threads:
-        t.join(timeout=60)
-        if t.is_alive():
-            raise RuntimeError("async client thread failed to finish")
-    orphans = [c.rank for c in clients if c.orphaned]
-    if server.fault_starved:
-        raise RuntimeError(
-            "fedbuff fault plan starved the delta buffer: every client "
-            "appears crashed/dropped, the run cannot reach its step count "
-            "(fix the plan or lower async_buffer_k)"
-        )
-    if orphans and server.server_steps < config.fed.comm_round:
-        # orphaned workers AND an incomplete run: the failure is real
-        raise RuntimeError(
-            f"async workers {orphans} were orphaned (server unreachable, "
-            "no FINISH) — federation did not terminate cleanly"
-        )
-    if orphans:
-        # the run COMPLETED — a worker that lost contact mid-run and timed
-        # out is a degraded participant, not a failed federation
-        logging.warning(
-            "async federation completed all %d steps but workers %s went "
-            "orphaned along the way (transient upload failures)",
-            server.server_steps, orphans,
-        )
-    if injector is not None:
-        server.log_fn(injector.summary_row())
-    return server
+    return FedSession(
+        config,
+        data,
+        model,
+        algorithm="fedbuff",
+        comm_factory=comm_factory,
+        task=task,
+        log_fn=log_fn,
+    ).run()
 
 
 def run_fedbuff_loopback(
@@ -698,17 +799,21 @@ def run_fedbuff_shm(
     task: str = "classification",
     log_fn=None,
     sock_dir: Optional[str] = None,
+    namespace: str = "",
 ):
     """Async federation over the shared-memory local transport (the
     TRPC-slot backend, core/shm_comm.py) — the protocol is comm-agnostic,
-    so the runner only swaps the factory."""
+    so the runner only swaps the factory. ``namespace`` disambiguates
+    socket names when concurrent federations share an explicit
+    ``sock_dir`` (see ShmCommManager)."""
     import tempfile
 
     from fedml_tpu.core.shm_comm import ShmCommManager
 
     def run(d):
         return run_fedbuff_federation(
-            config, data, model, lambda rank: ShmCommManager(rank, d),
+            config, data, model,
+            lambda rank: ShmCommManager(rank, d, namespace=namespace),
             task=task, log_fn=log_fn,
         )
 

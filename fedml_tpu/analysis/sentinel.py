@@ -63,13 +63,24 @@ def _on_jax_event(name: str, secs: float, **kw) -> None:
     global _backend_compiles
     if not name.endswith(_BACKEND_EVENT_SUFFIX):
         return
+    # per-tenant attribution (fedml_tpu/serve/): jax.monitoring fires on
+    # the COMPILING thread, so the telemetry scope active there names the
+    # tenant whose dispatch triggered this compile — the counter a
+    # co-tenant session's compile/recompiles == 0 gate reads
+    from fedml_tpu.telemetry.scope import current_scope
+
+    sc = current_scope()
     with _lock:
         _backend_compiles += 1
         total = _backend_compiles
+        if sc is not None:
+            sc.backend_compiles += 1
     try:
-        from fedml_tpu.telemetry import get_registry
+        from fedml_tpu.telemetry import get_global_registry
 
-        get_registry().gauge(
+        # process total → the GLOBAL registry always (a tenant registry
+        # must not carry a process-wide gauge under a tenant label)
+        get_global_registry().gauge(
             "fedml_compile_backend_compiles",
             "XLA backend compilations observed in this process",
         ).set(total)
@@ -81,8 +92,13 @@ def _on_jax_plain_event(name: str, **kw) -> None:
     global _cache_hits
     if name != _CACHE_HIT_EVENT:
         return
+    from fedml_tpu.telemetry.scope import current_scope
+
+    sc = current_scope()
     with _lock:
         _cache_hits += 1
+        if sc is not None:
+            sc.persistent_cache_hits += 1
 
 
 def ensure_backend_listener() -> bool:

@@ -306,7 +306,13 @@ class ProgramCache:
         live, not only visible in the end-of-run summary.json row."""
         try:
             snap = self.stats()
-            reg = get_registry()
+            # the ProgramCache is process-wide by design (co-tenant
+            # federations share programs), so its gauges publish into the
+            # GLOBAL registry even on a tenant-scoped thread — a tenant
+            # registry must not carry process totals under a tenant label
+            from fedml_tpu.telemetry import get_global_registry
+
+            reg = get_global_registry()
             for key in ("hits", "misses", "bypassed", "programs"):
                 reg.gauge(
                     f"fedml_compile_cache_{key}",

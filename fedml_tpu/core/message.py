@@ -44,6 +44,13 @@ class MessageType:
     S2C_PUBKEYS = "s2c_pubkeys"
     S2C_RECOVER = "s2c_recover"
     C2S_RECOVERY = "c2s_recovery"
+    # elastic fleet membership (fedml_tpu/serve/): a worker announces
+    # itself mid-federation (the async server answers with an assignment,
+    # or with FINISH when the fleet is at max_workers — backpressure) or
+    # leaves gracefully (the server stops dispatching to it instead of
+    # paying dead-peer timeouts / dispatching into a drained inbox)
+    C2S_JOIN = "c2s_join"
+    C2S_LEAVE = "c2s_leave"
 
     # param keys
     ARG_MODEL_PARAMS = "model_params"

@@ -39,20 +39,37 @@ from fedml_tpu.core.message import Message, write_wire_parts
 _FAMILY = "AF_UNIX"
 
 
-def _addr(sock_dir: str, rank: int) -> str:
-    return os.path.join(sock_dir, f"fedml_shm_{rank}.sock")
+def _addr(sock_dir: str, rank: int, namespace: str = "") -> str:
+    ns = f"{namespace}_" if namespace else ""
+    return os.path.join(sock_dir, f"fedml_shm_{ns}{rank}.sock")
 
 
 class ShmCommManager(BaseCommManager):
     """One per participant; ``rank`` names this endpoint (server = 0,
-    ref FedAvgAPI.py:14-27 process model)."""
+    ref FedAvgAPI.py:14-27 process model).
 
-    def __init__(self, rank: int, sock_dir: str, zero_copy: bool = False):
+    ``namespace`` prefixes every socket name so two concurrent
+    federations sharing one ``sock_dir`` (co-tenant sessions in one
+    service process, fedml_tpu/serve/) cannot collide: without it the
+    second session's rank-N constructor unlinks-and-rebinds the first
+    session's live rank-N socket and the two fleets cross-deliver. All
+    participants of one federation must use the SAME namespace (the
+    session's comm factory owns it). "" keeps the legacy socket names
+    byte-identical."""
+
+    def __init__(
+        self,
+        rank: int,
+        sock_dir: str,
+        zero_copy: bool = False,
+        namespace: str = "",
+    ):
         super().__init__()
         self.rank = int(rank)
         self.sock_dir = sock_dir
         self.zero_copy = zero_copy
-        addr = _addr(sock_dir, self.rank)
+        self.namespace = str(namespace)
+        addr = _addr(sock_dir, self.rank, self.namespace)
         if os.path.exists(addr):  # stale socket from a crashed run
             os.unlink(addr)
         # backlog: the default (1) makes a K-client broadcast race the
@@ -72,7 +89,8 @@ class ShmCommManager(BaseCommManager):
         try:
             written = write_wire_parts(seg.buf, header, buffers)
             with connection.Client(
-                _addr(self.sock_dir, msg.get_receiver_id()), family=_FAMILY
+                _addr(self.sock_dir, msg.get_receiver_id(), self.namespace),
+                family=_FAMILY,
             ) as conn:
                 conn.send({"shm": seg.name, "nbytes": written})
         except BaseException:
@@ -144,7 +162,7 @@ class ShmCommManager(BaseCommManager):
             self._listener.close()
         except OSError:
             pass
-        addr = _addr(self.sock_dir, self.rank)
+        addr = _addr(self.sock_dir, self.rank, self.namespace)
         try:
             os.unlink(addr)
         except OSError:
@@ -174,7 +192,8 @@ class ShmCommManager(BaseCommManager):
             return
         try:
             with connection.Client(
-                _addr(self.sock_dir, self.rank), family=_FAMILY
+                _addr(self.sock_dir, self.rank, self.namespace),
+                family=_FAMILY,
             ) as conn:
                 conn.send({"stop": True})
         except (ConnectionError, FileNotFoundError, OSError):

@@ -1,0 +1,31 @@
+"""Continuous federation service — many tenants, one TPU, long-lived.
+
+The single-run CLI runs one federation to completion and exits
+(``run_federation``); the north star is a SERVICE holding heavy traffic:
+N concurrent federations in one process sharing one device, FedBuff-style
+async dispatch as the serving path (3.6-3.8x sync update throughput,
+BENCH_r05), elastic client join/leave with backpressure, rolling
+checkpoints, and per-tenant observability. This package is that service:
+
+- :mod:`fedml_tpu.serve.session` — :class:`FedSession`: ONE federation's
+  entire setup (config, data, model, comm factory, scheduler, fault
+  injector, checkpoint state, telemetry) instance-scoped so N sessions
+  coexist without process-global state. ``run_federation`` /
+  ``run_fedbuff_federation`` are now thin blocking wrappers over it.
+- :mod:`fedml_tpu.serve.server` — :class:`FederationServer`: runs N
+  sessions concurrently, aggregates their telemetry under ``tenant``
+  labels on one Prometheus exporter, writes per-tenant rows into one
+  summary.json, drains/stops tenants individually.
+- :mod:`fedml_tpu.serve.cli` — ``python -m fedml_tpu serve --spec ...``:
+  the multi-tenant entry point (JSON list of run configs).
+
+Co-tenant federations with the same model family share compiled programs
+for free: the ProgramCache digest (fedml_tpu/compile/) is process-wide by
+design, and the per-scope compile attribution in the recompile sentinel
+proves it (``compile/recompiles == 0`` on the second same-family tenant —
+the ci.sh soak gate). See docs/SERVING.md."""
+
+from fedml_tpu.serve.session import FedSession
+from fedml_tpu.serve.server import FederationServer
+
+__all__ = ["FedSession", "FederationServer"]

@@ -1,0 +1,208 @@
+"""``python -m fedml_tpu serve`` — the multi-tenant service entry point.
+
+Takes a JSON tenant spec (a list of run configs) and runs every tenant
+concurrently in one process through :class:`FederationServer`. Spec keys
+reuse the single-run CLI's flag names verbatim (model, dataset,
+client_num_in_total, comm_round, selection, fault_plan, ...) so a tenant
+spec IS a run config — plus the session-level keys:
+
+``name`` (required, unique), ``algorithm`` (fedavg|fedprox|fedopt|
+fedbuff), ``runtime`` (loopback|shm|mqtt), ``checkpoint_path``,
+``checkpoint_every``, ``resume``, ``max_workers``, ``warmup``.
+
+Spec document shape: ``{"tenants": [...]}`` or a bare JSON list.
+
+Per tenant the service writes a full per-tenant log dir
+(``<log_dir>/<name>/`` — metrics.jsonl + summary.json, the same files a
+single run writes) and, into the aggregate ``<log_dir>/summary.json``,
+one ``tenants/<name>/...`` row per tenant. ``--prom_port`` serves every
+tenant's metrics under a ``tenant`` label from one exporter. See
+docs/SERVING.md."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import click
+
+SERVE_ALGORITHMS = ("fedavg", "fedprox", "fedopt", "fedbuff")
+SERVE_RUNTIMES = ("loopback", "shm", "mqtt")
+# session-level keys consumed here, not by build_config
+_SESSION_KEYS = (
+    "name", "checkpoint_path", "checkpoint_every", "resume", "max_workers",
+)
+
+
+def _cli_defaults() -> dict:
+    """The single-run CLI's full flag surface with its defaults — the
+    base every tenant spec overlays, so serve and single-run configs can
+    never drift apart."""
+    from fedml_tpu.cli import main as single_run
+
+    return {p.name: p.default for p in single_run.params}
+
+
+def load_spec(text_or_path: str) -> list:
+    """Parse a tenant spec: inline JSON or a path to a JSON file."""
+    s = str(text_or_path).strip()
+    if not s.startswith("{") and not s.startswith("["):
+        with open(s) as f:
+            doc = json.load(f)
+    else:
+        doc = json.loads(s)
+    tenants = doc.get("tenants") if isinstance(doc, dict) else doc
+    if not isinstance(tenants, list) or not tenants:
+        raise ValueError(
+            "tenant spec must be a non-empty JSON list (or {'tenants': [...]})"
+        )
+    names = set()
+    for t in tenants:
+        if not isinstance(t, dict) or not t.get("name"):
+            raise ValueError(f"every tenant needs a unique 'name': {t!r}")
+        if t["name"] in names:
+            raise ValueError(f"duplicate tenant name {t['name']!r}")
+        names.add(t["name"])
+    return tenants
+
+
+def build_tenant(spec: dict):
+    """(config, data, model, session_kwargs) for one tenant spec; the
+    tenant name stays in the spec dict (create_session takes it
+    positionally)."""
+    from fedml_tpu.cli import build_config
+    from fedml_tpu.data import registry as data_registry
+    from fedml_tpu.models import create_model
+
+    spec = dict(spec)
+    algorithm = spec.get("algorithm", "fedavg")
+    runtime = spec.get("runtime", "loopback")
+    if algorithm not in SERVE_ALGORITHMS:
+        raise click.UsageError(
+            f"tenant {spec['name']!r}: serve supports algorithms "
+            f"{SERVE_ALGORITHMS}, got {algorithm!r}"
+        )
+    if runtime not in SERVE_RUNTIMES:
+        raise click.UsageError(
+            f"tenant {spec['name']!r}: serve supports runtimes "
+            f"{SERVE_RUNTIMES}, got {runtime!r}"
+        )
+    opt = _cli_defaults()
+    session_kw = {}
+    for key in _SESSION_KEYS:
+        if key in spec:
+            session_kw[key] = spec.pop(key)
+    name = session_kw.pop("name")  # passed positionally to create_session
+    if "dataset" in spec:  # the CLI's --dataset flag maps to dataset_name
+        spec["dataset_name"] = spec.pop("dataset")
+    unknown = set(spec) - set(opt) - {"algorithm", "runtime"}
+    if unknown:
+        raise click.UsageError(
+            f"tenant {name!r}: unknown spec keys {sorted(unknown)} "
+            "(spec keys are the single-run CLI flag names)"
+        )
+    opt.update(spec)
+    if algorithm == "fedbuff" and opt.get("async_buffer_k", 0) in (0, None):
+        opt["async_buffer_k"] = 10  # the CLI flag default
+    if algorithm == "fedbuff" and opt.get("warmup"):
+        # mirror the single-run CLI's guard (FedSession raises too, but
+        # a spec error should fail at parse time, before data loads)
+        raise click.UsageError(
+            f"tenant {name!r}: warmup is not supported for "
+            "algorithm=fedbuff — its workers stream continuously and "
+            "compile on first dispatch; there is no round-0 barrier"
+        )
+    config = build_config(opt)
+    data = data_registry.load(config)
+    task = data_registry.task_for_dataset(config.data.dataset)
+    sample_shape = tuple(data.client_x[0].shape[1:])
+    model = create_model(
+        config.model, config.data.dataset, sample_shape, data.num_classes
+    )
+    session_kw.update(
+        algorithm=algorithm,
+        runtime=runtime,
+        task=task,
+        warmup=bool(opt.get("warmup", False)),
+    )
+    return config, data, model, session_kw
+
+
+@click.command(name="serve")
+@click.option("--spec", required=True,
+              help="Multi-tenant spec: inline JSON or a path to a JSON "
+                   "file — {'tenants': [{name, algorithm, runtime, "
+                   "<single-run CLI flags>...}, ...]} or a bare list")
+@click.option("--log_dir", type=click.Path(path_type=Path), default=None,
+              help="Aggregate log dir: per-tenant subdirs (<name>/"
+                   "summary.json) + one service summary.json with "
+                   "tenants/<name>/* rows")
+@click.option("--prom_port", type=int, default=None,
+              help="Serve every tenant's metrics (tenant label) from one "
+                   "/metrics endpoint; 0 picks an ephemeral port")
+@click.option("--duration_s", type=float, default=None,
+              help="Drain every tenant after this many seconds instead "
+                   "of waiting for their comm_round targets (a soak knob)")
+@click.option("--stagger_s", type=float, default=0.0,
+              help="Delay between tenant starts (lets the first tenant "
+                   "of a model family pay the compiles the rest share)")
+def serve_main(spec, log_dir, prom_port, duration_s, stagger_s):
+    """Run N federation tenants concurrently in one process."""
+    import time
+
+    from fedml_tpu.cli import _apply_platform_env
+    from fedml_tpu.serve.server import FederationServer
+
+    _apply_platform_env()
+    tenants = load_spec(spec)
+    server = FederationServer(
+        log_dir=str(log_dir) if log_dir else None, prom_port=prom_port
+    )
+    for t in tenants:
+        name = t["name"]
+        config, data, model, session_kw = build_tenant(t)
+        if log_dir:
+            from fedml_tpu.utils import MetricsLogger
+
+            tenant_logger = MetricsLogger(str(Path(log_dir) / name))
+            session_kw["log_fn"] = tenant_logger.log
+        server.create_session(name, config, data, model, **session_kw)
+    try:
+        for i, t in enumerate(tenants):
+            if i and stagger_s:
+                time.sleep(stagger_s)
+            server.start(names=[t["name"]])
+        if server.prom_port is not None:
+            click.echo(
+                f"serve: prometheus metrics on "
+                f"http://127.0.0.1:{server.prom_port}/metrics",
+                err=True,
+            )
+        if duration_s:
+            deadline = time.monotonic() + float(duration_s)
+            while time.monotonic() < deadline and not all(
+                s.done for s in server.sessions()
+            ):
+                time.sleep(min(0.25, max(0.0, deadline - time.monotonic())))
+            server.drain()
+        results = server.wait()
+    finally:
+        server.close()
+    from fedml_tpu.serve.server import _jsonable
+
+    out = {
+        name: {
+            "ok": r["ok"],
+            "error": r["error"],
+            **{k: _jsonable(v) for k, v in r["summary"].items()},
+        }
+        for name, r in results.items()
+    }
+    click.echo(json.dumps(out))
+    failed = [name for name, r in results.items() if not r["ok"]]
+    if failed:
+        raise click.ClickException(f"tenants failed: {failed}")
+
+
+if __name__ == "__main__":
+    serve_main()

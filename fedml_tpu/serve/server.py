@@ -1,0 +1,201 @@
+"""FederationServer — N concurrent FedSessions in one process, one ops
+surface.
+
+The service shape ROADMAP item 3 names: a long-lived process holding many
+tenants' federations on one device. Each tenant gets its own
+:class:`TelemetryScope` (tracer / metrics registry / comm meter /
+compile-attribution counters); the server stitches them into:
+
+- ONE Prometheus exporter serving every tenant's instruments under a
+  ``tenant`` label (:class:`TenantedRegistryView` — the process-global
+  registry rides along unlabeled);
+- ONE aggregate MetricsLogger whose summary.json carries per-tenant rows
+  (``tenants/<name>/...``) next to whatever per-tenant log dirs the
+  caller gives the sessions;
+- per-tenant drain/stop, elastic worker churn, and a status() snapshot.
+
+Compiled programs are deliberately NOT per-tenant: every session builds
+through the process-wide ProgramCache, so the second tenant of a model
+family dispatches the first tenant's executables — provable per tenant
+via ``scope.recompiles()`` (docs/SERVING.md, ci.sh soak gate)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from fedml_tpu.serve.session import FedSession
+from fedml_tpu.telemetry import (
+    TelemetryScope,
+    TenantedRegistryView,
+    get_global_registry,
+)
+
+
+class FederationServer:
+    """Run N tenants concurrently; one process, one device, one /metrics."""
+
+    def __init__(
+        self,
+        log_dir: Optional[str] = None,
+        prom_port: Optional[int] = None,
+    ):
+        self.view = TenantedRegistryView(base=get_global_registry())
+        self._sessions: Dict[str, FedSession] = {}
+        self._order: List[str] = []
+        self._lock = threading.Lock()
+        self._exporter = None
+        self._prom_port = prom_port
+        self.logger = None
+        if log_dir:
+            from fedml_tpu.utils import MetricsLogger
+
+            self.logger = MetricsLogger(str(log_dir))
+
+    # -- tenant registration ----------------------------------------------
+
+    def create_session(self, name: str, config, data, model, **kw) -> FedSession:
+        """Build a tenant session with its own TelemetryScope and register
+        it. ``kw`` forwards to :class:`FedSession` (algorithm, runtime,
+        checkpoint_path, max_workers, ...)."""
+        with self._lock:
+            if name in self._sessions:
+                raise ValueError(f"tenant {name!r} already registered")
+        kw.setdefault("scope", TelemetryScope(tenant=name))
+        session = FedSession(config, data, model, name=name, **kw)
+        return self.add_session(session)
+
+    def add_session(self, session: FedSession) -> FedSession:
+        """Register an externally-built session (it should carry a scope —
+        without one its telemetry lands in the process globals and the
+        tenant label surface has nothing to serve)."""
+        with self._lock:
+            if session.name in self._sessions:
+                raise ValueError(f"tenant {session.name!r} already registered")
+            self._sessions[session.name] = session
+            self._order.append(session.name)
+        if session.scope is not None:
+            self.view.add_tenant(session.name, session.scope.registry)
+        return session
+
+    def session(self, name: str) -> FedSession:
+        return self._sessions[name]
+
+    def sessions(self) -> List[FedSession]:
+        with self._lock:
+            return [self._sessions[n] for n in self._order]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, names: Optional[List[str]] = None) -> "FederationServer":
+        """Start the exporter (once) and the named tenants (all unstarted
+        ones by default). Callable repeatedly — a service admits tenants
+        over its lifetime."""
+        if self._prom_port is not None and self._exporter is None:
+            from fedml_tpu.analysis.sentinel import ensure_backend_listener
+            from fedml_tpu.telemetry import PrometheusExporter
+
+            # per-tenant compile attribution needs the process-wide
+            # jax.monitoring listener installed before tenant threads run
+            ensure_backend_listener()
+            self._exporter = PrometheusExporter(
+                port=self._prom_port, registry=self.view
+            ).start()
+            logging.info(
+                "serve: prometheus metrics on http://127.0.0.1:%d/metrics",
+                self._exporter.port,
+            )
+        for s in self.sessions():
+            if names is not None and s.name not in names:
+                continue
+            if s.state == "created":
+                s.start()
+        return self
+
+    @property
+    def prom_port(self) -> Optional[int]:
+        return self._exporter.port if self._exporter is not None else None
+
+    def drain(self, name: Optional[str] = None) -> None:
+        """Gracefully stop one tenant (or all): open rounds complete /
+        buffered deltas flush, fleets FINISH."""
+        for s in self.sessions():
+            if name is None or s.name == name:
+                s.drain()
+
+    def stop(self, name: Optional[str] = None) -> None:
+        for s in self.sessions():
+            if name is None or s.name == name:
+                s.stop()
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[str, dict]:
+        """Join every started tenant and collect results: one tenant's
+        failure never blocks (or masks) the others'. Per tenant, the
+        aggregate logger receives a ``tenants/<name>/...`` summary row.
+        Returns {name: {"ok", "error", "summary"}}; raises nothing —
+        callers decide what a failed tenant means (the serve CLI exits
+        nonzero)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        results: Dict[str, dict] = {}
+        for s in self.sessions():
+            if not s._started:
+                continue
+            left = None
+            if deadline is not None:
+                left = max(0.0, deadline - time.monotonic())
+            err = None
+            try:
+                s.wait(left)
+            except TimeoutError:
+                results[s.name] = {
+                    "ok": False, "error": "timeout", "summary": s.summary_row()
+                }
+                continue
+            except BaseException as e:  # noqa: BLE001 — per-tenant isolation
+                logging.exception("tenant %s failed", s.name)
+                err = e
+            summary = s.summary_row()
+            if self.logger is not None:
+                self.logger.log(
+                    {f"tenants/{s.name}/{k}": _jsonable(v)
+                     for k, v in summary.items()}
+                )
+            results[s.name] = {
+                "ok": err is None,
+                "error": repr(err) if err is not None else None,
+                "summary": summary,
+            }
+        return results
+
+    def status(self) -> dict:
+        return {s.name: s.status() for s in self.sessions()}
+
+    def render_metrics(self) -> str:
+        """The exact text the /metrics endpoint serves (tests/ops)."""
+        return self.view.render()
+
+    def close(self) -> None:
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
+        if self.logger is not None:
+            self.logger.close()
+
+    def __enter__(self) -> "FederationServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _jsonable(v):
+    try:
+        import numpy as np
+
+        if isinstance(v, (np.floating, np.integer)):
+            return v.item()
+    except Exception:  # noqa: BLE001 — numpy-free contexts
+        pass
+    return v

@@ -1,0 +1,772 @@
+"""FedSession — one federation, fully instance-scoped.
+
+Extracted from ``fedavg_transport.run_federation`` /
+``fedbuff.run_fedbuff_federation`` (which are now thin blocking wrappers
+over this class): everything those runners used to assemble inline —
+worker fleet sizing, the ONE shared FaultInjector, the shared local-train
+program, the error-feedback store, the warmup barrier, the guarded actor
+threads and their join/exit discipline — lives on a session object, plus
+the pieces a long-lived multi-tenant service needs on top:
+
+- **telemetry isolation**: a session constructed with a
+  :class:`fedml_tpu.telemetry.TelemetryScope` builds its managers,
+  trainers, health registry, and comm meters under that scope and wraps
+  every thread it spawns in it, so N co-tenant sessions record into N
+  tracers/registries/meters instead of one process-global set. Without a
+  scope the session inherits the ambient context (usually the globals) —
+  the single-run wrappers are byte-compatible.
+- **namespaced endpoints**: when no ``comm_factory`` is given the session
+  builds one per ``runtime`` with a session-unique namespace (fresh
+  loopback hub, namespaced shm socket names, namespaced MQTT topic
+  prefix), so two concurrent federations can never collide on
+  socket/Listener/topic names.
+- **non-blocking lifecycle**: ``start()`` spawns the fleet and the server
+  FSM on threads; ``wait()`` joins and applies the runners' exact
+  post-run checks; ``drain()``/``stop()`` end a tenant gracefully.
+- **rolling checkpoints + resume**: ``checkpoint_every`` persists
+  (model, round/step, server-opt state, scheduler ``sched`` slot, and —
+  async — the FedBuff version/dispatch counter) at round/flush
+  boundaries through utils/checkpoint.py; ``resume=True`` pours the
+  checkpoint back so the in-flight cohort is re-selected
+  byte-identically (the PR-3 ``sched``-slot contract, now reachable
+  through the session for BOTH the sync and the FedBuff path).
+- **elastic fleets** (FedBuff): ``add_worker()`` joins a new client actor
+  mid-federation (admitted or FINISH-refused at ``max_workers`` —
+  backpressure), ``remove_worker()`` retires one at its next dispatch.
+
+The ProgramCache stays process-wide on purpose: co-tenant sessions with
+the same model family share compiled programs (docs/SERVING.md)."""
+
+from __future__ import annotations
+
+import logging
+import shutil
+import tempfile
+import threading
+import uuid
+from typing import Callable, List, Optional
+
+from fedml_tpu.config import RunConfig
+from fedml_tpu.telemetry import TelemetryScope, activate_scope, current_scope, get_tracer
+
+SESSION_ALGORITHMS = ("fedavg", "fedprox", "fedopt", "fedbuff")
+SESSION_RUNTIMES = ("loopback", "shm", "mqtt")
+
+
+class FedSession:
+    """One federation as a long-lived object (see module docstring).
+
+    ``comm_factory(rank) -> BaseCommManager`` overrides the built-in
+    namespaced factories; ``scope`` (a TelemetryScope) makes the session's
+    telemetry instance-scoped — None inherits the ambient context."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        data,
+        model,
+        *,
+        name: Optional[str] = None,
+        algorithm: str = "fedavg",
+        runtime: str = "loopback",
+        comm_factory: Optional[Callable[[int], object]] = None,
+        task: str = "classification",
+        log_fn=None,
+        trainer_factory=None,
+        server_opt: Optional[bool] = None,
+        warmup: bool = False,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
+        max_workers: Optional[int] = None,
+        scope: Optional[TelemetryScope] = None,
+    ):
+        if algorithm not in SESSION_ALGORITHMS:
+            raise ValueError(
+                f"FedSession supports algorithms {SESSION_ALGORITHMS}, "
+                f"got {algorithm!r}"
+            )
+        if comm_factory is None and runtime not in SESSION_RUNTIMES:
+            raise ValueError(
+                f"FedSession runtimes are {SESSION_RUNTIMES} (or pass a "
+                f"comm_factory), got {runtime!r}"
+            )
+        if warmup and algorithm == "fedbuff":
+            # same contract as the single-run CLI: fedbuff workers stream
+            # continuously and compile on first dispatch — there is no
+            # round-0 barrier to warm against, and silently accepting the
+            # flag would leave the operator believing the warmup barrier
+            # is in place
+            raise ValueError(
+                "warmup is not supported for algorithm=fedbuff: its "
+                "workers stream continuously; there is no round-0 "
+                "barrier to warm against"
+            )
+        self.config = config
+        self.data = data
+        self.model = model
+        self.name = name or f"session-{uuid.uuid4().hex[:8]}"
+        self.algorithm = algorithm
+        self.runtime = runtime
+        self.task = task
+        self.comm_factory = comm_factory
+        self.trainer_factory = trainer_factory
+        self.server_opt = (
+            (algorithm == "fedopt") if server_opt is None else bool(server_opt)
+        )
+        self.warmup = bool(warmup)
+        self.checkpoint_path = str(checkpoint_path) if checkpoint_path else None
+        self.checkpoint_every = int(checkpoint_every)
+        self.resume = bool(resume)
+        self.max_workers = max_workers
+        self.scope = scope
+        self.mode = "fedbuff" if algorithm == "fedbuff" else "sync"
+        # endpoint namespace: unique per session OBJECT so two sessions
+        # built from identical specs still cannot collide (satellite fix:
+        # shm socket names / mqtt topics are per-session now)
+        self.namespace = f"{_slug(self.name)}-{uuid.uuid4().hex[:6]}"
+
+        self._user_log_fn = log_fn
+        self.server = None
+        self.clients: List[object] = []
+        self.threads: List[threading.Thread] = []
+        self._injector = None
+        self._make_trainer = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._server_error: Optional[BaseException] = None
+        self._errors: List[BaseException] = []
+        self._prop_scope: Optional[TelemetryScope] = None
+        self._tmpdir: Optional[str] = None
+        self._started = False
+        self._finalized = False
+        self._lock = threading.Lock()
+        self._next_rank = 1
+        self.state = "created"  # created -> running -> done|failed
+
+    # -- comm factories (namespaced per session) ---------------------------
+
+    def _default_comm_factory(self):
+        if self.runtime == "loopback":
+            from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+
+            hub = LoopbackHub()  # per-session hub: inherently namespaced
+            return lambda rank: LoopbackCommManager(hub, rank)
+        if self.runtime == "shm":
+            from fedml_tpu.core.shm_comm import ShmCommManager
+
+            self._tmpdir = tempfile.mkdtemp(prefix="fedml_serve_shm_")
+            ns = self.namespace
+            d = self._tmpdir
+            return lambda rank: ShmCommManager(rank, d, namespace=ns)
+        if self.runtime == "mqtt":
+            from fedml_tpu.core.mqtt_comm import EmbeddedBroker, MqttCommManager
+
+            broker = EmbeddedBroker()
+            prefix = f"fedml_tpu/{self.namespace}"
+            return lambda rank: MqttCommManager(
+                rank, broker=broker, topic_prefix=prefix
+            )
+        raise AssertionError(self.runtime)
+
+    # -- build (the extracted run_federation setup) ------------------------
+
+    def _build_sync(self):
+        from fedml_tpu.algorithms.fedavg_transport import (
+            FedAvgClientManager,
+            FedAvgServerManager,
+            LocalTrainer,
+            shared_local_train,
+        )
+        from fedml_tpu.scheduler import FaultInjector, overprovisioned_k
+
+        config = self.config
+        K = overprovisioned_k(
+            config.fed.client_num_per_round,
+            config.fed.overprovision_factor,
+            config.fed.client_num_in_total,
+        )
+        injector = FaultInjector.from_config(config, tracer=get_tracer())
+        if (
+            injector is not None
+            and injector.plan.has_participation_faults()
+            and not config.fed.deadline_s
+        ):
+            raise ValueError(
+                "fault_plan can drop uploads (dropout_p/crash_at_round) but "
+                "deadline_s is 0: the server's all-received barrier would "
+                "wait forever — set FedConfig.deadline_s/min_clients"
+            )
+        server = FedAvgServerManager(
+            config,
+            self.comm_factory(0),
+            self.model,
+            data=self.data,
+            task=self.task,
+            worker_num=K,
+            log_fn=self._log,
+            server_opt=self.server_opt,
+            faults=injector,
+        )
+        if injector is not None:
+            # the injector predates the server (the server's stall valve
+            # reads its plan); point its fault accounting at the server's
+            # registry
+            injector.health = server.health
+        shared_train = shared_local_train(self.model, config, self.task)
+        if self.warmup and self.trainer_factory is None:
+            from fedml_tpu.compile import warmup_local_train
+
+            warmup_local_train(
+                shared_train,
+                config,
+                self.data,
+                server.global_vars,
+                # client_ids=None: warm every shape class the PARTITION can
+                # produce, not just the opening cohort's (data/base.py
+                # partition_shape_classes is the enumeration contract)
+                log_fn=self._log,
+            )
+        make_trainer = self.trainer_factory or (
+            lambda rank: LocalTrainer(
+                config, self.data, self.model, self.task,
+                local_train_fn=shared_train,
+            )
+        )
+        # one shared error-feedback store: residuals are keyed by client id
+        # and the sampler re-assigns clients to ranks each round
+        from fedml_tpu.core.compression import TopKErrorFeedback
+
+        shared_ef = TopKErrorFeedback.maybe_from_config(config.comm)
+        if shared_ef is not None and config.fed.deadline_s:
+            raise ValueError(
+                "error_feedback cannot be combined with deadline_s quorum "
+                "rounds: a dropped late upload loses residual-cleared mass"
+            )
+        self.clients = [
+            FedAvgClientManager(
+                config, self.comm_factory(rank), rank, make_trainer(rank),
+                ef=shared_ef, faults=injector,
+            )
+            for rank in range(1, K + 1)
+        ]
+        self.server = server
+        self._injector = injector
+        self._make_trainer = make_trainer
+        self._next_rank = K + 1
+
+    def _build_fedbuff(self):
+        from fedml_tpu.algorithms.fedavg_transport import (
+            LocalTrainer,
+            shared_local_train,
+        )
+        from fedml_tpu.algorithms.fedbuff import (
+            FedBuffClientManager,
+            FedBuffServerManager,
+        )
+        from fedml_tpu.scheduler import FaultInjector
+
+        config = self.config
+        K = config.fed.client_num_per_round
+        server = FedBuffServerManager(
+            config,
+            self.comm_factory(0),
+            self.model,
+            data=self.data,
+            task=self.task,
+            worker_num=K,
+            log_fn=self._log,
+            max_workers=self.max_workers,
+        )
+        injector = FaultInjector.from_config(
+            config, health=server.health, tracer=get_tracer()
+        )
+        # THE shared transport local-train program: deduped through the
+        # process-wide ProgramCache, so this tenant shares compiles with
+        # the sync transports AND every co-tenant of the same model family
+        shared_train = shared_local_train(self.model, config, self.task)
+        make_trainer = self.trainer_factory or (
+            lambda rank: LocalTrainer(
+                config, self.data, self.model, self.task,
+                local_train_fn=shared_train,
+            )
+        )
+        self.clients = [
+            FedBuffClientManager(
+                config, self.comm_factory(rank), rank, make_trainer(rank),
+                faults=injector,
+            )
+            for rank in range(1, K + 1)
+        ]
+        self.server = server
+        self._injector = injector
+        self._make_trainer = make_trainer
+        self._next_rank = K + 1
+
+    # -- checkpoint/resume -------------------------------------------------
+
+    def _restore(self) -> bool:
+        """Pour the checkpoint into the built (still un-started) server.
+        Returns True when the checkpoint already covers the full target
+        (nothing left to run)."""
+        from fedml_tpu.utils.checkpoint import load_checkpoint, restore_like
+
+        loaded_vars, round_idx, _, opt_state, algo_state, sched_state = (
+            load_checkpoint(self.checkpoint_path)
+        )
+        server = self.server
+        server.global_vars = restore_like(server.global_vars, loaded_vars)
+        if self.mode == "fedbuff":
+            if algo_state is not None:
+                server.restore_state(algo_state)
+            else:  # checkpoint from a sync writer: steps only
+                server.server_steps = int(round_idx)
+                server.version = int(round_idx)
+            if sched_state is not None and server._scheduler is not None:
+                server._scheduler.load_state_dict(sched_state)
+            return server.server_steps >= self.config.fed.comm_round
+        server.round_idx = int(round_idx)
+        if opt_state is not None and server._server_step is not None:
+            # FedOpt moments: rebuild the optimizer-state pytree template,
+            # then pour the saved leaves in (npz stores tuples as lists —
+            # leaf order carries the structure, utils/checkpoint.py)
+            template = server._server_optimizer.init(
+                server.global_vars["params"]
+            )
+            server._server_opt_state = restore_like(template, opt_state)
+        if sched_state is not None:
+            # the PR-3 "sched" slot: selection memo + loss map, so
+            # send_init_msg re-selects the in-flight round's cohort
+            # byte-identically (round-keyed policies re-derive the rest)
+            server.scheduler.load_state_dict(sched_state)
+        return server.round_idx >= self.config.fed.comm_round
+
+    def _log(self, row: dict) -> None:
+        if self._user_log_fn is not None:
+            self._user_log_fn(row)
+        self._maybe_checkpoint(row)
+
+    def _maybe_checkpoint(self, row: dict) -> None:
+        cp, every = self.checkpoint_path, self.checkpoint_every
+        if not cp or every <= 0 or self.server is None:
+            return
+        from fedml_tpu.utils import save_checkpoint
+
+        if self.mode == "fedbuff":
+            step = row.get("server_step")
+            # flush boundaries only: the delta buffer is empty exactly
+            # when _flush logs its row, so the checkpoint needs no
+            # buffered-delta persistence (FedBuffServerManager.
+            # checkpoint_state docstring)
+            if step is None or int(step) % every:
+                return
+            sched = self.server._scheduler
+            save_checkpoint(
+                cp,
+                self.server.global_vars,
+                round_idx=int(step),
+                algo_state=self.server.checkpoint_state(),
+                sched_state=sched.state_dict() if sched is not None else None,
+            )
+        else:
+            # round-completion rows carry both "round" and "t_s"
+            # (scheduler/fault rows don't — they must not trigger a save)
+            if "round" not in row or "t_s" not in row:
+                return
+            nxt = int(row["round"]) + 1  # "next round to run" convention
+            if nxt % every:
+                return
+            save_checkpoint(
+                cp,
+                self.server.global_vars,
+                round_idx=nxt,
+                server_opt_state=self.server._server_opt_state,
+                sched_state=self.server.scheduler.state_dict(),
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FedSession":
+        """Build the federation and spawn its threads (non-blocking).
+        ``wait()`` joins; ``run()`` does both."""
+        with self._lock:
+            if self._started:
+                raise RuntimeError(f"session {self.name} already started")
+            self._started = True
+        if self.scope is not None:
+            # per-tenant compile attribution (scope.recompiles) feeds on
+            # the process-wide jax.monitoring listener — install it before
+            # any of this session's threads can trigger a compile, or the
+            # counters would read 0 vacuously (idempotent; degrades to
+            # 0-counting on jaxlibs without the monitoring API, exactly
+            # like the recompile sentinel)
+            from fedml_tpu.analysis.sentinel import ensure_backend_listener
+
+            ensure_backend_listener()
+        # threads must see the session's scope — or, when the session has
+        # none, whatever scope the CALLER is running under (a wrapper
+        # invoked from inside another scoped workload propagates it)
+        self._prop_scope = self.scope or current_scope()
+        try:
+            return self._start_built()
+        except BaseException:
+            # a failed build (config-guard ValueError, bad checkpoint)
+            # must not leak the shm tmpdir a default comm factory created
+            # — in a long-lived service every misconfigured tenant spec
+            # would leave one behind
+            self.state = "failed"
+            self._cleanup()
+            raise
+
+    def _start_built(self) -> "FedSession":
+        with activate_scope(self.scope):
+            if self.comm_factory is None:
+                self.comm_factory = self._default_comm_factory()
+            if self.mode == "fedbuff":
+                self._build_fedbuff()
+            else:
+                self._build_sync()
+            already_done = False
+            if self.resume and self.checkpoint_path:
+                already_done = self._restore()
+            if already_done:
+                logging.info(
+                    "session %s: checkpoint already at the configured "
+                    "comm_round — nothing to run", self.name,
+                )
+                # the managers were built but never run: release their
+                # transport endpoints (listeners/sockets) before cleanup
+                for c in self.clients:
+                    try:
+                        c.finish()
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                try:
+                    self.server.finish()
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
+                self.state = "done"
+                self._finalized = True
+                self._cleanup()
+                return self
+        self.state = "running"
+        prop = self._prop_scope
+
+        def guarded_run(c):
+            # A dead client would stall the server (sync barrier) or
+            # starve the buffer (async); surface the failure by stopping
+            # the server loop.
+            with activate_scope(prop):
+                try:
+                    c.run()
+                except BaseException as e:  # noqa: BLE001
+                    self._errors.append(e)
+                    self.server.finish()
+
+        self._guarded_run = guarded_run
+        self.threads = [
+            threading.Thread(
+                target=guarded_run, args=(c,), daemon=True,
+                name=f"fedml-{self.name}-client-{c.rank}",
+            )
+            for c in self.clients
+        ]
+        for t in self.threads:
+            t.start()
+        with activate_scope(self.scope):
+            self.server.send_init_msg()
+
+        def server_main():
+            with activate_scope(prop):
+                try:
+                    self.server.run()
+                except BaseException as e:  # noqa: BLE001
+                    self._server_error = e
+                    for c in self.clients:
+                        try:
+                            c.finish()
+                        except Exception:  # noqa: BLE001 — best effort
+                            pass
+
+        self._server_thread = threading.Thread(
+            target=server_main, daemon=True, name=f"fedml-{self.name}-server"
+        )
+        self._server_thread.start()
+        return self
+
+    @property
+    def done(self) -> bool:
+        if not self._started:
+            return False
+        if self._server_thread is None:
+            return self._finalized
+        return not self._server_thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the federation finishes, then apply the runner
+        post-checks (client errors, deadline failures, orphaned workers,
+        fault starvation) exactly as the blocking ``run_federation`` /
+        ``run_fedbuff_federation`` always did. Returns the server manager
+        (global_vars, history). Raises TimeoutError when ``timeout``
+        expires first (the session keeps running)."""
+        if not self._started:
+            raise RuntimeError(f"session {self.name} was never started")
+        if self._server_thread is not None:
+            self._server_thread.join(timeout)
+            if self._server_thread.is_alive():
+                raise TimeoutError(
+                    f"session {self.name} still running after {timeout}s"
+                )
+        self._finalize()
+        return self.server
+
+    def run(self):
+        """Blocking one-shot: start + wait (the wrapper entry point)."""
+        self.start()
+        return self.wait()
+
+    def _finalize(self) -> None:
+        with self._lock:
+            if self._finalized:
+                return
+            self._finalized = True
+        try:
+            if self.mode == "fedbuff":
+                self._finalize_fedbuff()
+            else:
+                self._finalize_sync()
+            self.state = "done"
+        except BaseException:
+            self.state = "failed"
+            raise
+        finally:
+            self._cleanup()
+
+    def _finalize_sync(self) -> None:
+        server, clients = self.server, self.clients
+        if self._server_error is not None:
+            for c in clients:
+                c.finish()
+            raise self._server_error
+        if getattr(server, "deadline_error", None) is not None:
+            for c in clients:
+                c.finish()
+            raise RuntimeError(
+                "server deadline path failed"
+            ) from server.deadline_error
+        if self._errors:
+            # release the surviving client threads before raising — they
+            # would otherwise park on inbox.get() for the process lifetime
+            for c in clients:
+                c.finish()
+            raise RuntimeError("client actor failed") from self._errors[0]
+        for t in self.threads:
+            t.join(timeout=60)
+            if t.is_alive():
+                raise RuntimeError("client thread failed to finish")
+        if self._injector is not None:
+            # run-level fault accounting into the metrics stream
+            # (summary.json records the injected faults — the CI oracle)
+            server.log_fn(self._injector.summary_row())
+
+    def _finalize_fedbuff(self) -> None:
+        server, clients = self.server, self.clients
+        if self._server_error is not None:
+            for c in clients:
+                c.finish()
+            raise self._server_error
+        if self._errors:
+            for c in clients:
+                c.finish()
+            raise RuntimeError(
+                "async client actor failed"
+            ) from self._errors[0]
+        for c in clients:
+            c.finish()  # idempotent: unblocks workers parked on inboxes
+        for t in self.threads:
+            t.join(timeout=60)
+            if t.is_alive():
+                raise RuntimeError("async client thread failed to finish")
+        orphans = [c.rank for c in clients if c.orphaned]
+        if server.fault_starved:
+            raise RuntimeError(
+                "fedbuff fault plan starved the delta buffer: every client "
+                "appears crashed/dropped, the run cannot reach its step "
+                "count (fix the plan or lower async_buffer_k)"
+            )
+        stopped_early = server._stop_requested
+        if (
+            orphans
+            and server.server_steps < self.config.fed.comm_round
+            and not stopped_early
+        ):
+            raise RuntimeError(
+                f"async workers {orphans} were orphaned (server "
+                "unreachable, no FINISH) — federation did not terminate "
+                "cleanly"
+            )
+        if orphans:
+            logging.warning(
+                "async federation completed all %d steps but workers %s "
+                "went orphaned along the way (transient upload failures)",
+                server.server_steps, orphans,
+            )
+        if self._injector is not None:
+            server.log_fn(self._injector.summary_row())
+
+    def _cleanup(self) -> None:
+        if self._tmpdir is not None:
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._tmpdir = None
+
+    # -- tenant control (fedml_tpu/serve/server.py) ------------------------
+
+    def request_stop(self, drain: bool = True, defer: bool = False) -> None:
+        """Ask this tenant's server to stop: ``drain=True`` finishes the
+        open round (sync) / flushes the buffered deltas (async) first;
+        ``drain=False`` closes out immediately. ``defer=True`` only sets
+        the flags — REQUIRED when calling from inside the session's own
+        log_fn/handlers (the direct path takes the server lock)."""
+        if self.server is None:
+            return
+        if self.mode == "fedbuff":
+            self.server.request_stop(drain=drain, defer=defer)
+        else:
+            if defer:
+                self.server._stop_requested = True
+            else:
+                self.server.request_stop(drain=drain)
+
+    def drain(self) -> None:
+        self.request_stop(drain=True)
+
+    def stop(self) -> None:
+        self.request_stop(drain=False)
+
+    def add_worker(self):
+        """Elastic join (FedBuff sessions): spawn a new client actor that
+        announces itself with C2S_JOIN; the server admits it with an
+        assignment or refuses with FINISH at ``max_workers``
+        (backpressure). Returns the new client manager (``.left`` /
+        ``._got_finish`` tell the story). Sync sessions have a fixed
+        fleet per round — join between rounds by restarting the tenant."""
+        if self.mode != "fedbuff":
+            raise RuntimeError(
+                "elastic join/leave is a FedBuff (async) session feature; "
+                "sync rounds have a fixed per-round worker fleet"
+            )
+        if not self._started or self._finalized:
+            raise RuntimeError(f"session {self.name} is not running")
+        from fedml_tpu.algorithms.fedbuff import FedBuffClientManager
+        from fedml_tpu.core.message import Message, MessageType as MT
+
+        with self._lock:
+            rank = self._next_rank
+            self._next_rank += 1
+        with activate_scope(self.scope):
+            client = FedBuffClientManager(
+                self.config,
+                self.comm_factory(rank),
+                rank,
+                self._make_trainer(rank),
+                faults=self._injector,
+            )
+        self.clients.append(client)
+        t = threading.Thread(
+            target=self._guarded_run, args=(client,), daemon=True,
+            name=f"fedml-{self.name}-client-{rank}",
+        )
+        self.threads.append(t)
+        t.start()
+        # the join announcement: the server answers with an assignment
+        # (admitted) or FINISH (fleet at max_workers). Handlers register
+        # inside client.run(); the reply queues in the inbox either way.
+        client.send_message(Message(MT.C2S_JOIN, rank, 0))
+        return client
+
+    def remove_worker(self, rank: Optional[int] = None):
+        """Elastic leave (FedBuff): ask one worker (highest-rank live one
+        by default) to leave at its next dispatch. Returns it, or None
+        when nobody is eligible."""
+        if self.mode != "fedbuff":
+            raise RuntimeError(
+                "elastic join/leave is a FedBuff (async) session feature"
+            )
+        dead = set(getattr(self.server, "_dead_workers", ()) or ())
+        candidates = [
+            c for c in self.clients
+            if not c.left and not c._leave_requested
+            # a FINISHed worker can't leave again — and the server-side
+            # dead set covers the race where a REFUSED joiner hasn't
+            # processed its FINISH yet (its _got_finish lags the server's
+            # joins_refused counter; picking it would lose the leave,
+            # since a refused worker never gets the dispatch the leave
+            # rides on)
+            and not c._got_finish and c.rank not in dead
+            and (rank is None or c.rank == rank)
+        ]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda c: c.rank)
+        victim.request_leave()
+        return victim
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        """JSON-ready snapshot for the service ops surface."""
+        row = {
+            "name": self.name,
+            "state": self.state,
+            "algorithm": self.algorithm,
+            "runtime": self.runtime,
+            "mode": self.mode,
+            "workers": len(self.clients),
+        }
+        server = self.server
+        if server is not None:
+            if self.mode == "fedbuff":
+                row.update(
+                    server_steps=server.server_steps,
+                    version=server.version,
+                    target_steps=self.config.fed.comm_round,
+                    joins_accepted=server.joins_accepted,
+                    joins_refused=server.joins_refused,
+                    leaves=server.leaves,
+                )
+            else:
+                row.update(
+                    round=server.round_idx,
+                    target_rounds=self.config.fed.comm_round,
+                )
+        if self.scope is not None:
+            row["compile/recompiles"] = self.scope.recompiles()
+        return row
+
+    def summary_row(self) -> dict:
+        """Flat per-tenant MetricsLogger row for the service's aggregate
+        summary.json (FederationServer prefixes it ``tenants/<name>/``)."""
+        row = dict(self.status())
+        row.pop("name", None)
+        server = self.server
+        if server is not None and server.history:
+            last = server.history[-1]
+            for key in ("Test/Acc", "Test/Loss", "t_s"):
+                if key in last:
+                    row[key] = last[key]
+        if self.scope is not None:
+            snap = self.scope.comm_meter.snapshot()
+            row["comm_messages_sent"] = sum(snap["messages_sent"].values())
+            row["comm_bytes_sent"] = sum(snap["bytes_sent"].values())
+        return row
+
+    @property
+    def history(self):
+        return self.server.history if self.server is not None else []
+
+    @property
+    def global_vars(self):
+        return self.server.global_vars if self.server is not None else None
+
+
+def _slug(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "-" for c in str(name))

@@ -20,6 +20,12 @@ many bytes crossed each transport":
   straggler flag) fed from the span stream or explicit observations.
 - :mod:`fedml_tpu.telemetry.prometheus` — stdlib-only ``/metrics`` HTTP
   endpoint (off by default; CLI flag ``--prom_port``).
+- :mod:`fedml_tpu.telemetry.scope` — thread-scoped
+  :class:`TelemetryScope` (per-tenant tracer/registry/comm meter) for the
+  multi-tenant federation service (fedml_tpu/serve/); the ``get_*``
+  accessors consult the active scope and fall back to the process
+  globals, so single-run paths are byte-identical. One exporter serves
+  every tenant through :class:`TenantedRegistryView` (``tenant`` label).
 
 Everything here is stdlib-only on purpose: telemetry must be importable
 before (and without) jax, and must never add a hot-path dependency."""
@@ -31,10 +37,24 @@ from fedml_tpu.telemetry.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    TenantedRegistryView,
+    get_global_registry,
     get_registry,
 )
 from fedml_tpu.telemetry.prometheus import PrometheusExporter
-from fedml_tpu.telemetry.spans import Span, SpanEvent, Tracer, get_tracer, span
+from fedml_tpu.telemetry.scope import (
+    TelemetryScope,
+    activate_scope,
+    current_scope,
+)
+from fedml_tpu.telemetry.spans import (
+    Span,
+    SpanEvent,
+    Tracer,
+    get_global_tracer,
+    get_tracer,
+    span,
+)
 
 __all__ = [
     "ClientHealthRegistry",
@@ -46,8 +66,14 @@ __all__ = [
     "PrometheusExporter",
     "Span",
     "SpanEvent",
+    "TelemetryScope",
+    "TenantedRegistryView",
     "Tracer",
+    "activate_scope",
+    "current_scope",
     "get_comm_meter",
+    "get_global_registry",
+    "get_global_tracer",
     "get_registry",
     "get_tracer",
     "span",

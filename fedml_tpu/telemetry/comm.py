@@ -124,10 +124,20 @@ class CommMeter:
 _GLOBAL: Optional[CommMeter] = None
 _GLOBAL_LOCK = threading.Lock()
 
+from fedml_tpu.telemetry.scope import current_scope  # noqa: E402 — after
+# CommMeter so scope.py's lazy constructor can import it (no cycle)
+
 
 def get_comm_meter() -> CommMeter:
-    """Process-wide meter every BaseCommManager reports into. Lazy so the
-    instruments only appear in the registry once comm is actually used."""
+    """The meter for the calling thread: the active
+    :class:`fedml_tpu.telemetry.scope.TelemetryScope`'s meter when one is
+    installed (each serving tenant's transports account into their own
+    meter/registry), else the process-wide meter every single-run
+    BaseCommManager reports into. Lazy so the global instruments only
+    appear in the registry once comm is actually used."""
+    sc = current_scope()
+    if sc is not None:
+        return sc.comm_meter
     global _GLOBAL
     if _GLOBAL is None:
         with _GLOBAL_LOCK:

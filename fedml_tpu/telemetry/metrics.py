@@ -63,8 +63,18 @@ class _Instrument:
             )
         return tuple(str(labels[n]) for n in self.labelnames)
 
-    def render(self) -> List[str]:
+    def samples(self, extra: str = "") -> List[str]:
+        """Sample lines only (no HELP/TYPE header). ``extra`` is a
+        pre-formatted label fragment (e.g. ``tenant="a"``) appended to
+        every sample's label set — the multi-tenant exporter's injection
+        point (:class:`TenantedRegistryView`)."""
         raise NotImplementedError
+
+    def render(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ] + self.samples()
 
 
 class Counter(_Instrument):
@@ -93,15 +103,14 @@ class Counter(_Instrument):
         with self._lock:
             return dict(self._values)
 
-    def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+    def samples(self, extra: str = "") -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
-        for key, v in items:
-            out.append(
-                f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(v)}"
-            )
-        return out
+        return [
+            f"{self.name}{_fmt_labels(self.labelnames, key, extra)} "
+            f"{_fmt_value(v)}"
+            for key, v in items
+        ]
 
 
 class Gauge(_Instrument):
@@ -124,15 +133,14 @@ class Gauge(_Instrument):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
-    def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+    def samples(self, extra: str = "") -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
-        for key, v in items:
-            out.append(
-                f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(v)}"
-            )
-        return out
+        return [
+            f"{self.name}{_fmt_labels(self.labelnames, key, extra)} "
+            f"{_fmt_value(v)}"
+            for key, v in items
+        ]
 
 
 class Histogram(_Instrument):
@@ -178,8 +186,8 @@ class Histogram(_Instrument):
         with self._lock:
             return self._sums.get(self._key(labels), 0.0)
 
-    def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def samples(self, extra: str = "") -> List[str]:
+        out: List[str] = []
         with self._lock:
             items = sorted(self._counts.items())
             sums = dict(self._sums)
@@ -190,17 +198,19 @@ class Histogram(_Instrument):
                 cum += c
                 le = "+Inf" if math.isinf(ub) else repr(ub)
                 le_label = 'le="%s"' % le
+                if extra:
+                    le_label = f"{extra},{le_label}"
                 out.append(
                     f"{self.name}_bucket"
                     f"{_fmt_labels(self.labelnames, key, le_label)} "
                     f"{_fmt_value(cum)}"
                 )
             out.append(
-                f"{self.name}_sum{_fmt_labels(self.labelnames, key)} "
+                f"{self.name}_sum{_fmt_labels(self.labelnames, key, extra)} "
                 f"{_fmt_value(sums[key])}"
             )
             out.append(
-                f"{self.name}_count{_fmt_labels(self.labelnames, key)} "
+                f"{self.name}_count{_fmt_labels(self.labelnames, key, extra)} "
                 f"{_fmt_value(totals[key])}"
             )
         return out
@@ -269,7 +279,85 @@ class MetricsRegistry:
 
 _GLOBAL = MetricsRegistry()
 
+from fedml_tpu.telemetry.scope import current_scope  # noqa: E402 — after
+# MetricsRegistry so scope.py's lazy constructor can import it (no cycle)
+
 
 def get_registry() -> MetricsRegistry:
-    """The process-wide registry the Prometheus exporter serves."""
+    """The registry for the calling thread: the active
+    :class:`fedml_tpu.telemetry.scope.TelemetryScope`'s registry when one
+    is installed (per-tenant instruments in multi-tenant serving), else
+    the process-wide registry the single-run Prometheus exporter serves."""
+    sc = current_scope()
+    return sc.registry if sc is not None else _GLOBAL
+
+
+def get_global_registry() -> MetricsRegistry:
+    """The process-wide registry, regardless of any active scope —
+    process-wide facts (ProgramCache gauges, backend-compile totals) must
+    publish here so a tenant registry never carries a process total under
+    a tenant label."""
     return _GLOBAL
+
+
+class TenantedRegistryView:
+    """Composite render view over the global registry plus N per-tenant
+    registries — what ONE Prometheus exporter serves for a multi-tenant
+    federation service (fedml_tpu/serve/).
+
+    Tenant registries' samples get a ``tenant="<name>"`` label injected;
+    the base registry's samples stay unlabeled. The exposition format
+    requires each metric name to appear in exactly one HELP/TYPE block,
+    so rendering groups samples across registries by metric name (N
+    tenants recording ``fedml_comm_bytes_sent_total`` yield one block
+    with N × label-set sample lines). Duck-typed against
+    :class:`PrometheusExporter`'s ``registry`` slot (it only calls
+    ``render()``)."""
+
+    def __init__(
+        self,
+        base: Optional[MetricsRegistry] = None,
+        label: str = "tenant",
+    ):
+        self._lock = threading.Lock()
+        self._base = base
+        self._label = label
+        self._tenants: Dict[str, MetricsRegistry] = {}
+
+    def add_tenant(self, name: str, registry: MetricsRegistry) -> None:
+        with self._lock:
+            self._tenants[str(name)] = registry
+
+    def remove_tenant(self, name: str) -> None:
+        with self._lock:
+            self._tenants.pop(str(name), None)
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def render(self) -> str:
+        with self._lock:
+            sources = [("", self._base)] if self._base is not None else []
+            sources += [
+                (f'{self._label}="{_escape_label(name)}"', reg)
+                for name, reg in sorted(self._tenants.items())
+            ]
+        groups: Dict[str, tuple] = {}
+        for extra, reg in sources:
+            for inst in reg.instruments():
+                g = groups.get(inst.name)
+                if g is None:
+                    groups[inst.name] = g = (inst.kind, inst.help, [])
+                elif g[0] != inst.kind:
+                    # name registered with different kinds across tenants:
+                    # keep the first block valid, skip the clashing samples
+                    continue
+                g[2].extend(inst.samples(extra))
+        lines: List[str] = []
+        for name in sorted(groups):
+            kind, help, samples = groups[name]
+            lines.append(f"# HELP {name} {help}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,139 @@
+"""Thread-scoped telemetry — the instance isolation layer multi-tenant
+serving needs (fedml_tpu/serve/).
+
+Every telemetry entry point used to be a process singleton: ONE tracer
+(`spans._GLOBAL`), ONE metrics registry, ONE comm meter. That is exactly
+right for the single-run CLI (one federation per process, summary.json as
+the CI oracle) and exactly wrong for a federation SERVICE, where N
+co-tenant federations share one process and one TPU: their round spans
+would interleave in one trace, their comm byte counters would sum into one
+unlabeled total, and their health gauges would overwrite each other.
+
+A :class:`TelemetryScope` bundles one tenant's telemetry instances —
+tracer, metrics registry, comm meter, and per-scope XLA-compile
+attribution counters — and installs them on a per-THREAD stack.
+``get_tracer()`` / ``get_registry()`` / ``get_comm_meter()`` consult
+:func:`current_scope` first and fall back to the process globals, so:
+
+- code that never activates a scope (the whole single-run CLI path, every
+  existing test) behaves byte-identically — the globals are still the
+  globals;
+- a federation session that wraps its server/client/worker threads in
+  ``scope.activate()`` gets fully instance-scoped telemetry without any
+  call site changing: the managers, trainers, health registries, and
+  comm meters it constructs on those threads all land in the scope.
+
+Threads do NOT inherit the scope automatically (thread-locals don't
+propagate); whoever spawns a thread for a scoped workload must wrap the
+thread body (``scope.wrap(fn)`` or ``with activate_scope(scope):``). The
+session runner (fedml_tpu/serve/session.py) owns every thread of a
+federation, so it is the single propagation point.
+
+Process-wide facts stay process-wide on purpose: the ProgramCache gauges
+and the backend-compile gauge publish into the GLOBAL registry
+(``get_global_registry``) even when a scope is active — a per-tenant
+registry must never carry a process total under a tenant label. Per-scope
+compile ATTRIBUTION is separate: the sentinel's jax.monitoring listeners
+increment ``scope.backend_compiles``/``scope.persistent_cache_hits`` for
+the scope active on the compiling thread, which is how a co-tenant
+session proves ``compile/recompiles == 0`` (cross-tenant executable
+sharing, docs/SERVING.md)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+_TLS = threading.local()
+
+
+def _stack():
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def current_scope() -> Optional["TelemetryScope"]:
+    """The innermost scope activated on THIS thread, or None (globals)."""
+    st = getattr(_TLS, "stack", None)
+    return st[-1] if st else None
+
+
+class TelemetryScope:
+    """One tenant's telemetry instances + compile-attribution counters."""
+
+    def __init__(
+        self,
+        tenant: Optional[str] = None,
+        tracer=None,
+        registry=None,
+        comm_meter=None,
+    ):
+        # lazy imports: scope.py must be importable from spans/metrics/comm
+        # without a cycle (they import current_scope at module level)
+        from fedml_tpu.telemetry.metrics import MetricsRegistry
+        from fedml_tpu.telemetry.spans import Tracer
+
+        self.tenant = tenant
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.tracer = tracer if tracer is not None else Tracer()
+        if tenant and self.tracer.process_label is None:
+            self.tracer.process_label = f"fedml_tpu tenant {tenant}"
+        if comm_meter is None:
+            from fedml_tpu.telemetry.comm import CommMeter
+
+            comm_meter = CommMeter(registry=self.registry)
+        self.comm_meter = comm_meter
+        # Per-scope XLA compile attribution, incremented by the sentinel's
+        # process-wide jax.monitoring listeners (analysis/sentinel.py)
+        # under its module lock, on whatever thread triggered the compile.
+        # recompiles() mirrors the sentinel's definition: backend-compile
+        # events minus persistent-cache hits (a hit deserializes an
+        # already-compiled program — not a compile).
+        self.backend_compiles = 0
+        self.persistent_cache_hits = 0
+
+    def recompiles(self) -> int:
+        """ACTUAL XLA compiles attributed to threads running under this
+        scope (see :mod:`fedml_tpu.analysis.sentinel` for the event
+        accounting). 0 for a co-tenant session whose programs were all
+        compiled — or deserialized — by an earlier tenant."""
+        return max(0, self.backend_compiles - self.persistent_cache_hits)
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Install this scope on the calling thread for the duration."""
+        st = _stack()
+        st.append(self)
+        try:
+            yield self
+        finally:
+            # remove THIS scope specifically: a mis-nested exit must not
+            # pop someone else's scope off the stack
+            if st and st[-1] is self:
+                st.pop()
+            elif self in st:
+                st.remove(self)
+
+    def wrap(self, fn):
+        """A callable that runs ``fn`` under this scope — the thread-body
+        propagation helper (thread-locals don't cross Thread boundaries)."""
+
+        def _scoped(*args, **kwargs):
+            with self.activate():
+                return fn(*args, **kwargs)
+
+        return _scoped
+
+    def __repr__(self):
+        return f"TelemetryScope(tenant={self.tenant!r})"
+
+
+def activate_scope(scope: Optional[TelemetryScope]):
+    """None-tolerant ``scope.activate()``: a no-op context manager when
+    ``scope`` is None, so ambient-scope code paths need no branching."""
+    if scope is None:
+        return contextlib.nullcontext()
+    return scope.activate()

@@ -258,12 +258,27 @@ class Tracer:
 
 _GLOBAL = Tracer()
 
+from fedml_tpu.telemetry.scope import current_scope  # noqa: E402 — import
+# placed after Tracer so scope.py's lazy constructor can import it; scope
+# itself imports nothing from telemetry at module level (no cycle)
+
 
 def get_tracer() -> Tracer:
-    """The process-wide tracer every subsystem records into by default."""
+    """The tracer for the calling thread: the active
+    :class:`fedml_tpu.telemetry.scope.TelemetryScope`'s tracer when one is
+    installed (multi-tenant serving — each session's threads record into
+    their own trace), else the process-wide default every single-run path
+    records into."""
+    sc = current_scope()
+    return sc.tracer if sc is not None else _GLOBAL
+
+
+def get_global_tracer() -> Tracer:
+    """The process-wide tracer, regardless of any active scope."""
     return _GLOBAL
 
 
 def span(name: str, **attrs) -> Span:
-    """``with span("round", round=n): ...`` on the global tracer."""
-    return _GLOBAL.span(name, **attrs)
+    """``with span("round", round=n): ...`` on the calling thread's tracer
+    (scope-aware, see :func:`get_tracer`)."""
+    return get_tracer().span(name, **attrs)
