@@ -205,21 +205,84 @@ if python -m fedml_tpu --algorithm fedavg --model lr --dataset synthetic \
 fi
 echo "  recompile_budget ok"
 
-echo "== serve soak smoke: 3 concurrent tenants, churning fleet, shared executables (docs/SERVING.md) =="
+echo "== chaos: record a fault trace, replay it byte-identically (docs/SCHEDULING.md) =="
+# Record: a probabilistically-faulted quorum run — the server health
+# registry logs every injected (client, round) fault event with its
+# magnitude and --telemetry_dir exports it as fault_trace.json. Replay:
+# --fault_plan trace:<that file> re-injects the exact events (scripted,
+# not re-sampled), so the faults/* summary rows AND the numerics must be
+# byte-identical. ROADMAP 5a: CI replays observed fleets, not
+# hand-written JSON.
+CHAOS=$(mktemp -d)
+CHAOS_CFG="--algorithm fedavg --runtime loopback --model lr \
+  --dataset synthetic --client_num_in_total 6 --client_num_per_round 3 \
+  --comm_round 4 --batch_size 8 --deadline_s 5 --min_clients 1"
+python -m fedml_tpu $CHAOS_CFG \
+  --fault_plan '{"seed": 2, "default": {"dropout_p": 0.3}, "clients": {"1": {"slowdown_s": 0.02}}}' \
+  --telemetry_dir "$CHAOS/rec" --log_dir "$CHAOS/rec_logs" > /dev/null
+python -m fedml_tpu $CHAOS_CFG \
+  --fault_plan "trace:$CHAOS/rec/fault_trace.json" \
+  --telemetry_dir "$CHAOS/rep" --log_dir "$CHAOS/rep_logs" > /dev/null
+python - "$CHAOS" <<'PY'
+import json, sys
+d = sys.argv[1]
+rec = json.load(open(f"{d}/rec_logs/summary.json"))
+rep = json.load(open(f"{d}/rep_logs/summary.json"))
+fkeys = sorted(k for k in rec if k.startswith("faults/"))
+assert fkeys, rec
+diff = {k: (rec[k], rep.get(k)) for k in fkeys if rec[k] != rep.get(k)}
+assert not diff, f"replayed faults diverged: {diff}"
+assert rec["faults/total"] > 0, rec      # the recording run really faulted
+assert rep["Test/Loss"] == rec["Test/Loss"]  # same faults -> same numerics
+print(f"  trace replay ok: {({k: int(rec[k]) for k in fkeys})} byte-identical")
+PY
+
+echo "== chaos: flaky transport — injected send failures, retries survive (docs/OBSERVABILITY.md) =="
+# A fault-free run vs the same config under transport chaos
+# (--send_fault_p fails attempts before the wire; --send_retries redial
+# with deterministic backoff). Gates: retries happened, nothing gave up,
+# numerics unchanged.
+python -m fedml_tpu $CHAOS_CFG \
+  --telemetry_dir "$CHAOS/clean_tel" --log_dir "$CHAOS/clean_logs" > /dev/null
+python -m fedml_tpu $CHAOS_CFG \
+  --send_retries 6 --send_fault_p 0.25 --send_backoff_s 0.002 \
+  --telemetry_dir "$CHAOS/flaky_tel" --log_dir "$CHAOS/flaky_logs" > /dev/null
+python - "$CHAOS" <<'PY'
+import json, sys
+d = sys.argv[1]
+clean = json.load(open(f"{d}/clean_logs/summary.json"))
+flaky = json.load(open(f"{d}/flaky_logs/summary.json"))
+assert flaky["comm/retries"] > 0, flaky
+assert flaky["comm/gave_up"] == 0, flaky
+assert clean["comm/retries"] == 0, clean
+assert flaky["Test/Loss"] == clean["Test/Loss"], (clean, flaky)
+print(f"  flaky transport ok: {int(flaky['comm/retries'])} retries, "
+      f"0 gave up, numerics identical to fault-free")
+PY
+rm -rf "$CHAOS"
+
+echo "== serve soak smoke: 3 concurrent tenants, churning fleet, shared executables, self-healing kill (docs/SERVING.md) =="
 # Three tenants in ONE process over one device: soak_a and soak_b share a
 # model family (soak_b must prove cross-tenant program sharing with
 # compile/recompiles == 0 via the sentinel's per-scope attribution),
 # soak_c is a distinct family running the sync path. soak_a's FedBuff
-# fleet churns (joins/leaves + one refused join at max_workers). Gates:
-# >= 1000 rounds total, flat RSS between the warm mark and the end,
-# scrapeable per-tenant metrics from one /metrics endpoint.
+# fleet churns (joins/leaves + one refused join at max_workers). soak_d
+# is SUPERVISED and killed mid-flight — the supervisor must restore it
+# from its rolling checkpoint with final numerics bit-identical to an
+# uninterrupted run (the PR-9 kill/resume parity, now driven
+# automatically). Gates: >= 1000 rounds total, flat RSS between the warm
+# mark and the end, scrapeable per-tenant metrics from one /metrics
+# endpoint, tenant-labeled restart counters.
 timeout 600 python - <<'PY'
-import threading, time, urllib.request
+import tempfile, threading, time, urllib.request
+
+import jax
+import numpy as np
 
 from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
 from fedml_tpu.data.synthetic import synthetic_classification
 from fedml_tpu.models import create_model
-from fedml_tpu.serve import FederationServer
+from fedml_tpu.serve import FederationServer, FedSession, RestartPolicy
 
 def rss_mb():
     with open("/proc/self/status") as f:
@@ -244,6 +307,17 @@ other = synthetic_classification(num_clients=12, num_classes=4, feat_shape=(28,)
                                  samples_per_client=32, partition_method="homo", seed=1)
 other_model = create_model("lr", "synthetic", (28,), 4)
 
+# soak_d: the self-healing tenant (a THIRD model family so its reference
+# run cannot pre-warm soak_a's programs and void the attribution gate).
+# K=1 worker with async_buffer_k=1 keeps the async pipeline sequential,
+# so kill/resume parity is exact, not approximate.
+heal = synthetic_classification(num_clients=12, num_classes=4, feat_shape=(12,),
+                                samples_per_client=32, partition_method="homo", seed=2)
+heal_model = create_model("lr", "synthetic", (12,), 4)
+# uninterrupted reference, run to completion before the service starts
+ref = FedSession(cfg(60, 1, 1, 5), heal, heal_model, algorithm="fedbuff").run()
+assert ref.server_steps == 60
+
 srv = FederationServer(prom_port=0)
 a = srv.create_session("soak_a", cfg(380, 3, 2, 0), fam, fam_model,
                        algorithm="fedbuff", max_workers=4)
@@ -252,6 +326,22 @@ b = srv.create_session("soak_b", cfg(420, 3, 2, 7), fam, fam_model,
 c = srv.create_session("soak_c", cfg(250, 2, 0, 3, freq=250),
                        other, other_model, algorithm="fedavg")
 
+killed = {"done": False}
+def chaos_kill(row):
+    # one-shot mid-flight kill at step 20: the crash surfaces in the
+    # server FSM, the supervisor restarts the tenant from its rolling
+    # checkpoint, and the continuation must be bit-identical
+    if row.get("server_step") == 20 and not killed["done"]:
+        killed["done"] = True
+        raise RuntimeError("soak chaos kill")
+
+heal_dir = tempfile.mkdtemp(prefix="fedml_soak_heal_")
+d = srv.create_session("soak_d", cfg(60, 1, 1, 5), heal, heal_model,
+                       algorithm="fedbuff",
+                       restart=RestartPolicy(budget=2, backoff_base_s=0.05),
+                       checkpoint_path=f"{heal_dir}/ck", checkpoint_every=1,
+                       log_fn=chaos_kill)
+
 # soak_a first: the family's compiles are attributed to it; soak_b joins
 # once the family is warm and must compile NOTHING
 srv.start(names=["soak_a"])
@@ -259,7 +349,7 @@ t0 = time.time()
 while a.server.server_steps < 60:
     assert time.time() - t0 < 180, "soak_a stalled"
     time.sleep(0.05)
-srv.start(names=["soak_b", "soak_c"])
+srv.start(names=["soak_b", "soak_c", "soak_d"])
 
 # churn soak_a's fleet. Each transition waits for the server-side
 # counter so the sequence is deterministic: the backpressure probe sees
@@ -301,9 +391,23 @@ assert body.count("# TYPE fedml_comm_messages_sent_total counter") == 1
 churner.join(timeout=120)
 results = srv.wait(timeout=420)
 end_rss = rss_mb()
+final_metrics = srv.render_metrics()
 srv.close()
 
 assert all(r["ok"] for r in results.values()), results
+# self-healing: the killed tenant recovered (1 restart), reached its
+# target, and its final model is bit-identical to never having died
+assert killed["done"], "the chaos kill never fired"
+assert d.restarts == 1, d.restarts
+assert d.server.server_steps == 60
+for la, lb in zip(jax.tree_util.tree_leaves(ref.global_vars),
+                  jax.tree_util.tree_leaves(d.global_vars)):
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+assert results["soak_d"]["summary"]["supervisor/restarts"] == 1
+assert results["soak_d"]["summary"]["supervisor/health"] == "degraded"
+assert 'fedml_session_restarts_total{tenant="soak_d"} 1.0' in final_metrics
+import shutil
+shutil.rmtree(heal_dir, ignore_errors=True)
 total_rounds = (a.server.server_steps + b.server.server_steps
                 + len(c.history))
 assert a.server.server_steps == 380 and b.server.server_steps == 420
@@ -320,11 +424,13 @@ assert growth < 64.0, f"RSS grew {growth:.1f} MB ({warm_rss:.0f} -> {end_rss:.0f
 # same-family tenant triggered zero XLA compiles of its own
 assert a.scope.recompiles() > 0, "attribution vacuous: soak_a compiled nothing?"
 assert b.scope.recompiles() == 0, b.scope.recompiles()
-print(f"  soak ok: {total_rounds} rounds across 3 tenants, "
+print(f"  soak ok: {total_rounds} rounds across 3 tenants "
+      f"(+60 self-healed in soak_d), "
       f"{a.server.joins_accepted} joins / {a.server.leaves} leaves / "
       f"{a.server.joins_refused} refused, RSS {warm_rss:.0f} -> "
       f"{end_rss:.0f} MB, soak_b recompiles == 0 "
-      f"(soak_a paid {a.scope.recompiles()})")
+      f"(soak_a paid {a.scope.recompiles()}), soak_d restored "
+      f"bit-identical after 1 mid-flight kill")
 PY
 
 echo "== serve CLI smoke: multi-tenant spec -> per-tenant summary rows =="

@@ -191,7 +191,7 @@ class FedAvgServerManager(ServerManager):
         server_opt: bool = False,
         faults=None,
     ):
-        super().__init__(comm, rank=0)
+        super().__init__(comm, rank=0, config=config)
         self.config = config
         self.model = model
         self.data = data
@@ -828,7 +828,7 @@ class FedAvgClientManager(ClientManager):
         ef=None,
         faults=None,
     ):
-        super().__init__(comm, rank)
+        super().__init__(comm, rank, config=config)
         self.config = config
         self.trainer = trainer
         # fault injection (scheduler/faults.FaultInjector, usually shared
@@ -919,7 +919,8 @@ class FedAvgClientManager(ClientManager):
         weights, n = self.trainer.train(round_idx, w_round)
         if fd is not None and fd.slowdown_s:
             self._faults.record(
-                int(self.trainer.client_index), int(round_idx), "slowdown"
+                int(self.trainer.client_index), int(round_idx), "slowdown",
+                detail=fd.slowdown_s,
             )
             time.sleep(fd.slowdown_s)
         comp = self.config.comm.compression

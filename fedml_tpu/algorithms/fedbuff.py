@@ -98,7 +98,7 @@ class FedBuffServerManager(ServerManager):
         log_fn=None,
         max_workers: Optional[int] = None,
     ):
-        super().__init__(comm, rank=0)
+        super().__init__(comm, rank=0, config=config)
         if config.fed.async_buffer_k <= 0:
             raise ValueError("FedBuff requires FedConfig.async_buffer_k > 0")
         self.config = config
@@ -546,7 +546,7 @@ class FedBuffClientManager(ClientManager):
         orphan_deadline_s: Optional[float] = None,
         faults=None,
     ):
-        super().__init__(comm, rank)
+        super().__init__(comm, rank, config=config)
         self.config = config
         self.trainer = trainer
         # fault injection (scheduler/faults.py), keyed by the dispatch tag
@@ -690,7 +690,10 @@ class FedBuffClientManager(ClientManager):
                 return
         new_vars, n = self.trainer.train(msg.get(MT.ARG_ROUND_IDX), w_base)
         if fd is not None and fd.slowdown_s:
-            self._faults.record(int(self.trainer.client_index), tag, "slowdown")
+            self._faults.record(
+                int(self.trainer.client_index), tag, "slowdown",
+                detail=fd.slowdown_s,
+            )
             time.sleep(fd.slowdown_s)
         delta = jax.tree_util.tree_map(
             lambda a, b: np.asarray(a) - np.asarray(b), new_vars, w_base

@@ -213,9 +213,36 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--fault_plan", type=str, default=None,
               help="Fault-injection plan (scheduler/faults.py): inline "
                    "JSON or a path to a JSON file — per-client dropout_p/"
-                   "slowdown_s/crash_at_round/flaky_upload_p, deterministic "
-                   "per (plan seed, client, round). Sync transport runs "
-                   "with participation faults require --deadline_s")
+                   "slowdown_s/crash_at_round/flaky_upload_p, plus device "
+                   "profiles ('profiles'/'fleet' keys) and scripted "
+                   "per-round events; 'trace:<path>' replays a recorded "
+                   "fault_trace.json byte-identically (the file "
+                   "--telemetry_dir writes). Deterministic per (plan "
+                   "seed, client, round). Sync transport runs with "
+                   "participation faults require --deadline_s")
+@click.option("--send_retries", type=int, default=0,
+              help="Transport runtimes: retry a failed send up to N times "
+                   "under seed-deterministic jittered exponential backoff "
+                   "(core/retry.py; at-least-once — FedBuff/sync servers "
+                   "dedupe re-deliveries). 0 = fail on first error. "
+                   "Retry/give-up counts land in summary.json "
+                   "(comm/retries, comm/gave_up) and Prometheus")
+@click.option("--send_backoff_s", type=float, default=0.05,
+              help="Retry backoff base in seconds (doubles per retry, "
+                   "jittered, capped at CommConfig.send_backoff_max_s)")
+@click.option("--send_timeout_s", type=float, default=30.0,
+              help="runtime=grpc: per-RPC send deadline (was hard-coded "
+                   "30 s). With --send_retries the retry layer owns "
+                   "reconnects, so first contact also fails fast at this "
+                   "timeout and retries instead of the one-shot 120 s "
+                   "wait_for_ready handshake")
+@click.option("--send_fault_p", type=float, default=0.0,
+              help="Transport chaos: fail each send ATTEMPT with this "
+                   "probability before it reaches the wire — "
+                   "deterministic in (seed, send seq, attempt), so a "
+                   "flaky-transport run replays identically; the "
+                   "surviving attempt delivers exactly once (numerics "
+                   "unchanged). Requires --send_retries >= 1")
 @click.option("--deadline_s", type=float, default=0.0,
               help="Transport runtimes: straggler deadline — after this many "
                    "seconds the server closes the round on a quorum instead "
@@ -375,6 +402,33 @@ def _validate_scheduler(config, opt) -> None:
         )
 
 
+def _validate_comm_retry(config, opt) -> None:
+    """Parse-time transport-retry validation: chaos without retries is a
+    guaranteed mid-run crash, and the vmap/mesh runtimes exchange no
+    messages for the flags to act on."""
+    comm = config.comm
+    if not 0.0 <= comm.send_fault_p < 1.0:
+        raise click.UsageError("--send_fault_p must be in [0, 1)")
+    if comm.send_retries < 0:
+        raise click.UsageError("--send_retries must be >= 0")
+    if comm.send_fault_p > 0 and comm.send_retries < 1:
+        raise click.UsageError(
+            "--send_fault_p injects transient send failures; without "
+            "--send_retries >= 1 the first injected failure kills the "
+            "sending actor instead of exercising the retry path"
+        )
+    if comm.send_timeout_s <= 0:
+        raise click.UsageError("--send_timeout_s must be > 0")
+    if (comm.send_retries or comm.send_fault_p) and opt["runtime"] in (
+        "vmap", "mesh"
+    ):
+        raise click.UsageError(
+            "--send_retries/--send_fault_p apply to the transport "
+            "runtimes (loopback/shm/grpc/mqtt); vmap/mesh rounds exchange "
+            "no messages, so the flags would be silently ignored"
+        )
+
+
 # Algorithms whose round-0 programs warmup_api/warmup_local_train can
 # actually enumerate: the standard FedAvgAPI round/eval/server-step family.
 # scaffold/ditto/dp_fedavg/hierarchical run bespoke train_round loops
@@ -519,6 +573,10 @@ def build_config(opt) -> RunConfig:
             topk_frac=opt.get("topk_frac", 0.01),
             error_feedback=opt.get("error_feedback", False),
             secure_agg=opt.get("secure_agg", False),
+            send_retries=opt.get("send_retries", 0) or 0,
+            send_backoff_s=opt.get("send_backoff_s", 0.05),
+            send_timeout_s=opt.get("send_timeout_s", 30.0),
+            send_fault_p=opt.get("send_fault_p", 0.0) or 0.0,
         ),
         mesh=MeshConfig(client_shards=opt["client_shards"]),
         compile=CompileConfig(
@@ -591,6 +649,17 @@ def _telemetry_finish(state, opt, logger, health=None):
         if health is not None:
             with open(tdir / f"health{suffix}.json", "w") as f:
                 json.dump(health.snapshot(), f, indent=2)
+            if hasattr(health, "export_trace") and opt.get("algorithm") != "fedbuff":
+                # the observed fleet as a replayable FaultTrace
+                # (scheduler/faults.py): --fault_plan trace:<this file>
+                # re-injects the exact recorded dropout/slowdown/flaky
+                # events, byte-identically (docs/SCHEDULING.md). FedBuff
+                # records fault events keyed by DISPATCH TAG, not round —
+                # such a trace cannot replay faithfully, so none is
+                # written (trace replay targets the round-keyed runtimes)
+                health.export_trace(
+                    rounds=1 if opt.get("ci") else opt.get("comm_round")
+                ).save(str(tdir / f"fault_trace{suffix}.json"))
         click.echo(f"telemetry: wrote {trace_path}", err=True)
     if state.get("exporter") is not None:
         state["exporter"].stop()
@@ -654,6 +723,7 @@ def run(**opt):
     _dp_cfg(opt)
     _validate_scheduler(config, opt)
     _validate_compile(config, opt)
+    _validate_comm_retry(config, opt)
     restore_compile_cache = None
     if config.compile.cache_dir:
         # BEFORE any jit: every compile of this run should be eligible
@@ -1473,7 +1543,10 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
         table = read_ip_config(str(opt["ip_config"]))
     else:
         table = {r: "127.0.0.1" for r in range(K + 1)}
-    comm = GrpcCommManager(rank, table, base_port=opt["base_port"])
+    comm = GrpcCommManager(
+        rank, table, base_port=opt["base_port"],
+        send_timeout_s=config.comm.send_timeout_s,
+    )
     # per-process fault injector (client ranks only): the plan is
     # deterministic in (seed, client, round), so every process injects
     # the same faults; the server infers dropouts from its quorum rounds

@@ -175,6 +175,31 @@ class CommConfig:
     # coordinates accumulate and ship in later rounds instead of being
     # lost. Off by default (stateless-client parity with the reference).
     error_feedback: bool = False
+    # Transport send retry (core/retry.py, applied once in the
+    # BaseCommManager send template): a failed send is retried up to this
+    # many times under seed-deterministic jittered exponential backoff.
+    # 0 = legacy single-attempt sends. At-least-once safe: FedBuff
+    # dedupes restated uploads on the dispatch tag, the sync server on
+    # (client, round)/worker slot.
+    send_retries: int = 0
+    send_backoff_s: float = 0.05  # backoff base (doubles per retry)
+    send_backoff_max_s: float = 2.0  # per-sleep cap
+    # Total wall-clock a logical send may spend across attempts + backoff
+    # sleeps; the send gives up early when the next sleep would cross it.
+    # 0 = attempts cap only.
+    send_retry_deadline_s: float = 0.0
+    # Per-RPC deadline for grpc sends (was a hard-coded 30.0 in
+    # grpc_comm._send). With send_retries > 0 the retry layer owns
+    # reconnects: every attempt — including first contact, which still
+    # waits for the peer's server to bind — is capped here instead of
+    # the legacy one-shot 120 s wait_for_ready handshake.
+    send_timeout_s: float = 30.0
+    # Transport chaos: probability an individual send ATTEMPT fails with
+    # an injected transient error before reaching the wire — pure in
+    # (seed, send seq, attempt), so a flaky-transport run replays
+    # identically. The eventual successful attempt delivers exactly once
+    # (numerics identical to a fault-free run). Requires send_retries > 0.
+    send_fault_p: float = 0.0
     # Secure aggregation in the round loop (ref distributed turboaggregate):
     # clients upload pairwise-masked field vectors of their weighted
     # deltas; the server only ever sums masked uploads, and a quorum round

@@ -10,15 +10,25 @@ a template method (accounting + delegate to the backend's ``_send``) and
 per-message-type message/byte counters and latency histograms for free
 (fedml_tpu/telemetry/comm.py). Wire sizes come from the envelope itself:
 ``Message.to_wire_parts``/``from_bytes`` stamp the serialized size on the
-message, so accounting costs no extra serialization pass."""
+message, so accounting costs no extra serialization pass.
+
+Retries ride the same template (core/retry.py): when a
+:class:`~fedml_tpu.core.retry.RetryPolicy` is installed
+(``set_retry_policy`` — the manager base does it from CommConfig), a
+failed ``_send`` backs off with seed-deterministic jitter and tries
+again up to the policy's attempt/deadline caps, with retry/give-up
+counts flowing into the comm meter. No policy installed = the exact
+legacy path (one attempt, failure raises, nothing counted as sent)."""
 
 from __future__ import annotations
 
 import abc
+import itertools
 import time
-from typing import List
+from typing import List, Optional
 
 from fedml_tpu.core.message import Message
+from fedml_tpu.core.retry import InjectedSendFault, RetryPolicy
 from fedml_tpu.telemetry.comm import get_comm_meter
 
 
@@ -31,6 +41,17 @@ class BaseCommManager(abc.ABC):
     def __init__(self):
         self._observers: List[Observer] = []
         self._meter = get_comm_meter()
+        # send retry policy (core/retry.py): installed once by the manager
+        # base (_ManagerBase) from CommConfig.send_*; None = legacy
+        # single-attempt sends. The per-manager send sequence keys the
+        # deterministic jitter/chaos streams — each manager's sends are
+        # issued in deterministic order (one actor thread per manager), so
+        # the whole retry schedule replays run over run.
+        self.retry_policy: Optional[RetryPolicy] = None
+        self._send_seq = itertools.count()
+
+    def set_retry_policy(self, policy: Optional[RetryPolicy]) -> None:
+        self.retry_policy = policy
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -55,14 +76,60 @@ class BaseCommManager(abc.ABC):
 
     def send_message(self, msg: Message, **kwargs) -> None:
         """Template method: delegate to the backend ``_send``, then account
-        (messages/bytes sent + send-call latency) — a failed send raises
-        through and is NOT counted as sent."""
-        t0 = time.perf_counter()
-        self._send(msg, **kwargs)
+        (messages/bytes sent + send-call latency) — a send that (finally)
+        failed raises through and is NOT counted as sent.
+
+        With a retry policy installed, a failed attempt is retried under
+        jittered exponential backoff up to ``max_attempts``/``deadline_s``
+        (core/retry.py); retries are at-least-once — safe because FedBuff
+        dedupes restated uploads on the dispatch tag and the sync server
+        dedupes on (client, round). Retry/give-up counts land in the comm
+        meter (``comm/retries`` / ``comm/gave_up`` in summary.json, the
+        ``fedml_comm_send_retries_total`` family in Prometheus)."""
+        policy = self.retry_policy
+        if policy is None:
+            t0 = time.perf_counter()
+            self._send(msg, **kwargs)
+            wire_s = time.perf_counter() - t0
+        else:
+            start = time.perf_counter()
+            seq = next(self._send_seq)
+            mt = msg.get_type()
+            attempt = 0
+            while True:
+                try:
+                    if policy.injects(seq, attempt):
+                        raise InjectedSendFault(
+                            f"chaos: injected transient send failure "
+                            f"(msg_type={mt}, seq={seq}, attempt={attempt})"
+                        )
+                    t0 = time.perf_counter()
+                    self._send(msg, **kwargs)
+                    # the histogram records the SUCCESSFUL attempt's wire
+                    # time only — backoff sleeps and failed attempts would
+                    # otherwise drown real transport latency in the
+                    # injected sleep schedule
+                    wire_s = time.perf_counter() - t0
+                    break
+                # Exception, not BaseException: KeyboardInterrupt/
+                # SystemExit must abort the send, not be retried N times
+                # under backoff
+                except Exception:  # noqa: BLE001 — transport boundary
+                    attempt += 1
+                    delay = policy.backoff_s(seq, attempt)
+                    out_of_attempts = attempt >= policy.max_attempts
+                    out_of_time = bool(policy.deadline_s) and (
+                        time.perf_counter() - start + delay > policy.deadline_s
+                    )
+                    if out_of_attempts or out_of_time:
+                        self._meter.on_send_gave_up(mt)
+                        raise
+                    self._meter.on_send_retry(mt)
+                    time.sleep(delay)
         self._meter.on_sent(
             msg.get_type(),
             getattr(msg, "_wire_nbytes", None),
-            time.perf_counter() - t0,
+            wire_s,
         )
 
     @abc.abstractmethod

@@ -54,6 +54,8 @@ class GrpcCommManager(BaseCommManager):
         ip_config: Dict[int, str],
         base_port: int = 8890,
         bind_host: str = "0.0.0.0",
+        send_timeout_s: float = 30.0,
+        handshake_timeout_s: float = 120.0,
     ):
         import grpc
 
@@ -61,6 +63,11 @@ class GrpcCommManager(BaseCommManager):
         self.rank = rank
         self.ip_config = ip_config
         self.base_port = base_port
+        # per-send RPC deadline (was a hard-coded 30.0 in _send; now
+        # CommConfig.send_timeout_s via the CLI's --send_timeout_s) and the
+        # one-time first-contact allowance the no-retry path still uses
+        self.send_timeout_s = float(send_timeout_s)
+        self.handshake_timeout_s = float(handshake_timeout_s)
         self._q: "queue.Queue" = queue.Queue()
         self._channels: Dict[int, object] = {}
         self._handshaken: set = set()
@@ -104,25 +111,47 @@ class GrpcCommManager(BaseCommManager):
             _METHOD, request_serializer=None, response_deserializer=None
         )
 
-    def _send(self, msg: Message, timeout: Optional[float] = 30.0) -> None:
-        # wait_for_ready on the FIRST send per peer only: multi-process
-        # federation has no startup-order guarantee (ref run_*.sh scripts
-        # just background processes), so the handshake send blocks until the
-        # peer's server is up. After that a dead peer must fail FAST —
-        # _complete_round broadcasts while holding the round lock, and a
-        # 10-minute stall there would freeze every live client too.
+    def _send(self, msg: Message, timeout: Optional[float] = None) -> None:
         receiver = msg.get_receiver_id()
+        if self.retry_policy is not None:
+            # The retry layer (core/retry.py, via the send_message
+            # template) owns reconnects: every attempt is bounded by
+            # send_timeout_s and failures are retried under backoff — no
+            # one-shot 120 s handshake stall, no attempted-once handshake
+            # bookkeeping. Until a peer has answered once, attempts keep
+            # wait_for_ready=True (still capped at send_timeout_s) so the
+            # multi-process startup race waits for the peer's server to
+            # BIND instead of burning the whole retry budget on instant
+            # connection-refused errors; after first contact a dead peer
+            # fails fast and the backoff schedule owns the redials.
+            first = receiver not in self._handshaken
+            self._stub(receiver)(
+                msg.to_bytes(),
+                wait_for_ready=first,
+                timeout=timeout if timeout is not None else self.send_timeout_s,
+            )
+            self._handshaken.add(receiver)  # on SUCCESS only (vs legacy)
+            return
+        # Legacy single-attempt path: wait_for_ready on the FIRST send per
+        # peer only — multi-process federation has no startup-order
+        # guarantee (ref run_*.sh scripts just background processes), so
+        # the handshake send blocks until the peer's server is up. After
+        # that a dead peer must fail FAST — _complete_round broadcasts
+        # while holding the round lock, and a 10-minute stall there would
+        # freeze every live client too.
         first = receiver not in self._handshaken
         try:
             self._stub(receiver)(
                 msg.to_bytes(),
                 wait_for_ready=first,
-                timeout=120.0 if first else timeout,
+                timeout=self.handshake_timeout_s if first else (
+                    timeout if timeout is not None else self.send_timeout_s
+                ),
             )
         finally:
             # handshake is attempted-once, not succeeded-once: a peer that
             # died before its server came up must fail FAST on later sends
-            # (retrying the 120 s wait_for_ready every round would stall
+            # (retrying the long wait_for_ready every round would stall
             # the whole federation on one dead process)
             self._handshaken.add(receiver)
 

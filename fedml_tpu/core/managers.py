@@ -17,11 +17,22 @@ from fedml_tpu.core.message import Message
 
 
 class _ManagerBase(Observer):
-    def __init__(self, comm: BaseCommManager, rank: int):
+    def __init__(self, comm: BaseCommManager, rank: int, config=None):
         self.comm = comm
         self.rank = rank
         self._handlers: Dict[str, Callable[[Message], None]] = {}
         comm.add_observer(self)
+        # Transport retry (core/retry.py), wired ONCE here — the same
+        # single-point trick the comm meter uses — so every manager family
+        # on every backend gets CommConfig.send_* retries for free. The
+        # templates that never see a RunConfig (base_framework demos) pass
+        # no config and keep single-attempt sends.
+        if config is not None:
+            from fedml_tpu.core.retry import RetryPolicy
+
+            comm.set_retry_policy(
+                RetryPolicy.from_config(config.comm, seed=config.seed)
+            )
 
     def register_message_receive_handler(
         self, msg_type: str, handler: Callable[[Message], None]

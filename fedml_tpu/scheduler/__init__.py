@@ -24,9 +24,12 @@ Stdlib + numpy only — importable before (and without) jax, like
 telemetry; scheduling must never add a hot-path dependency."""
 
 from fedml_tpu.scheduler.faults import (
+    DEVICE_PROFILES,
+    DeviceProfile,
     FaultDecision,
     FaultInjector,
     FaultPlan,
+    FaultTrace,
 )
 from fedml_tpu.scheduler.policies import (
     POLICY_NAMES,
@@ -42,11 +45,14 @@ from fedml_tpu.scheduler.policies import (
 )
 
 __all__ = [
+    "DEVICE_PROFILES",
     "POLICY_NAMES",
     "ClientScheduler",
+    "DeviceProfile",
     "FaultDecision",
     "FaultInjector",
     "FaultPlan",
+    "FaultTrace",
     "OverprovisionPolicy",
     "SelectionContext",
     "SelectionPolicy",

@@ -18,6 +18,11 @@ checkpoints, and per-tenant observability. This package is that service:
   summary.json, drains/stops tenants individually.
 - :mod:`fedml_tpu.serve.cli` — ``python -m fedml_tpu serve --spec ...``:
   the multi-tenant entry point (JSON list of run configs).
+- :mod:`fedml_tpu.serve.supervisor` — :class:`SupervisedSession`: a
+  crashed tenant restarts from its latest rolling checkpoint under
+  jittered exponential backoff, bounded by a per-tenant restart budget
+  and a crash-loop breaker (self-healing; ``restart=`` on
+  ``create_session`` / ``restart_budget`` in a tenant spec).
 
 Co-tenant federations with the same model family share compiled programs
 for free: the ProgramCache digest (fedml_tpu/compile/) is process-wide by
@@ -27,5 +32,16 @@ the ci.sh soak gate). See docs/SERVING.md."""
 
 from fedml_tpu.serve.session import FedSession
 from fedml_tpu.serve.server import FederationServer
+from fedml_tpu.serve.supervisor import (
+    RestartBudgetExhausted,
+    RestartPolicy,
+    SupervisedSession,
+)
 
-__all__ = ["FedSession", "FederationServer"]
+__all__ = [
+    "FedSession",
+    "FederationServer",
+    "RestartBudgetExhausted",
+    "RestartPolicy",
+    "SupervisedSession",
+]

@@ -8,7 +8,11 @@ spec IS a run config — plus the session-level keys:
 
 ``name`` (required, unique), ``algorithm`` (fedavg|fedprox|fedopt|
 fedbuff), ``runtime`` (loopback|shm|mqtt), ``checkpoint_path``,
-``checkpoint_every``, ``resume``, ``max_workers``, ``warmup``.
+``checkpoint_every``, ``resume``, ``max_workers``, ``warmup`` — plus the
+self-healing keys (fedml_tpu/serve/supervisor.py): ``restart_budget``
+(int — supervise the tenant: a crash restarts it from its rolling
+checkpoint, at most this many times), ``restart_backoff_s``,
+``restart_backoff_max_s``, ``breaker_window``.
 
 Spec document shape: ``{"tenants": [...]}`` or a bare JSON list.
 
@@ -17,7 +21,14 @@ Per tenant the service writes a full per-tenant log dir
 single run writes) and, into the aggregate ``<log_dir>/summary.json``,
 one ``tenants/<name>/...`` row per tenant. ``--prom_port`` serves every
 tenant's metrics under a ``tenant`` label from one exporter. See
-docs/SERVING.md."""
+docs/SERVING.md.
+
+Exit codes — split so soak automation can tell a flaky tenant from a
+misconfigured spec: **0** every tenant finished (including "recovered
+after N restarts" — the restart count rides the JSON output), **1**
+tenant runtime failures, **2** misconfigured spec (parse-time, or a
+session build rejecting its config), **3** every failure is a
+supervised tenant whose restart budget / crash-loop breaker gave up."""
 
 from __future__ import annotations
 
@@ -32,6 +43,18 @@ SERVE_RUNTIMES = ("loopback", "shm", "mqtt")
 _SESSION_KEYS = (
     "name", "checkpoint_path", "checkpoint_every", "resume", "max_workers",
 )
+# supervision keys -> RestartPolicy (fedml_tpu/serve/supervisor.py)
+_RESTART_KEYS = (
+    "restart_budget", "restart_backoff_s", "restart_backoff_max_s",
+    "breaker_window",
+)
+
+
+class _RestartsExhaustedExit(click.ClickException):
+    """Every failed tenant is a supervised one whose restarts ran dry —
+    exit 3 (flaky tenant), distinct from exit 2 (misconfigured spec)."""
+
+    exit_code = 3
 
 
 def _cli_defaults() -> dict:
@@ -92,6 +115,25 @@ def build_tenant(spec: dict):
     for key in _SESSION_KEYS:
         if key in spec:
             session_kw[key] = spec.pop(key)
+    restart_kw = {k: spec.pop(k) for k in _RESTART_KEYS if k in spec}
+    if restart_kw:
+        from fedml_tpu.serve.supervisor import RestartPolicy
+
+        if "restart_budget" not in restart_kw:
+            raise click.UsageError(
+                f"tenant {session_kw.get('name')!r}: {sorted(restart_kw)} "
+                "configure supervision but restart_budget is missing — "
+                "set it to supervise this tenant"
+            )
+        session_kw["restart"] = RestartPolicy(
+            budget=int(restart_kw["restart_budget"]),
+            backoff_base_s=float(restart_kw.get("restart_backoff_s", 0.25)),
+            backoff_max_s=float(
+                restart_kw.get("restart_backoff_max_s", 30.0)
+            ),
+            breaker_window=int(restart_kw.get("breaker_window", 0)),
+            seed=int(spec.get("seed", 0) or 0),
+        )
     name = session_kw.pop("name")  # passed positionally to create_session
     if "dataset" in spec:  # the CLI's --dataset flag maps to dataset_name
         spec["dataset_name"] = spec.pop("dataset")
@@ -102,6 +144,10 @@ def build_tenant(spec: dict):
             "(spec keys are the single-run CLI flag names)"
         )
     opt.update(spec)
+    # serve's defaults, not the single-run CLI's (runtime defaults to
+    # loopback here, vmap there) — the shared validators below read these
+    opt["runtime"] = runtime
+    opt["algorithm"] = algorithm
     if algorithm == "fedbuff" and opt.get("async_buffer_k", 0) in (0, None):
         opt["async_buffer_k"] = 10  # the CLI flag default
     if algorithm == "fedbuff" and opt.get("warmup"):
@@ -113,6 +159,16 @@ def build_tenant(spec: dict):
             "compile on first dispatch; there is no round-0 barrier"
         )
     config = build_config(opt)
+    # the single-run CLI's transport-retry guards (chaos without retries
+    # is a guaranteed mid-run crash — it must be a parse-time CONFIG
+    # error here too, not a runtime failure that burns a supervised
+    # tenant's restart budget and reads as flakiness)
+    from fedml_tpu.cli import _validate_comm_retry
+
+    try:
+        _validate_comm_retry(config, opt)
+    except click.UsageError as e:
+        raise click.UsageError(f"tenant {name!r}: {e.format_message()}")
     data = data_registry.load(config)
     task = data_registry.task_for_dataset(config.data.dataset)
     sample_shape = tuple(data.client_x[0].shape[1:])
@@ -158,6 +214,12 @@ def serve_main(spec, log_dir, prom_port, duration_s, stagger_s):
     server = FederationServer(
         log_dir=str(log_dir) if log_dir else None, prom_port=prom_port
     )
+    # config-rejected tenants (spec passed parsing but the session build
+    # refused it — e.g. participation faults without deadline_s): isolated
+    # per tenant so one bad spec never takes down its co-tenants, and
+    # reported as the misconfigured-spec exit class (2), NOT as a flaky
+    # tenant
+    config_failed = {}
     for t in tenants:
         name = t["name"]
         config, data, model, session_kw = build_tenant(t)
@@ -166,12 +228,21 @@ def serve_main(spec, log_dir, prom_port, duration_s, stagger_s):
 
             tenant_logger = MetricsLogger(str(Path(log_dir) / name))
             session_kw["log_fn"] = tenant_logger.log
-        server.create_session(name, config, data, model, **session_kw)
+        try:
+            server.create_session(name, config, data, model, **session_kw)
+        except ValueError as e:
+            config_failed[name] = repr(e)
     try:
         for i, t in enumerate(tenants):
+            name = t["name"]
+            if name in config_failed:
+                continue
             if i and stagger_s:
                 time.sleep(stagger_s)
-            server.start(names=[t["name"]])
+            try:
+                server.start(names=[name])
+            except ValueError as e:  # session build rejected the config
+                config_failed[name] = repr(e)
         if server.prom_port is not None:
             click.echo(
                 f"serve: prometheus metrics on "
@@ -194,14 +265,34 @@ def serve_main(spec, log_dir, prom_port, duration_s, stagger_s):
         name: {
             "ok": r["ok"],
             "error": r["error"],
+            "error_kind": r.get("error_kind"),
             **{k: _jsonable(v) for k, v in r["summary"].items()},
         }
         for name, r in results.items()
     }
+    for name, err in config_failed.items():
+        out[name] = {"ok": False, "error": err, "error_kind": "config"}
     click.echo(json.dumps(out))
-    failed = [name for name, r in results.items() if not r["ok"]]
-    if failed:
-        raise click.ClickException(f"tenants failed: {failed}")
+    failed = {
+        name: r.get("error_kind") or "runtime"
+        for name, r in out.items() if not r["ok"]
+    }
+    if not failed:
+        return
+    if any(kind == "config" for kind in failed.values()):
+        # misconfigured specs take precedence: the operator must fix the
+        # spec before the flakiness signal means anything
+        raise click.UsageError(
+            f"misconfigured tenants: "
+            f"{sorted(n for n, k in failed.items() if k == 'config')} "
+            f"(all failures: {failed})"
+        )
+    if all(kind == "restart_exhausted" for kind in failed.values()):
+        raise _RestartsExhaustedExit(
+            f"flaky tenants exhausted their restart budgets: "
+            f"{sorted(failed)}"
+        )
+    raise click.ClickException(f"tenants failed: {failed}")
 
 
 if __name__ == "__main__":

@@ -56,15 +56,31 @@ class FederationServer:
 
     # -- tenant registration ----------------------------------------------
 
-    def create_session(self, name: str, config, data, model, **kw) -> FedSession:
+    def create_session(self, name: str, config, data, model, restart=None, **kw):
         """Build a tenant session with its own TelemetryScope and register
         it. ``kw`` forwards to :class:`FedSession` (algorithm, runtime,
-        checkpoint_path, max_workers, ...)."""
+        checkpoint_path, max_workers, ...). ``restart`` (a
+        :class:`~fedml_tpu.serve.supervisor.RestartPolicy`, or an int
+        restart budget) makes the tenant SUPERVISED: a crash restarts it
+        from its latest rolling checkpoint under backoff instead of
+        failing the tenant (fedml_tpu/serve/supervisor.py)."""
         with self._lock:
             if name in self._sessions:
                 raise ValueError(f"tenant {name!r} already registered")
         kw.setdefault("scope", TelemetryScope(tenant=name))
-        session = FedSession(config, data, model, name=name, **kw)
+        if restart is not None:
+            from fedml_tpu.serve.supervisor import (
+                RestartPolicy,
+                SupervisedSession,
+            )
+
+            if isinstance(restart, int):
+                restart = RestartPolicy(budget=restart)
+            session = SupervisedSession(
+                config, data, model, name=name, restart=restart, **kw
+            )
+        else:
+            session = FedSession(config, data, model, name=name, **kw)
         return self.add_session(session)
 
     def add_session(self, session: FedSession) -> FedSession:
@@ -134,9 +150,12 @@ class FederationServer:
         """Join every started tenant and collect results: one tenant's
         failure never blocks (or masks) the others'. Per tenant, the
         aggregate logger receives a ``tenants/<name>/...`` summary row.
-        Returns {name: {"ok", "error", "summary"}}; raises nothing —
-        callers decide what a failed tenant means (the serve CLI exits
-        nonzero)."""
+        Returns {name: {"ok", "error", "error_kind", "summary"}}; raises
+        nothing — callers decide what a failed tenant means.
+        ``error_kind`` separates the failure classes the serve CLI maps
+        to distinct exit codes: ``"config"`` (the session build rejected
+        the spec), ``"restart_exhausted"`` (a supervised tenant's budget/
+        crash-loop breaker gave up), ``"timeout"``, ``"runtime"``."""
         deadline = None if timeout is None else time.monotonic() + timeout
         results: Dict[str, dict] = {}
         for s in self.sessions():
@@ -150,7 +169,8 @@ class FederationServer:
                 s.wait(left)
             except TimeoutError:
                 results[s.name] = {
-                    "ok": False, "error": "timeout", "summary": s.summary_row()
+                    "ok": False, "error": "timeout", "error_kind": "timeout",
+                    "summary": s.summary_row(),
                 }
                 continue
             except BaseException as e:  # noqa: BLE001 — per-tenant isolation
@@ -165,6 +185,7 @@ class FederationServer:
             results[s.name] = {
                 "ok": err is None,
                 "error": repr(err) if err is not None else None,
+                "error_kind": _error_kind(s, err),
                 "summary": summary,
             }
         return results
@@ -188,6 +209,22 @@ class FederationServer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _error_kind(session, err) -> Optional[str]:
+    """Classify a tenant failure for the serve CLI's split exit codes: a
+    spec the session build rejected is ``config`` (fix the spec), a
+    supervised tenant whose restarts ran dry is ``restart_exhausted``
+    (a flaky tenant/fleet), everything else ``runtime``."""
+    if err is None:
+        return None
+    from fedml_tpu.serve.supervisor import RestartBudgetExhausted
+
+    if isinstance(err, RestartBudgetExhausted):
+        return "restart_exhausted"
+    if getattr(session, "failure_phase", None) == "build":
+        return "config"
+    return "runtime"
 
 
 def _jsonable(v):

@@ -142,6 +142,11 @@ class FedSession:
         self._lock = threading.Lock()
         self._next_rank = 1
         self.state = "created"  # created -> running -> done|failed
+        # which phase failed: "build" (config guards / checkpoint restore
+        # rejected the session before anything ran — the serve CLI's
+        # misconfigured-spec exit class) vs "run" (the federation itself
+        # crashed); None while healthy
+        self.failure_phase: Optional[str] = None
 
     # -- comm factories (namespaced per session) ---------------------------
 
@@ -414,6 +419,7 @@ class FedSession:
             # — in a long-lived service every misconfigured tenant spec
             # would leave one behind
             self.state = "failed"
+            self.failure_phase = "build"
             self._cleanup()
             raise
 
@@ -537,6 +543,7 @@ class FedSession:
             self.state = "done"
         except BaseException:
             self.state = "failed"
+            self.failure_phase = "run"
             raise
         finally:
             self._cleanup()
@@ -757,6 +764,8 @@ class FedSession:
             snap = self.scope.comm_meter.snapshot()
             row["comm_messages_sent"] = sum(snap["messages_sent"].values())
             row["comm_bytes_sent"] = sum(snap["bytes_sent"].values())
+            row["comm/retries"] = sum(snap.get("send_retries", {}).values())
+            row["comm/gave_up"] = sum(snap.get("send_gave_up", {}).values())
         return row
 
     @property

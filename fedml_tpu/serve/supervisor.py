@@ -1,0 +1,459 @@
+"""Session supervisor — a crashed tenant restarts itself from its latest
+rolling checkpoint instead of dying.
+
+PR 9's service treats a crashed :class:`~fedml_tpu.serve.session.FedSession`
+as terminal: the exception lands in ``FederationServer.wait()`` results
+and the tenant is gone, even though rolling checkpoints + bit-parity
+resume already exist and are test-proven. The supervisor closes that
+loop: it owns a tenant's session *factory* and, when an attempt crashes,
+rebuilds a fresh ``FedSession`` with ``resume=True`` (fresh endpoint
+namespace, same TelemetryScope — counters stay monotonic per tenant)
+under **jittered exponential backoff**, bounded by a **restart budget**
+and a **crash-loop breaker**:
+
+- *budget*: at most ``RestartPolicy.budget`` restarts per tenant; past it
+  the tenant fails loudly with a quarantine-style
+  :class:`RestartBudgetExhausted` (the corrupt-checkpoint case: every
+  resume fails at build, the budget burns down, the message points at
+  the checkpoint — no silent spinning).
+- *breaker*: ``breaker_window`` consecutive crashes at the SAME
+  round/step trip the breaker early — a deterministic crash loop cannot
+  be fixed by more restarts, so a big budget is not a license to spin.
+
+Restarts are only bit-parity when the session rolls checkpoints
+(``checkpoint_path`` + ``checkpoint_every``): the resumed continuation
+re-selects the in-flight cohort and lands on numerics identical to an
+uninterrupted run (the PR-9 kill/resume contract, now exercised
+automatically by the ci.sh chaos stage). Without a checkpoint the
+supervisor still restarts — from round 0, with a logged warning.
+
+Observability: restarts/budget/quarantine land in the tenant's scope
+registry (``fedml_session_restarts_total``,
+``fedml_session_restart_budget_remaining``, ``fedml_session_quarantined``
+— tenant-labeled on the service /metrics) and as ``supervisor/*`` keys
+in the tenant's aggregate summary row. The serve CLI maps "recovered
+after N restarts" to exit 0 (with the restart count in its JSON output)
+and budget/breaker exhaustion to its own exit code — see
+fedml_tpu/serve/cli.py."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import List, Optional
+
+from fedml_tpu.serve.session import FedSession
+from fedml_tpu.telemetry import TelemetryScope
+from fedml_tpu.telemetry.metrics import get_global_registry
+
+
+class RestartBudgetExhausted(RuntimeError):
+    """The supervisor gave up on a tenant: restart budget exhausted or
+    crash-loop breaker open. ``reason`` is ``"budget"`` or
+    ``"crash_loop"``; ``restarts`` the attempts burned. The serve CLI
+    maps this class to its flaky-tenant exit code (3), distinct from
+    misconfigured-spec failures."""
+
+    def __init__(self, message: str, reason: str, restarts: int):
+        super().__init__(message)
+        self.reason = reason
+        self.restarts = int(restarts)
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartPolicy:
+    """Supervision knobs for one tenant.
+
+    ``budget`` caps restarts (the first start is free). Backoff before
+    restart ``k`` is ``backoff_base_s * 2^(k-1)`` scaled by a
+    seed-deterministic jitter in [0.5, 1.5), capped at
+    ``backoff_max_s`` — jittered so N tenants crashing together (a
+    shared-dependency blip) do not restart in lockstep, deterministic so
+    a replayed run schedules identically. ``breaker_window`` = 0
+    disables the crash-loop breaker; N trips it after N consecutive
+    crashes with no round/step progress."""
+
+    budget: int = 3
+    backoff_base_s: float = 0.25
+    backoff_max_s: float = 30.0
+    breaker_window: int = 0
+    seed: int = 0
+
+    def backoff_s(self, attempt: int) -> float:
+        from fedml_tpu.core.retry import _mix, jittered_backoff_s
+
+        return jittered_backoff_s(
+            self.backoff_base_s, self.backoff_max_s, attempt,
+            _mix(self.seed, attempt, 0x5EA1),
+        )
+
+
+class SupervisedSession:
+    """A FedSession-shaped tenant that heals itself (see module docstring).
+
+    Constructor mirrors :class:`FedSession` (config, data, model + the
+    session keyword surface) plus ``restart`` (a :class:`RestartPolicy`).
+    Each attempt builds a FRESH FedSession — sessions are single-shot
+    objects and every rebuild gets its own endpoint namespace, so a
+    crashed attempt's lingering threads can never cross-deliver into the
+    restart. The TelemetryScope is shared across attempts on purpose:
+    one tenant, one metric stream.
+
+    A caller-supplied ``comm_factory`` is reused across attempts — only
+    pass one whose endpoints are safe to rebind after a crash (the
+    built-in namespaced factories are; a fixed-port factory is not)."""
+
+    def __init__(
+        self,
+        config,
+        data,
+        model,
+        *,
+        name: Optional[str] = None,
+        restart: Optional[RestartPolicy] = None,
+        scope: Optional[TelemetryScope] = None,
+        **session_kw,
+    ):
+        import uuid
+
+        self.config = config
+        self.data = data
+        self.model = model
+        self.name = name or f"supervised-{uuid.uuid4().hex[:8]}"
+        self.scope = scope
+        self.restart = restart or RestartPolicy()
+        self._session_kw = dict(session_kw)
+        self.checkpoint_path = self._session_kw.get("checkpoint_path")
+        if not self.checkpoint_path or not self._session_kw.get(
+            "checkpoint_every"
+        ):
+            logging.warning(
+                "supervised tenant %s has no rolling checkpoint "
+                "(checkpoint_path + checkpoint_every): restarts will rerun "
+                "from round 0 instead of resuming bit-identically",
+                self.name,
+            )
+        # validate the spec ONCE, eagerly: a constructor-level config
+        # error (bad algorithm/runtime, fedbuff+warmup) raises here —
+        # before any supervision — exactly like an unsupervised
+        # create_session, so a misconfigured spec stays a config error
+        # instead of burning a restart budget
+        self._probe_build()
+
+        self.session: Optional[FedSession] = None
+        self.restarts = 0
+        self.recovered = False
+        self.state = "created"  # created -> running|backoff -> done|failed
+        self.failure_phase: Optional[str] = None
+        self._terminal_error: Optional[BaseException] = None
+        self._crash_log: List[str] = []
+        self._started = False
+        self._stop_requested = False
+        self._drain_on_stop = True
+        self._monitor: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._lock = threading.Lock()
+
+        r = scope.registry if scope is not None else get_global_registry()
+        self._c_restarts = r.counter(
+            "fedml_session_restarts_total",
+            "Supervised tenant restarts (crash -> resume from checkpoint)",
+        )
+        self._g_budget = r.gauge(
+            "fedml_session_restart_budget_remaining",
+            "Restarts this tenant may still burn before quarantine",
+        )
+        self._g_quarantined = r.gauge(
+            "fedml_session_quarantined",
+            "1 when the supervisor gave up (budget exhausted or crash loop)",
+        )
+        self._g_budget.set(self.restart.budget)
+        self._g_quarantined.set(0)
+
+    # -- attempt construction ----------------------------------------------
+
+    def _probe_build(self) -> None:
+        """Constructor-level validation without building: FedSession's
+        ctor guards run on a throwaway instance."""
+        FedSession(
+            self.config, self.data, self.model, name=self.name,
+            scope=self.scope, **self._session_kw,
+        )
+
+    def _checkpoint_available(self) -> bool:
+        return bool(
+            self.checkpoint_path
+            and os.path.exists(str(self.checkpoint_path) + ".npz")
+        )
+
+    def _build(self, attempt: int) -> FedSession:
+        kw = dict(self._session_kw)
+        if attempt > 0 and self._checkpoint_available():
+            kw["resume"] = True
+        return FedSession(
+            self.config, self.data, self.model, name=self.name,
+            scope=self.scope, **kw,
+        )
+
+    def _progress(self, session: Optional[FedSession]) -> int:
+        server = getattr(session, "server", None)
+        if server is None:
+            return 0
+        if getattr(session, "mode", None) == "fedbuff":
+            return int(getattr(server, "server_steps", 0))
+        return int(getattr(server, "round_idx", 0))
+
+    # -- the supervision loop ----------------------------------------------
+
+    def start(self) -> "SupervisedSession":
+        with self._lock:
+            if self._started:
+                raise RuntimeError(f"session {self.name} already started")
+            self._started = True
+        self._monitor = threading.Thread(
+            target=self._supervise, daemon=True,
+            name=f"fedml-supervisor-{self.name}",
+        )
+        self._monitor.start()
+        return self
+
+    def _supervise(self) -> None:
+        policy = self.restart
+        attempt = 0
+        last_progress: Optional[int] = None
+        streak = 0  # consecutive crashes with no forward progress
+        while True:
+            try:
+                session = self._build(attempt)
+            except BaseException as e:  # noqa: BLE001 — supervisor boundary
+                # constructor-level rejection is deterministic in the spec
+                # (the checkpoint is not consulted until start): retrying
+                # identical inputs cannot help — a config error, not flakiness
+                self._terminal(e, phase="build")
+                return
+            try:
+                self.session = session
+                self.state = "running"
+                session.start()
+                session.wait()
+            except BaseException as e:  # noqa: BLE001 — supervisor boundary
+                if (
+                    getattr(session, "failure_phase", None) == "build"
+                    and not session.resume
+                ):
+                    # the session BUILD rejected the config without a
+                    # checkpoint in play (config-guard ValueError): every
+                    # restart would fail identically — surface it as a
+                    # misconfigured spec (serve CLI exit 2) instead of
+                    # burning the budget and masquerading as a flaky
+                    # tenant. A build failure under resume=True stays
+                    # retryable: that is the corrupt-checkpoint path,
+                    # whose visible budget burn is the point.
+                    self._terminal(e, phase="build")
+                    return
+                progress = self._progress(self.session)
+                self._crash_log.append(
+                    f"attempt {attempt} crashed at "
+                    f"{'step' if self._mode() == 'fedbuff' else 'round'} "
+                    f"{progress}: {e!r}"
+                )
+                self._detach_crashed()
+                if last_progress is not None and progress <= last_progress:
+                    streak += 1
+                else:
+                    streak = 1
+                last_progress = progress
+                if self._stop_requested:
+                    self._terminal(e, phase="run")
+                    return
+                if policy.breaker_window and streak >= policy.breaker_window:
+                    self._quarantine(e, attempt, reason="crash_loop")
+                    return
+                if attempt >= policy.budget:
+                    self._quarantine(e, attempt, reason="budget")
+                    return
+                attempt += 1
+                self.restarts = attempt
+                self._c_restarts.inc()
+                self._g_budget.set(policy.budget - attempt)
+                delay = policy.backoff_s(attempt)
+                logging.warning(
+                    "supervisor: tenant %s crashed (%r) — restart %d/%d "
+                    "in %.2fs%s", self.name, e, attempt, policy.budget,
+                    delay,
+                    " from checkpoint" if self._checkpoint_available()
+                    else " from scratch (no checkpoint)",
+                )
+                self.state = "backoff"
+                self._wake.wait(delay)
+                if self._stop_requested:
+                    self._terminal(e, phase="run")
+                    return
+                continue
+            # clean finish
+            self.recovered = self.restarts > 0
+            self.state = "done"
+            if self.recovered:
+                logging.info(
+                    "supervisor: tenant %s recovered after %d restart(s)",
+                    self.name, self.restarts,
+                )
+            return
+
+    def _mode(self) -> str:
+        return getattr(self.session, "mode", None) or (
+            "fedbuff" if self._session_kw.get("algorithm") == "fedbuff"
+            else "sync"
+        )
+
+    def _detach_crashed(self) -> None:
+        """Unhook the crashed attempt's health registry from the scope
+        tracer — the restart builds a fresh one, and a dead listener per
+        crash would otherwise accumulate for the tenant's lifetime."""
+        try:
+            server = getattr(self.session, "server", None)
+            if server is not None and getattr(server, "health", None) is not None:
+                server.health.detach()
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+    def _quarantine(
+        self, err: BaseException, attempts: int, reason: str
+    ) -> None:
+        self._g_quarantined.set(1)
+        if reason == "crash_loop":
+            what = (
+                f"crash-loop breaker open: {self.restart.breaker_window} "
+                "consecutive attempts crashed with no round/step progress"
+            )
+        else:
+            what = (
+                f"restart budget exhausted "
+                f"({attempts}/{self.restart.budget} restarts)"
+            )
+        hint = (
+            f" — the rolling checkpoint at {self.checkpoint_path!r} may be "
+            "corrupt; inspect or delete it before re-admitting this tenant"
+            if self._checkpoint_available() else ""
+        )
+        msg = (
+            f"tenant {self.name!r} QUARANTINED: {what}; last failure: "
+            f"{err!r}{hint}. Crash history: " + "; ".join(self._crash_log)
+        )
+        exc = RestartBudgetExhausted(msg, reason=reason, restarts=attempts)
+        exc.__cause__ = err
+        self._terminal(exc, phase="supervise")
+        logging.error("supervisor: %s", msg)
+
+    def _terminal(self, err: BaseException, phase: str) -> None:
+        self._terminal_error = err
+        self.failure_phase = phase
+        self.state = "failed"
+
+    # -- the FedSession-shaped surface the server consumes -----------------
+
+    @property
+    def done(self) -> bool:
+        return bool(
+            self._started
+            and self._monitor is not None
+            and not self._monitor.is_alive()
+        )
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._started:
+            raise RuntimeError(f"session {self.name} was never started")
+        self._monitor.join(timeout)
+        if self._monitor.is_alive():
+            raise TimeoutError(
+                f"session {self.name} still running after {timeout}s"
+            )
+        if self._terminal_error is not None:
+            raise self._terminal_error
+        return self.session.server if self.session is not None else None
+
+    def run(self):
+        self.start()
+        return self.wait()
+
+    def request_stop(self, drain: bool = True, defer: bool = False) -> None:
+        self._stop_requested = True
+        self._wake.set()  # a tenant backing off stops instead of restarting
+        session = self.session
+        if session is not None and self.state == "running":
+            try:
+                session.request_stop(drain=drain, defer=defer)
+            except BaseException:  # noqa: BLE001 — the attempt may be
+                # crashing concurrently; stopping a dead session is
+                # best-effort, and its failure must not re-raise the
+                # tenant's crash on the OPERATOR's thread (the supervisor
+                # loop owns the crash)
+                logging.debug(
+                    "supervisor: stop of tenant %s's current attempt "
+                    "failed (already crashing)", self.name, exc_info=True,
+                )
+
+    def drain(self) -> None:
+        self.request_stop(drain=True)
+
+    def stop(self) -> None:
+        self.request_stop(drain=False)
+
+    def add_worker(self):
+        return self.session.add_worker()
+
+    def remove_worker(self, rank: Optional[int] = None):
+        return self.session.remove_worker(rank)
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def health_state(self) -> str:
+        """healthy (never restarted) | degraded (running/finished with
+        restarts burned) | failed (quarantined or terminal error)."""
+        if self.state == "failed":
+            return "failed"
+        return "degraded" if self.restarts else "healthy"
+
+    def _supervisor_row(self) -> dict:
+        return {
+            "supervisor/restarts": self.restarts,
+            "supervisor/restart_budget": self.restart.budget,
+            "supervisor/recovered": int(self.recovered),
+            "supervisor/quarantined": int(
+                isinstance(self._terminal_error, RestartBudgetExhausted)
+            ),
+            "supervisor/health": self.health_state,
+        }
+
+    def status(self) -> dict:
+        row = (
+            self.session.status() if self.session is not None
+            else {"name": self.name}
+        )
+        row["state"] = self.state
+        row.update(self._supervisor_row())
+        return row
+
+    def summary_row(self) -> dict:
+        row = (
+            self.session.summary_row() if self.session is not None
+            else {"state": self.state}
+        )
+        row["state"] = self.state
+        row.update(self._supervisor_row())
+        return row
+
+    @property
+    def server(self):
+        return self.session.server if self.session is not None else None
+
+    @property
+    def history(self):
+        return self.session.history if self.session is not None else []
+
+    @property
+    def global_vars(self):
+        return self.session.global_vars if self.session is not None else None

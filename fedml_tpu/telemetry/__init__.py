@@ -96,4 +96,13 @@ def telemetry_summary(baseline: dict = None) -> dict:
         if baseline:
             total -= sum(baseline.get(key, {}).values())
         row[f"telemetry/comm_{key}"] = total
+    # transport retry accounting (core/retry.py) — the CI oracle keys for
+    # the flaky-transport chaos gate: a faulted run must show retries > 0
+    # with gave_up == 0 and unchanged numerics
+    for key, out in (("send_retries", "comm/retries"),
+                     ("send_gave_up", "comm/gave_up")):
+        total = sum(snap.get(key, {}).values())
+        if baseline:
+            total -= sum(baseline.get(key, {}).values())
+        row[out] = total
     return row

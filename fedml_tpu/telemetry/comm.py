@@ -42,6 +42,8 @@ class CommMeter:
         self._received: Dict[str, int] = {}
         self._bytes_sent: Dict[str, int] = {}
         self._bytes_received: Dict[str, int] = {}
+        self._send_retries: Dict[str, int] = {}
+        self._send_gave_up: Dict[str, int] = {}
         r = self.registry
         self._c_sent = r.counter(
             "fedml_comm_messages_sent_total",
@@ -73,6 +75,16 @@ class CommMeter:
             "Receive-side observer handling latency",
             ("msg_type",),
         )
+        self._c_retries = r.counter(
+            "fedml_comm_send_retries_total",
+            "Send attempts that failed and were retried (core/retry.py)",
+            ("msg_type",),
+        )
+        self._c_gave_up = r.counter(
+            "fedml_comm_send_gave_up_total",
+            "Sends abandoned after exhausting the retry attempt/deadline caps",
+            ("msg_type",),
+        )
 
     # -- hot path (called from BaseCommManager) --
     def on_sent(self, msg_type: str, nbytes: Optional[int], seconds: float) -> None:
@@ -99,6 +111,20 @@ class CommMeter:
             self._c_bytes_recv.inc(int(nbytes), msg_type=msg_type)
         self._h_handle.observe(seconds, msg_type=msg_type)
 
+    def on_send_retry(self, msg_type: str) -> None:
+        with self._lock:
+            self._send_retries[msg_type] = (
+                self._send_retries.get(msg_type, 0) + 1
+            )
+        self._c_retries.inc(1, msg_type=msg_type)
+
+    def on_send_gave_up(self, msg_type: str) -> None:
+        with self._lock:
+            self._send_gave_up[msg_type] = (
+                self._send_gave_up.get(msg_type, 0) + 1
+            )
+        self._c_gave_up.inc(1, msg_type=msg_type)
+
     # -- queries --
     def snapshot(self) -> dict:
         """Plain-dict totals: {metric: {msg_type: value}} — what the
@@ -109,6 +135,8 @@ class CommMeter:
                 "messages_received": dict(self._received),
                 "bytes_sent": dict(self._bytes_sent),
                 "bytes_received": dict(self._bytes_received),
+                "send_retries": dict(self._send_retries),
+                "send_gave_up": dict(self._send_gave_up),
             }
 
     def reset(self) -> None:
@@ -119,6 +147,8 @@ class CommMeter:
             self._received.clear()
             self._bytes_sent.clear()
             self._bytes_received.clear()
+            self._send_retries.clear()
+            self._send_gave_up.clear()
 
 
 _GLOBAL: Optional[CommMeter] = None

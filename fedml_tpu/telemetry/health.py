@@ -43,6 +43,13 @@ _TRAIN_BUCKETS = (
 )
 
 
+# Per-client cap on the full-fidelity fault event log backing
+# export_trace(): past it the record keeps counting (the `faults` tallies
+# stay exact) but the trace is marked incomplete — FaultPlan.from_trace
+# refuses truncated clients rather than replay a partial fleet.
+_MAX_TRACE_EVENTS = 65536
+
+
 class _ClientRecord:
     __slots__ = (
         "last_seen_round",
@@ -50,6 +57,8 @@ class _ClientRecord:
         "times",
         "seen_rounds",
         "faults",
+        "fault_events",
+        "trace_complete",
     )
 
     def __init__(self, window: int):
@@ -61,6 +70,9 @@ class _ClientRecord:
         # injected/observed faults by kind (scheduler/faults.py feeds this
         # via observe_fault): {"dropout": n, "crash": n, ...}
         self.faults: Dict[str, int] = {}
+        # full-fidelity event log for trace replay: (round, kind, detail)
+        self.fault_events: List[tuple] = []
+        self.trace_complete = True
 
     def mean(self) -> Optional[float]:
         if not self.times:
@@ -142,17 +154,25 @@ class ClientHealthRegistry:
             self.straggler_ids()
         return True
 
-    def observe_fault(self, client_id: int, round_idx: int, kind: str) -> None:
+    def observe_fault(
+        self, client_id: int, round_idx: int, kind: str, detail: float = 0.0
+    ) -> None:
         """Record a client fault (scheduler fault injection, or a real
         failure the runtime observed). Faults are NOT train observations:
         they never touch the timing stats or the straggler flag, only the
-        per-client fault tally surfaced in snapshot()."""
+        per-client fault tally surfaced in snapshot() and the event log
+        behind export_trace(). ``detail`` is the event's magnitude where
+        one exists (slowdown seconds) so a replayed trace reproduces it."""
         cid = int(client_id)
         with self._lock:
             rec = self._clients.get(cid)
             if rec is None:
                 rec = self._clients[cid] = _ClientRecord(self.window)
             rec.faults[kind] = rec.faults.get(kind, 0) + 1
+            if len(rec.fault_events) < _MAX_TRACE_EVENTS:
+                rec.fault_events.append((int(round_idx), kind, float(detail)))
+            else:
+                rec.trace_complete = False
             rec.last_seen_round = max(rec.last_seen_round, int(round_idx))
             n_clients = len(self._clients)
         self._g_seen.set(n_clients)
@@ -240,6 +260,45 @@ class ClientHealthRegistry:
 
     def is_straggler(self, client_id: int) -> bool:
         return int(client_id) in self.straggler_ids()
+
+    def export_trace(self, rounds: Optional[int] = None):
+        """Export the observed fleet as a
+        :class:`~fedml_tpu.scheduler.faults.FaultTrace` — per-client fault
+        events (round + magnitude) and train-time stats.
+        ``FaultPlan.from_trace`` replays it byte-identically against the
+        same run config (ROADMAP 5a: CI replays observed fleets, not
+        hand-written JSON). ``rounds`` is the run's round horizon; default
+        = last observed round + 1. Only meaningful for ROUND-keyed
+        runtimes: a FedBuff server feeds this registry with events keyed
+        by dispatch tag, which cannot replay (the CLI skips the export
+        there)."""
+        from fedml_tpu.scheduler.faults import FaultTrace
+
+        with self._lock:
+            items = [
+                (cid, rec, list(rec.fault_events)) for cid, rec in
+                self._clients.items()
+            ]
+        clients = {}
+        horizon = 0
+        for cid, rec, events in items:
+            faults: Dict[str, list] = {}
+            for r, kind, detail in events:
+                faults.setdefault(kind, []).append([int(r), float(detail)])
+                horizon = max(horizon, int(r) + 1)
+            horizon = max(horizon, rec.last_seen_round + 1)
+            clients[int(cid)] = {
+                "last_seen_round": rec.last_seen_round,
+                "rounds_participated": rec.rounds_participated,
+                "mean_train_s": rec.mean(),
+                "p90_train_s": rec.percentile(0.9),
+                "faults": faults,
+                "trace_complete": rec.trace_complete,
+            }
+        return FaultTrace(
+            rounds=int(rounds) if rounds is not None else horizon,
+            clients=clients,
+        )
 
     def snapshot(self) -> dict:
         """JSON-ready view: {client_id: {last_seen_round, rounds_participated,

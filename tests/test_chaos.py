@@ -1,0 +1,331 @@
+"""Trace-replay chaos (scheduler/faults.py + telemetry/health.py):
+device-profile fleets, scripted plans, FaultPlan JSON/pickle round-trips,
+and the record -> replay -> survive loop — a recorded FaultTrace replays
+with byte-identical faults/* rows and numerics."""
+
+import json
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.scheduler import (
+    DEVICE_PROFILES,
+    DeviceProfile,
+    FaultInjector,
+    FaultPlan,
+    FaultTrace,
+)
+from fedml_tpu.telemetry.health import ClientHealthRegistry
+
+
+def _decisions(plan, clients=8, rounds=6):
+    return [
+        plan.decide(c, r) for c in range(clients) for r in range(rounds)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# device profiles + fleet shorthand
+# ---------------------------------------------------------------------------
+
+
+def test_profile_name_as_client_spec():
+    plan = FaultPlan.from_json({
+        "clients": {"2": "lowend_phone", "3": {"profile": "midrange_phone",
+                                               "dropout_p": 0.5}},
+    })
+    low = DEVICE_PROFILES["lowend_phone"]
+    assert plan.spec_for(2).slowdown_s == low.slowdown_s
+    assert plan.spec_for(2).dropout_p == low.dropout_p
+    # overrides layer on top of the profile
+    assert plan.spec_for(3).dropout_p == 0.5
+    assert plan.spec_for(3).slowdown_s == DEVICE_PROFILES["midrange_phone"].slowdown_s
+
+
+def test_custom_profiles_and_unknown_profile_rejected():
+    plan = FaultPlan.from_json({
+        "profiles": {"glacial": {"slowdown_s": 1.5, "dropout_p": 0.3}},
+        "clients": {"0": "glacial"},
+    })
+    assert plan.spec_for(0).slowdown_s == 1.5
+    with pytest.raises(ValueError, match="unknown device profile"):
+        FaultPlan.from_json({"clients": {"0": "no_such_tier"}})
+    # a profile may ALIAS (or derive from) a built-in tier
+    plan = FaultPlan.from_json({
+        "profiles": {"fast": "highend_phone",
+                     "worse": {"profile": "lowend_phone", "dropout_p": 0.5}},
+        "clients": {"0": "fast", "1": "worse"},
+    })
+    assert plan.spec_for(0) == DEVICE_PROFILES["highend_phone"].spec()
+    assert plan.spec_for(1).dropout_p == 0.5
+    assert plan.spec_for(1).slowdown_s == DEVICE_PROFILES["lowend_phone"].slowdown_s
+
+
+def test_fleet_assignment_is_deterministic_and_apportioned():
+    doc = {
+        "seed": 5,
+        "fleet": {"lowend_phone": 0.25, "midrange_phone": 0.25,
+                  "server_grade": 0.5},
+        "num_clients": 16,
+    }
+    a, b = FaultPlan.from_json(doc), FaultPlan.from_json(doc)
+    assert {c: s for c, s in a.clients.items()} == {
+        c: s for c, s in b.clients.items()
+    }
+    by_tier = {}
+    for spec in a.clients.values():
+        by_tier[spec.slowdown_s] = by_tier.get(spec.slowdown_s, 0) + 1
+    low = DEVICE_PROFILES["lowend_phone"].slowdown_s
+    mid = DEVICE_PROFILES["midrange_phone"].slowdown_s
+    assert by_tier == {low: 4, mid: 4, 0.0: 8}
+    # a different seed shuffles WHICH clients land in each tier
+    other = FaultPlan.from_json({**doc, "seed": 6})
+    assert {c: s.slowdown_s for c, s in a.clients.items()} != {
+        c: s.slowdown_s for c, s in other.clients.items()
+    }
+
+
+def test_fleet_requires_num_clients_and_known_profiles():
+    with pytest.raises(ValueError, match="num_clients"):
+        FaultPlan.from_json({"fleet": {"lowend_phone": 1.0}})
+    with pytest.raises(ValueError, match="unknown profile"):
+        FaultPlan.from_json({"fleet": {"nope": 1.0}, "num_clients": 4})
+    with pytest.raises(ValueError, match="num_clients"):
+        FaultPlan.from_json({"num_clients": 4})
+
+
+# ---------------------------------------------------------------------------
+# scripted plans
+# ---------------------------------------------------------------------------
+
+
+def test_scripted_events_are_exact_not_probabilistic():
+    plan = FaultPlan.from_json({
+        "scripted": {"1": {"0": {"drop": True}, "2": {"flaky": True},
+                           "3": {"slowdown_s": 0.25}}},
+        "clients": {"1": {"dropout_p": 1.0}},  # overridden by the script
+    })
+    assert plan.decide(1, 0).drop
+    assert plan.decide(1, 1).participates  # dropout_p=1 does NOT fire
+    assert plan.decide(1, 2).flaky
+    assert plan.decide(1, 3).slowdown_s == 0.25
+    assert plan.decide(2, 0).participates  # unscripted clients untouched
+    assert plan.has_participation_faults()
+    assert not FaultPlan.from_json(
+        {"scripted": {"1": {"0": {"flaky": True}}}}
+    ).has_participation_faults()
+    with pytest.raises(ValueError, match="unknown keys"):
+        FaultPlan.from_json({"scripted": {"1": {"0": {"explode": True}}}})
+
+
+# ---------------------------------------------------------------------------
+# round-trips (satellite: to_json/from_json + pickled-decide purity fuzz)
+# ---------------------------------------------------------------------------
+
+
+def _rich_plan():
+    return FaultPlan.from_json({
+        "seed": 13,
+        "default": {"dropout_p": 0.1},
+        "profiles": {"glacial": {"slowdown_s": 0.7, "flaky_upload_p": 0.2}},
+        "fleet": {"glacial": 0.5, "highend_phone": 0.5},
+        "num_clients": 8,
+        "clients": {"3": {"profile": "lowend_phone", "crash_at_round": 4},
+                    "5": "midrange_phone"},
+        "scripted": {"6": {"1": {"drop": True},
+                           "4": {"slowdown_s": 0.05, "flaky": True}}},
+    })
+
+
+def test_json_roundtrip_preserves_decisions_including_profiles():
+    plan = _rich_plan()
+    doc = plan.to_json()
+    back = FaultPlan.from_json(json.loads(json.dumps(doc)))
+    assert _decisions(back) == _decisions(plan)
+    assert back.to_json() == doc  # canonical form is a fixed point
+
+
+def test_decide_pure_across_pickle_roundtrip_fuzz():
+    """The satellite fuzz check: decide stays pure in (plan seed, client,
+    round) across a pickle round-trip — per-pair draw streams cannot
+    depend on process state the pickle would lose."""
+    plan = _rich_plan()
+    clone = pickle.loads(pickle.dumps(plan))
+    rng = np.random.default_rng(0)
+    for _ in range(500):
+        c = int(rng.integers(0, 64))
+        r = int(rng.integers(0, 256))
+        assert plan.decide(c, r) == clone.decide(c, r), (c, r)
+    # and across a json round-trip of the pickled clone, for good measure
+    back = FaultPlan.from_json(clone.to_json())
+    for _ in range(200):
+        c = int(rng.integers(0, 64))
+        r = int(rng.integers(0, 256))
+        assert plan.decide(c, r) == back.decide(c, r), (c, r)
+
+
+# ---------------------------------------------------------------------------
+# fault traces: export -> from_trace -> byte-identical replay
+# ---------------------------------------------------------------------------
+
+
+def test_health_registry_exports_fault_events_with_detail():
+    reg = ClientHealthRegistry()
+    inj = FaultInjector(
+        FaultPlan.from_json({"clients": {"1": {"slowdown_s": 0.3}}}),
+        health=reg,
+    )
+    inj.record(1, 0, "slowdown", detail=0.3)
+    inj.record(1, 2, "dropout")
+    inj.record(2, 1, "crash")
+    inj.record(2, 3, "crash")  # deduped: one crash event per client
+    trace = reg.export_trace(rounds=4)
+    assert trace.rounds == 4
+    assert trace.clients[1]["faults"]["slowdown"] == [[0, 0.3]]
+    assert trace.clients[1]["faults"]["dropout"] == [[2, 0.0]]
+    assert trace.clients[2]["faults"]["crash"] == [[1, 0.0]]
+    assert trace.clients[1]["trace_complete"]
+
+
+def test_from_trace_builds_exact_replay_plan():
+    trace = FaultTrace(rounds=6, clients={
+        1: {"faults": {"dropout": [[0, 0.0], [3, 0.0]],
+                       "slowdown": [[2, 0.4]]}},
+        2: {"faults": {"crash": [[4, 0.0]]}},
+    })
+    plan = FaultPlan.from_trace(trace)
+    assert plan.decide(1, 0).drop and plan.decide(1, 3).drop
+    assert plan.decide(1, 1).participates
+    assert plan.decide(1, 2).slowdown_s == 0.4
+    assert plan.decide(2, 4).crashed and plan.decide(2, 5).crashed
+    assert not plan.decide(2, 3).crashed
+    assert plan.has_participation_faults()
+    # truncated traces refuse to replay
+    bad = FaultTrace(rounds=2, clients={
+        1: {"faults": {"dropout": [[0, 0.0]]}, "trace_complete": False},
+    })
+    with pytest.raises(ValueError, match="truncated"):
+        FaultPlan.from_trace(bad)
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    trace = FaultTrace(rounds=3, clients={
+        0: {"faults": {"flaky": [[1, 0.0]]}, "mean_train_s": 0.01},
+    })
+    p = tmp_path / "trace.json"
+    trace.save(str(p))
+    back = FaultTrace.load(str(p))
+    assert back.to_json() == trace.to_json()
+    # the from_spec trace: prefix resolves through the same loader
+    plan = FaultPlan.from_spec(f"trace:{p}")
+    assert plan.decide(0, 1).flaky
+    with pytest.raises(ValueError, match="does not exist"):
+        FaultPlan.from_spec("trace:/no/such/file.json")
+
+
+def _data_model():
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    data = synthetic_classification(
+        num_clients=6, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=0,
+    )
+    return data, create_model("lr", "synthetic", (10,), 3)
+
+
+def _cfg(plan: str):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=3, comm_round=4,
+            epochs=1, frequency_of_the_test=100, fault_plan=plan,
+            deadline_s=5.0, min_clients=1,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+
+
+def test_record_then_replay_is_byte_identical(tmp_path):
+    """THE record -> replay loop, end to end on the loopback transport: a
+    probabilistically-faulted run is recorded by the server health
+    registry; FaultPlan.from_trace replays it with byte-identical
+    faults/* summary rows AND bit-identical numerics (ci.sh chaos gate
+    b, as a test)."""
+    from fedml_tpu.serve import FedSession
+
+    data, model = _data_model()
+    plan = json.dumps({
+        "seed": 2,
+        "default": {"dropout_p": 0.3},
+        "clients": {"1": {"slowdown_s": 0.02}},
+    })
+    rec = FedSession(_cfg(plan), data, model, name="chaos_rec")
+    rec_server = rec.run()
+    rec_row = rec._injector.summary_row()
+    assert rec_row["faults/total"] > 0, "recording run injected nothing"
+    trace_path = tmp_path / "fault_trace.json"
+    rec_server.health.export_trace(rounds=4).save(str(trace_path))
+
+    rep = FedSession(
+        _cfg(f"trace:{trace_path}"), data, model, name="chaos_rep"
+    )
+    rep_server = rep.run()
+    assert rep._injector.summary_row() == rec_row
+    for a, b in zip(
+        jax.tree_util.tree_leaves(rec_server.global_vars),
+        jax.tree_util.tree_leaves(rep_server.global_vars),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fedbuff_run_writes_no_fault_trace(tmp_path):
+    """FedBuff fault events are keyed by dispatch tag, not round — such a
+    trace cannot replay faithfully, so the CLI must not export one (the
+    health snapshot still lands in health.json)."""
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    tdir = tmp_path / "tel"
+    r = CliRunner().invoke(main, [
+        "--algorithm", "fedbuff", "--runtime", "loopback", "--model", "lr",
+        "--dataset", "synthetic", "--client_num_in_total", "4",
+        "--client_num_per_round", "2", "--comm_round", "2",
+        "--async_buffer_k", "2", "--batch_size", "8",
+        "--telemetry_dir", str(tdir),
+    ], catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    assert (tdir / "health.json").exists()
+    assert not (tdir / "fault_trace.json").exists()
+
+
+def test_device_profile_fleet_runs_on_vmap_simulator():
+    """Participation faults from a profile fleet drive the vmap cohort
+    filter — the fleet description is runtime-agnostic."""
+    from fedml_tpu.algorithms import FedAvgAPI
+
+    data, model = _data_model()
+    plan = json.dumps({
+        "seed": 1,
+        "fleet": {"lowend_phone": 0.5, "server_grade": 0.5},
+        "num_clients": 6,
+    })
+    config = RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=4, comm_round=6,
+            epochs=1, frequency_of_the_test=100, fault_plan=plan,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+    api = FedAvgAPI(config, data, model, task="classification")
+    api.train()
+    assert api.faults is not None
+    row = api.faults.summary_row()
+    assert row["faults/dropouts"] > 0  # lowend tier really dropped

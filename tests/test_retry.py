@@ -1,0 +1,313 @@
+"""Transport retry layer (core/retry.py + the BaseCommManager send
+template): deterministic backoff/chaos streams, retry/give-up accounting,
+and the flaky-transport federation contract — injected send failures
+survive with retries > 0, gave_up == 0, and numerics identical to a
+fault-free run (the ci.sh chaos gate)."""
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.config import (
+    CommConfig,
+    DataConfig,
+    FedConfig,
+    RunConfig,
+    TrainConfig,
+)
+from fedml_tpu.core.comm import BaseCommManager
+from fedml_tpu.core.message import Message, MessageType as MT
+from fedml_tpu.core.retry import InjectedSendFault, RetryPolicy
+from fedml_tpu.telemetry import TelemetryScope
+
+
+class _FlakyComm(BaseCommManager):
+    """A backend whose _send fails the first ``fail_first`` attempts of
+    every message."""
+
+    def __init__(self, fail_first=0):
+        super().__init__()
+        self.fail_first = fail_first
+        self.attempts = 0
+        self.delivered = []
+
+    def _send(self, msg):
+        self.attempts += 1
+        if self.attempts <= self.fail_first:
+            raise ConnectionError(f"transient #{self.attempts}")
+        self.delivered.append(msg)
+
+    def handle_receive_message(self):  # pragma: no cover - unused
+        pass
+
+    def stop_receive_message(self):  # pragma: no cover - unused
+        pass
+
+
+def _msg():
+    return Message(MT.C2S_SEND_STATS, 1, 0)
+
+
+def _fast(**kw):
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.002)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# policy determinism
+# ---------------------------------------------------------------------------
+
+
+def test_from_config_none_when_off():
+    assert RetryPolicy.from_config(CommConfig()) is None
+    p = RetryPolicy.from_config(CommConfig(send_retries=3), seed=9)
+    assert p.max_attempts == 4 and p.seed == 9
+    # chaos without retries still builds a policy (the CLI guards the
+    # combination; programmatic callers get the give-up accounting)
+    assert RetryPolicy.from_config(CommConfig(send_fault_p=0.5)) is not None
+
+
+def test_backoff_is_deterministic_jittered_and_capped():
+    p = RetryPolicy(max_attempts=5, backoff_base_s=0.1, backoff_max_s=0.5, seed=3)
+    seq = [p.backoff_s(0, a) for a in range(1, 6)]
+    assert seq == [p.backoff_s(0, a) for a in range(1, 6)]  # pure
+    # jitter stays within [0.5, 1.5) of the exponential raw value, capped
+    for a, s in enumerate(seq, start=1):
+        raw = 0.1 * 2 ** (a - 1)
+        assert min(0.5, 0.5 * raw) <= s <= min(0.5, 1.5 * raw)
+    assert max(seq) <= 0.5  # capped
+    # a different seed moves the jitter
+    q = RetryPolicy(max_attempts=5, backoff_base_s=0.1, backoff_max_s=0.5, seed=4)
+    assert [q.backoff_s(0, a) for a in range(1, 6)] != seq
+
+
+def test_chaos_injection_is_pure_in_seed_seq_attempt():
+    p = _fast(max_attempts=2, fault_p=0.5, seed=11)
+    grid = [(s, a) for s in range(64) for a in range(2)]
+    flips = [p.injects(s, a) for s, a in grid]
+    assert flips == [p.injects(s, a) for s, a in grid]
+    assert any(flips) and not all(flips)  # a real coin, not a constant
+    q = _fast(max_attempts=2, fault_p=0.5, seed=12)
+    assert [q.injects(s, a) for s, a in grid] != flips
+
+
+# ---------------------------------------------------------------------------
+# the send template
+# ---------------------------------------------------------------------------
+
+
+def test_no_policy_is_legacy_single_attempt():
+    comm = _FlakyComm(fail_first=1)
+    with pytest.raises(ConnectionError):
+        comm.send_message(_msg())
+    assert comm.attempts == 1 and not comm.delivered
+
+
+def test_retry_delivers_after_transient_failures():
+    scope = TelemetryScope(tenant="t")
+    with scope.activate():
+        comm = _FlakyComm(fail_first=2)
+    comm.set_retry_policy(_fast(max_attempts=4))
+    comm.send_message(_msg())
+    assert comm.attempts == 3 and len(comm.delivered) == 1
+    snap = scope.comm_meter.snapshot()
+    assert sum(snap["send_retries"].values()) == 2
+    assert sum(snap["send_gave_up"].values()) == 0
+    # the delivered message IS counted as sent
+    assert sum(snap["messages_sent"].values()) == 1
+
+
+def test_retry_gives_up_after_attempt_cap_and_raises_original():
+    scope = TelemetryScope(tenant="t")
+    with scope.activate():
+        comm = _FlakyComm(fail_first=100)
+    comm.set_retry_policy(_fast(max_attempts=3))
+    with pytest.raises(ConnectionError):
+        comm.send_message(_msg())
+    assert comm.attempts == 3
+    snap = scope.comm_meter.snapshot()
+    assert sum(snap["send_retries"].values()) == 2
+    assert sum(snap["send_gave_up"].values()) == 1
+    assert sum(snap["messages_sent"].values()) == 0  # never counted as sent
+
+
+def test_retry_deadline_caps_total_time():
+    scope = TelemetryScope(tenant="t")
+    with scope.activate():
+        comm = _FlakyComm(fail_first=100)
+    # huge attempt budget but a deadline the second backoff would cross
+    comm.set_retry_policy(RetryPolicy(
+        max_attempts=1000, backoff_base_s=0.2, backoff_max_s=0.2,
+        deadline_s=0.05,
+    ))
+    with pytest.raises(ConnectionError):
+        comm.send_message(_msg())
+    assert comm.attempts < 5  # gave up on the deadline, not the cap
+
+
+def test_injected_faults_are_retried_and_deterministic():
+    poly = _fast(max_attempts=8, fault_p=0.5, seed=5)
+
+    def run():
+        scope = TelemetryScope(tenant="t")
+        with scope.activate():
+            comm = _FlakyComm(fail_first=0)
+        comm.set_retry_policy(poly)
+        for _ in range(20):
+            comm.send_message(_msg())
+        snap = scope.comm_meter.snapshot()
+        return (
+            len(comm.delivered),
+            sum(snap["send_retries"].values()),
+            sum(snap["send_gave_up"].values()),
+        )
+
+    first = run()
+    assert first[0] == 20 and first[1] > 0 and first[2] == 0
+    assert run() == first  # the chaos schedule replays identically
+
+
+def test_injected_fault_without_retries_gives_up():
+    comm = _FlakyComm(fail_first=0)
+    comm.set_retry_policy(RetryPolicy(max_attempts=1, fault_p=1.0))
+    with pytest.raises(InjectedSendFault):
+        comm.send_message(_msg())
+    assert not comm.delivered  # the chaos fault fires BEFORE the wire
+
+
+# ---------------------------------------------------------------------------
+# grpc satellite: configurable timeout, retry-owned reconnects
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_send_timeout_is_config_not_hardcoded():
+    pytest.importorskip("grpc")
+    from fedml_tpu.core.grpc_comm import GrpcCommManager
+
+    comm = GrpcCommManager(
+        0, {0: "127.0.0.1"}, base_port=18990, send_timeout_s=3.5
+    )
+    try:
+        assert comm.send_timeout_s == 3.5
+        assert comm.handshake_timeout_s == 120.0
+    finally:
+        comm.stop_receive_message()
+
+
+def test_grpc_retry_policy_owns_reconnects_no_handshake_stall():
+    """With a retry policy installed, a send to a dead peer fails fast at
+    send_timeout_s per attempt (no one-shot 120 s wait_for_ready) and the
+    template retries it — here to exhaustion, quickly."""
+    pytest.importorskip("grpc")
+    import time
+
+    from fedml_tpu.core.grpc_comm import GrpcCommManager
+
+    comm = GrpcCommManager(
+        1, {1: "127.0.0.1", 0: "127.0.0.1"}, base_port=18992,
+        send_timeout_s=0.2,
+    )
+    comm.set_retry_policy(_fast(max_attempts=2))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(Exception):
+            comm.send_message(Message(MT.C2S_SEND_STATS, 1, 0))  # rank 0 dead
+        assert time.monotonic() - t0 < 10.0  # not the 120 s handshake
+    finally:
+        comm.stop_receive_message()
+
+
+# ---------------------------------------------------------------------------
+# federation contract: flaky transport, unchanged numerics (acceptance c)
+# ---------------------------------------------------------------------------
+
+
+def _data_model():
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    data = synthetic_classification(
+        num_clients=6, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=0,
+    )
+    return data, create_model("lr", "synthetic", (10,), 3)
+
+
+def _cfg(**comm_kw):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=3, comm_round=3,
+            epochs=1, frequency_of_the_test=100,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        comm=CommConfig(**comm_kw),
+        seed=0,
+    )
+
+
+def test_flaky_transport_federation_matches_fault_free():
+    from fedml_tpu.serve import FedSession
+
+    data, model = _data_model()
+    clean = FedSession(
+        _cfg(), data, model, name="rt_clean",
+        scope=TelemetryScope(tenant="rt_clean"),
+    ).run()
+    scope = TelemetryScope(tenant="rt_flaky")
+    session = FedSession(
+        _cfg(send_retries=6, send_fault_p=0.25, send_backoff_s=0.002),
+        data, model, name="rt_flaky", scope=scope,
+    )
+    flaky = session.run()
+    snap = scope.comm_meter.snapshot()
+    assert sum(snap["send_retries"].values()) > 0
+    assert sum(snap["send_gave_up"].values()) == 0
+    row = session.summary_row()
+    assert row["comm/retries"] > 0 and row["comm/gave_up"] == 0
+    for a, b in zip(
+        jax.tree_util.tree_leaves(clean.global_vars),
+        jax.tree_util.tree_leaves(flaky.global_vars),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fedbuff_flaky_transport_completes_with_retries():
+    """Async path: at-least-once re-deliveries under chaos are absorbed
+    by the dispatch-tag dedupe; the run reaches its step target."""
+    from fedml_tpu.serve import FedSession
+
+    data, model = _data_model()
+    scope = TelemetryScope(tenant="rt_async")
+    session = FedSession(
+        _cfg(send_retries=6, send_fault_p=0.2, send_backoff_s=0.002).replace(
+            fed=FedConfig(
+                client_num_in_total=6, client_num_per_round=2, comm_round=4,
+                epochs=1, frequency_of_the_test=100, async_buffer_k=2,
+            )
+        ),
+        data, model, name="rt_async", algorithm="fedbuff", scope=scope,
+    )
+    server = session.run()
+    assert server.server_steps == 4
+    snap = scope.comm_meter.snapshot()
+    assert sum(snap["send_retries"].values()) > 0
+    assert sum(snap["send_gave_up"].values()) == 0
+
+
+def test_cli_rejects_chaos_without_retries_and_sim_runtimes():
+    from click.testing import CliRunner
+
+    from fedml_tpu.cli import main
+
+    r = CliRunner().invoke(main, [
+        "--runtime", "loopback", "--send_fault_p", "0.2",
+        "--dataset", "synthetic", "--ci",
+    ])
+    assert r.exit_code != 0 and "send_retries" in r.output
+    r = CliRunner().invoke(main, [
+        "--runtime", "vmap", "--send_retries", "3",
+        "--dataset", "synthetic", "--ci",
+    ])
+    assert r.exit_code != 0 and "transport" in r.output

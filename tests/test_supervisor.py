@@ -1,0 +1,417 @@
+"""Session supervisor (fedml_tpu/serve/supervisor.py): crash -> restart
+from the rolling checkpoint with bit-parity, restart budgets, the
+crash-loop breaker, tenant-labeled restart metrics, and the serve CLI's
+split exit codes (flaky tenant vs misconfigured spec)."""
+
+import json
+import os
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+from fedml_tpu.serve import (
+    FederationServer,
+    FedSession,
+    RestartBudgetExhausted,
+    RestartPolicy,
+    SupervisedSession,
+)
+
+
+def _data(num_clients=6, seed=0):
+    return synthetic_classification(
+        num_clients=num_clients, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=seed,
+    )
+
+
+def _model():
+    return create_model("lr", "synthetic", (10,), 3)
+
+
+def _sync_cfg(comm_round=6, workers=2, total=6, seed=7, **fed_kw):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=total, client_num_per_round=workers,
+            comm_round=comm_round, epochs=1, frequency_of_the_test=100,
+            **fed_kw,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=seed,
+    )
+
+
+def _async_cfg(comm_round=6, workers=1, total=6, k=1, seed=3):
+    return _sync_cfg(
+        comm_round=comm_round, workers=workers, total=total, seed=seed,
+        async_buffer_k=k,
+    )
+
+
+def _tree_equal(a, b):
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _kill_once_at_round(n):
+    state = {"done": False}
+
+    def log_fn(row):
+        if row.get("round") == n and "t_s" in row and not state["done"]:
+            state["done"] = True
+            raise RuntimeError("chaos kill")
+
+    return log_fn
+
+
+# ---------------------------------------------------------------------------
+# self-healing with bit-parity
+# ---------------------------------------------------------------------------
+
+
+def test_sync_tenant_killed_mid_flight_recovers_bit_identical(tmp_path):
+    """THE self-healing contract (acceptance a, as a test): a supervised
+    sync tenant crashes once mid-flight; the supervisor restarts it from
+    its rolling checkpoint and the final model is bit-identical to an
+    uninterrupted run."""
+    data, model = _data(), _model()
+    ref = FedSession(_sync_cfg(), data, model).run()
+
+    sup = SupervisedSession(
+        _sync_cfg(), data, model, name="heal_sync",
+        restart=RestartPolicy(budget=2, backoff_base_s=0.02),
+        checkpoint_path=str(tmp_path / "ck"), checkpoint_every=1,
+        log_fn=_kill_once_at_round(2),
+    )
+    server = sup.run()
+    assert sup.restarts == 1 and sup.recovered
+    assert sup.state == "done" and sup.health_state == "degraded"
+    assert server.round_idx == 6
+    _tree_equal(ref.global_vars, server.global_vars)
+    row = sup.summary_row()
+    assert row["supervisor/restarts"] == 1
+    assert row["supervisor/recovered"] == 1
+    assert row["supervisor/quarantined"] == 0
+
+
+def test_fedbuff_tenant_killed_mid_flight_recovers_bit_identical(tmp_path):
+    """Async twin: kill at a flush boundary, resume re-mints the
+    assignment stream (the PR-9 contract) — now through the supervisor
+    with no operator in the loop. K=1, k=1 keeps the pipeline
+    sequential so equality is exact."""
+    data, model = _data(num_clients=8), _model()
+    ref = FedSession(
+        _async_cfg(total=8), data, model, algorithm="fedbuff"
+    ).run()
+    assert ref.server_steps == 6
+
+    state = {"done": False}
+
+    def chaos(row):
+        if row.get("server_step") == 3 and not state["done"]:
+            state["done"] = True
+            raise RuntimeError("chaos kill")
+
+    sup = SupervisedSession(
+        _async_cfg(total=8), data, model, name="heal_async",
+        algorithm="fedbuff",
+        restart=RestartPolicy(budget=2, backoff_base_s=0.02),
+        checkpoint_path=str(tmp_path / "ack"), checkpoint_every=1,
+        log_fn=chaos,
+    )
+    server = sup.run()
+    assert sup.restarts == 1 and sup.recovered
+    assert server.server_steps == 6
+    _tree_equal(ref.global_vars, server.global_vars)
+
+
+# ---------------------------------------------------------------------------
+# budget exhaustion + crash-loop breaker
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_checkpoint_exhausts_budget_and_quarantines(tmp_path):
+    """The satellite contract: a tenant whose checkpoint is corrupt must
+    exhaust its restart budget and fail LOUDLY with a quarantine-style
+    message — not spin — with the restarts visible in the scraped
+    /metrics (tenant-labeled)."""
+    data, model = _data(), _model()
+    cp = str(tmp_path / "bad")
+    with open(cp + ".npz", "wb") as f:
+        f.write(b"definitely not an npz archive")
+    srv = FederationServer(prom_port=0)
+    sup = srv.create_session(
+        "corrupt", _sync_cfg(), data, model,
+        restart=RestartPolicy(budget=2, backoff_base_s=0.01),
+        checkpoint_path=cp, checkpoint_every=1, resume=True,
+    )
+    srv.start()
+    results = srv.wait()
+    assert not results["corrupt"]["ok"]
+    assert results["corrupt"]["error_kind"] == "restart_exhausted"
+    assert "QUARANTINED" in results["corrupt"]["error"]
+    assert "corrupt" in results["corrupt"]["error"]  # points at the ckpt
+    assert sup.restarts == 2
+    summary = results["corrupt"]["summary"]
+    assert summary["supervisor/quarantined"] == 1
+    assert summary["supervisor/health"] == "failed"
+    # restarts are scrapeable, tenant-labeled, from the live exporter
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.prom_port}/metrics"
+    ).read().decode()
+    assert 'fedml_session_restarts_total{tenant="corrupt"} 2.0' in body
+    assert 'fedml_session_quarantined{tenant="corrupt"} 1.0' in body
+    srv.close()
+
+
+def test_crash_loop_breaker_trips_before_budget(tmp_path):
+    """A deterministic crash loop (no progress between crashes) trips the
+    breaker after breaker_window restarts even when the budget would
+    allow many more — more restarts cannot fix a deterministic crash."""
+    data, model = _data(), _model()
+
+    def always_crash(row):
+        if "t_s" in row:
+            raise RuntimeError("deterministic bug")
+
+    sup = SupervisedSession(
+        _sync_cfg(), data, model, name="loopy",
+        restart=RestartPolicy(
+            budget=50, backoff_base_s=0.01, breaker_window=2
+        ),
+        checkpoint_path=str(tmp_path / "lk"), checkpoint_every=1,
+        log_fn=always_crash,
+    )
+    sup.start()
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.wait()
+    assert ei.value.reason == "crash_loop"
+    # window=2: the initial attempt + 1 restart both crashed at round 0,
+    # so exactly 1 restart burned — nowhere near the 50-restart budget
+    assert sup.restarts == 1
+    assert "crash-loop breaker" in str(ei.value)
+
+
+def test_supervised_config_error_does_not_burn_budget():
+    """A deterministic session-build rejection (config guard, no
+    checkpoint in play) is terminal on the FIRST attempt and classified
+    'config' — retrying identical inputs cannot help, and reporting it
+    as a flaky tenant (exit 3) would send the operator chasing ghosts."""
+    data, model = _data(), _model()
+    srv = FederationServer()
+    sup = srv.create_session(
+        "badsup", _sync_cfg(fault_plan='{"default": {"dropout_p": 0.5}}'),
+        data, model, restart=RestartPolicy(budget=5, backoff_base_s=0.01),
+    )
+    srv.start()
+    results = srv.wait()
+    assert not results["badsup"]["ok"]
+    assert results["badsup"]["error_kind"] == "config"
+    assert "deadline_s" in results["badsup"]["error"]
+    assert sup.restarts == 0  # the budget was not touched
+
+
+def test_unsupervised_config_error_classified_config():
+    """A config-guard ValueError at session build stays the
+    misconfigured-spec class — distinct from a flaky tenant."""
+    data, model = _data(), _model()
+    srv = FederationServer()
+    srv.add_session(FedSession(
+        _sync_cfg(fault_plan='{"default": {"dropout_p": 0.5}}'),
+        data, model, name="badcfg",
+    ))
+    with pytest.raises(ValueError, match="deadline_s"):
+        srv.start()
+    session = srv.session("badcfg")
+    assert session.failure_phase == "build"
+
+
+def test_supervised_tenant_without_checkpoint_restarts_from_scratch():
+    data, model = _data(), _model()
+    killed = {"done": False}
+
+    def chaos(row):
+        if row.get("round") == 1 and "t_s" in row and not killed["done"]:
+            killed["done"] = True
+            raise RuntimeError("chaos")
+
+    ref = FedSession(_sync_cfg(comm_round=3), data, model).run()
+    sup = SupervisedSession(
+        _sync_cfg(comm_round=3), data, model, name="scratch",
+        restart=RestartPolicy(budget=1, backoff_base_s=0.01),
+        log_fn=chaos,
+    )
+    server = sup.run()
+    assert sup.restarts == 1 and server.round_idx == 3
+    _tree_equal(ref.global_vars, server.global_vars)  # deterministic rerun
+
+
+def test_supervised_session_rejects_bad_config_eagerly():
+    """Constructor-level config errors surface at create time — before
+    any supervision — so a misconfigured spec cannot burn a restart
+    budget and masquerade as flakiness."""
+    data, model = _data(), _model()
+    with pytest.raises(ValueError, match="warmup"):
+        SupervisedSession(
+            _async_cfg(), data, model, algorithm="fedbuff", warmup=True,
+            restart=RestartPolicy(budget=3),
+        )
+
+
+def test_stop_during_backoff_fails_fast(tmp_path):
+    data, model = _data(), _model()
+
+    def always_crash(row):
+        if "t_s" in row:
+            raise RuntimeError("bug")
+
+    sup = SupervisedSession(
+        _sync_cfg(), data, model, name="stopme",
+        restart=RestartPolicy(budget=100, backoff_base_s=30.0),
+        checkpoint_path=str(tmp_path / "sk"), checkpoint_every=1,
+        log_fn=always_crash,
+    )
+    sup.start()
+    import time
+
+    t0 = time.monotonic()
+    while sup.state != "backoff" and time.monotonic() - t0 < 60:
+        time.sleep(0.02)
+    assert sup.state == "backoff"
+    sup.stop()  # wakes the 30 s backoff sleeper immediately
+    with pytest.raises(RuntimeError, match="bug"):
+        sup.wait(timeout=30)
+    assert sup.state == "failed"
+
+
+# ---------------------------------------------------------------------------
+# serve CLI: split exit codes
+# ---------------------------------------------------------------------------
+
+
+def _json_line(output):
+    """The CLI's JSON result line (click may append error text after)."""
+    for line in reversed(output.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(f"no JSON line in output: {output!r}")
+
+
+def _tenant(name, **over):
+    t = {
+        "name": name, "algorithm": "fedavg", "runtime": "loopback",
+        "model": "lr", "dataset": "synthetic", "client_num_in_total": 6,
+        "client_num_per_round": 2, "comm_round": 2, "batch_size": 8,
+        "frequency_of_the_test": 100,
+    }
+    t.update(over)
+    return t
+
+
+def test_serve_cli_exit_codes_split_config_vs_flaky(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.cli import serve_main
+
+    # (2) misconfigured spec: participation faults without deadline_s is
+    # a session-build config error — and it must not kill co-tenants
+    spec = {"tenants": [
+        _tenant("good"),
+        _tenant("bad", fault_plan='{"default": {"dropout_p": 0.5}}'),
+    ]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code == 2, r.output
+    out = _json_line(r.output)
+    assert out["good"]["ok"] and not out["bad"]["ok"]
+    assert out["bad"]["error_kind"] == "config"
+
+    # (3) flaky tenant: supervised resume from a corrupt checkpoint
+    # exhausts its budget -> the dedicated exit code
+    cp = tmp_path / "corrupt_ck"
+    (tmp_path / "corrupt_ck.npz").write_bytes(b"garbage")
+    spec = {"tenants": [_tenant(
+        "flaky", checkpoint_path=str(cp), checkpoint_every=1,
+        resume=True, restart_budget=1, restart_backoff_s=0.01,
+    )]}
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code == 3, r.output
+    out = _json_line(r.output)
+    assert out["flaky"]["error_kind"] == "restart_exhausted"
+    assert out["flaky"]["supervisor/restarts"] == 1
+
+
+def test_serve_cli_supervised_clean_tenant_exits_zero(tmp_path):
+    """A supervised tenant that never crashes is exit 0 with
+    supervisor/restarts 0 and health "healthy" in the JSON output —
+    supervision itself costs nothing. (Mid-run kills are not expressible
+    through a spec; "recovered after N restarts" -> exit 0 is pinned
+    programmatically in the kill/recover tests above, which run through
+    the same summary surface the CLI prints.)"""
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.cli import serve_main
+
+    spec = {"tenants": [_tenant(
+        "calm", restart_budget=2, restart_backoff_s=0.01,
+        checkpoint_path=str(tmp_path / "calm_ck"), checkpoint_every=1,
+    )]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code == 0, r.output
+    out = _json_line(r.output)
+    assert out["calm"]["ok"]
+    assert out["calm"]["supervisor/restarts"] == 0
+    assert out["calm"]["supervisor/health"] == "healthy"
+
+
+def test_serve_spec_gets_single_run_comm_retry_guards(tmp_path):
+    """Chaos without retries in a tenant spec is a parse-time config
+    error (exit 2), exactly like the single-run CLI — not a mid-run
+    crash that burns a supervised tenant's restart budget."""
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.cli import serve_main
+
+    spec = {"tenants": [_tenant("chaotic", send_fault_p=0.5)]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code == 2, (r.exit_code, r.output)
+    assert "send_retries" in r.output and "chaotic" in r.output
+    # and the valid combination passes through to the tenant config
+    spec = {"tenants": [_tenant(
+        "retrying", send_fault_p=0.2, send_retries=4, send_backoff_s=0.002,
+    )]}
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code == 0, r.output
+    out = _json_line(r.output)
+    assert out["retrying"]["ok"]
+    assert out["retrying"]["comm/retries"] > 0
+    assert out["retrying"]["comm/gave_up"] == 0
+
+
+def test_serve_cli_rejects_restart_knobs_without_budget(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.cli import serve_main
+
+    spec = {"tenants": [_tenant("x", restart_backoff_s=1.0)]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code != 0
+    assert "restart_budget" in r.output
