@@ -782,6 +782,88 @@ def _scale_100k_stateful(num_clients=100_000, timed_rounds=15):
     }
 
 
+def _scale_1m(num_clients=1_000_000, timed_rounds=10, repeats=3):
+    """1M-client stateful run through the population runtime (ROADMAP
+    item 1 gate; ISSUE 11): SCAFFOLD with the SHARDED record-major state
+    tier (population/state_tier.py) + the non-uniform ``weighted``
+    selection policy drawn O(cohort) through the alias sampler
+    (population/sampler.py). The partner run is the IDENTICAL federation
+    at 100k clients — same cohort geometry, same store, same policy —
+    so the ratio isolates what the gate demands: steady-state round time
+    flat in N (the acceptance bar is within ~2× of the 100k rate).
+    DATA shards are 64 distinct synthetic shards tiled over the ids
+    (scale_100k's own row covers disk-backed data; this row isolates
+    the population machinery: selection + state tier + health)."""
+    import dataclasses as _dc
+    import tempfile
+
+    from fedml_tpu.algorithms.scaffold import ScaffoldAPI
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    base = synthetic_classification(
+        num_clients=64, num_classes=10, feat_shape=(32,),
+        samples_per_client=32, partition_method="hetero", seed=0,
+    )
+
+    def tiled(n):
+        return _dc.replace(
+            base,
+            client_x=[base.client_x[i % 64] for i in range(n)],
+            client_y=[base.client_y[i % 64] for i in range(n)],
+        )
+
+    def run(n):
+        cfg = RunConfig(
+            data=DataConfig(batch_size=16, device_cache=False),
+            fed=FedConfig(
+                client_num_in_total=n, client_num_per_round=10,
+                comm_round=1, epochs=1, frequency_of_the_test=10_000,
+                selection="weighted",
+                state_store="sharded",
+                state_dir=tempfile.mkdtemp(prefix=f"fedml_tpu_pop_{n}_"),
+            ),
+            train=TrainConfig(client_optimizer="sgd", lr=0.1),
+            seed=0,
+        )
+        model = create_model("lr", "synthetic", (32,), 10)
+        t0 = time.perf_counter()
+        api = ScaffoldAPI(cfg, tiled(n), model)
+        assert api._state_mode == "sharded"
+        assert api.scheduler._ctx.index is not None, "O(cohort) draw off"
+        build_s = time.perf_counter() - t0
+        m = None
+        for r in range(3):
+            _, m = api.train_round(r)
+        _sync(m)
+        return api, _timed_rounds(api, 3, timed_rounds, repeats=repeats), build_s
+
+    api, s_1m, build_1m = run(num_clients)
+    _, s_100k, _ = run(100_000)
+    return {
+        "algorithm": "scaffold",
+        "selection": "weighted (alias-sampled, O(cohort))",
+        "num_clients": num_clients,
+        "state_store": "sharded record-major mmap "
+                       "(population/state_tier.py), cohort-only "
+                       "gather/scatter, lazy zero-init, next-cohort "
+                       "prefetch",
+        "state_bytes_logical": int(api._c_store.state_bytes_total),
+        "state_rows_touched": int(api._c_store.initialized_count()),
+        "api_build_s": round(build_1m, 2),
+        "rounds_per_sec": round(1.0 / s_1m, 3),
+        "round_ms_wall": round(s_1m * 1e3, 1),
+        "partner_100k_rounds_per_sec": round(1.0 / s_100k, 3),
+        "ratio_1m_over_100k": round(s_1m / s_100k, 3),
+        "gate": "steady-state round time flat in N: ratio must stay "
+                "within ~2x (ROADMAP item 1 / ISSUE 11 acceptance)",
+        "data_note": "64 distinct shards tiled over the ids — isolates "
+                     "the population machinery (selection, state tier, "
+                     "health); scale_100k covers the disk data tier",
+    }
+
+
 def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=6, async_steps=18):
     """Async (FedBuff) vs sync (barrier) under compute heterogeneity —
     VERDICT r3 Next #3: async's pitch, quantified. Both arms run as REAL
@@ -1273,7 +1355,7 @@ class _Emitter:
         "north_star_eager_trainloop", "north_star_fused",
         "bf16_cross_silo_resnet56", "flash_attention_s8192",
         "mxu_validation", "scale_100k_clients", "scale_100k_stateful",
-        "fedbuff_async", "process_cold_start",
+        "scale_1m", "fedbuff_async", "process_cold_start",
     )
 
     def __init__(self, t0: float, detail_path: str):
@@ -1572,6 +1654,7 @@ def main():
         "flash_attention": ("flash_attention_s8192",),
         "scale": ("scale_100k_clients",),
         "scale_stateful": ("scale_100k_stateful",),
+        "scale_1m": ("scale_1m",),
         "sleeper": ("north_star_bf16",),
     }
 
@@ -1731,6 +1814,9 @@ def main():
     def s_scale_state():
         emitter.update({"scale_100k_stateful": _scale_100k_stateful()})
 
+    def s_scale_1m():
+        emitter.update({"scale_1m": _scale_1m()})
+
     def s_cold_start():
         emitter.update({"process_cold_start": _process_cold_start()})
 
@@ -1797,6 +1883,7 @@ def main():
             ("flash_attention", s_flash, 80, 240),
             ("scale", s_scale, 140, 480),
             ("scale_stateful", s_scale_state, 60, 300),
+            ("scale_1m", s_scale_1m, 120, 480),
             ("bf16_cross_silo", s_bf16_cross_silo, 380, 600),
         ]
     prev = time.perf_counter()

@@ -401,7 +401,7 @@ class DittoAPI(FedAvgAPI):
     ):
         super().__init__(config, data, model, **kw)
         from fedml_tpu.algorithms.state_store import (
-            MmapClientState,
+            make_spill_store,
             resolve_state_store,
         )
 
@@ -411,7 +411,10 @@ class DittoAPI(FedAvgAPI):
             int(np.prod(v.shape)) * v.dtype.itemsize
             for v in jax.tree_util.tree_leaves(self.global_vars)
         )
-        self._state_mode = resolve_state_store(config.fed, vbytes * n)
+        self._state_mode = resolve_state_store(
+            config.fed, vbytes * n, n_clients=n,
+            population=getattr(config, "population", None),
+        )
         if self._state_mode == "device":
             # paper init: v_k = w_0 (every personal model starts at the
             # global init)
@@ -425,10 +428,12 @@ class DittoAPI(FedAvgAPI):
             self.v_stack = None
             # lazy v_k = w_0 init: untouched rows gather as w_0 without a
             # 100k-row write at construction
-            self._v_store = MmapClientState(
+            self._v_store = make_spill_store(
+                self._state_mode,
                 jax.device_get(self.global_vars),
                 n,
                 config.fed.state_dir or None,
+                population=getattr(config, "population", None),
             )
             self._v_prefetch = CohortPrefetcher(self._v_store)
             self._ditto_round = self._build_ditto_cohort_round()
@@ -477,7 +482,7 @@ class DittoAPI(FedAvgAPI):
     def restore_state(self, tree):
         from fedml_tpu.utils.checkpoint import restore_like
 
-        if self._state_mode == "mmap":
+        if self._state_mode != "device":
             # a pending prefetch holds PRE-restore rows; drop it before
             # reset_to rewrites the store
             self._v_prefetch.cancel()

@@ -471,7 +471,7 @@ class FedAvgAPI:
         # shared round wall time — participation/last-seen stay exact, and
         # the transport runtimes refine timing per client.
         self._tracer = get_tracer()
-        self.health = ClientHealthRegistry()
+        self.health = ClientHealthRegistry.from_config(config)
         # Scheduler: policy-driven cohort selection (FedConfig.selection /
         # .overprovision_factor, scheduler/policies.py). It shares this
         # API's health registry (straggler_aware consults the straggler

@@ -296,7 +296,7 @@ class FedAvgServerManager(ServerManager):
         # at broadcast and end at round completion (possibly on another
         # thread), so they use explicit handles, not context managers.
         self._tracer = get_tracer()
-        self.health = ClientHealthRegistry().attach(self._tracer)
+        self.health = ClientHealthRegistry.from_config(config).attach(self._tracer)
         self._round_span = None
         self._assigned: Dict[int, tuple] = {}  # worker -> (client_idx, t_bcast)
         # Scheduler: the SAME policy driver the vmap simulator uses

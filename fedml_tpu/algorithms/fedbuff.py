@@ -164,7 +164,7 @@ class FedBuffServerManager(ServerManager):
         # flagged client's next delta can be discounted before it is even
         # buffered. Rounds here are model VERSIONS (there is no barrier).
         self._tracer = get_tracer()
-        self.health = ClientHealthRegistry().attach(self._tracer)
+        self.health = ClientHealthRegistry.from_config(config).attach(self._tracer)
         self._dispatch_times: Dict[int, tuple] = {}  # worker -> (cid, tag, t)
         # Non-uniform dispatch (FedConfig.selection): route each
         # assignment through the scheduler registry keyed by the dispatch

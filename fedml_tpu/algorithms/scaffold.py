@@ -580,7 +580,7 @@ class ScaffoldAPI(FedAvgAPI):
     def __init__(self, config: RunConfig, data: FederatedDataset, model: ModelDef, **kw):
         super().__init__(config, data, model, **kw)
         from fedml_tpu.algorithms.state_store import (
-            MmapClientState,
+            make_spill_store,
             resolve_state_store,
         )
 
@@ -591,7 +591,10 @@ class ScaffoldAPI(FedAvgAPI):
         )
         zeros32 = lambda p: jnp.zeros(p.shape, jnp.float32)
         self.c_server = jax.tree_util.tree_map(zeros32, params)
-        self._state_mode = resolve_state_store(config.fed, 4 * psize * n)
+        self._state_mode = resolve_state_store(
+            config.fed, 4 * psize * n, n_clients=n,
+            population=getattr(config, "population", None),
+        )
         if self._state_mode == "device":
             self.c_stack = jax.tree_util.tree_map(
                 lambda p: jnp.zeros((n,) + p.shape, jnp.float32), params
@@ -601,12 +604,14 @@ class ScaffoldAPI(FedAvgAPI):
             from fedml_tpu.algorithms.state_store import CohortPrefetcher
 
             self.c_stack = None
-            self._c_store = MmapClientState(
+            self._c_store = make_spill_store(
+                self._state_mode,
                 jax.tree_util.tree_map(
                     lambda p: np.zeros(p.shape, np.float32), params
                 ),
                 n,
                 config.fed.state_dir or None,
+                population=getattr(config, "population", None),
             )
             # overlap the NEXT cohort's disk gather with the current
             # round's device compute (the measured spill tax was 3.1x —
@@ -669,7 +674,7 @@ class ScaffoldAPI(FedAvgAPI):
     def restore_state(self, tree):
         from fedml_tpu.utils.checkpoint import restore_like
 
-        if self._state_mode == "mmap":
+        if self._state_mode != "device":
             # a pending prefetch holds PRE-restore rows; drop it (and let
             # any in-flight read finish before reset_to rewrites the store)
             self._c_prefetch.cancel()

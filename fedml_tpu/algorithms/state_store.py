@@ -88,6 +88,7 @@ class MmapClientState:
             self._init_mask = np.load(
                 os.path.join(self.path, "init_mask.npy"), mmap_mode="r+"
             )
+            self._advise_random()
         else:
             # open_memmap w+ creates SPARSE zero-filled files — O(1) in
             # data written, whatever N is
@@ -108,6 +109,18 @@ class MmapClientState:
             )
             with open(meta_path, "w") as f:
                 json.dump({"n": self.n, "leaves": schema}, f)
+            self._advise_random()
+
+    def _advise_random(self) -> None:
+        # cohort rows are random by construction: kernel readahead on
+        # the sparse [N, ...] files amplifies every row fault into a
+        # full readahead window (measured 280x on the sharded tier at
+        # 1M clients — see data.mmap_store.advise_random)
+        from fedml_tpu.data.mmap_store import advise_random
+
+        for mm in self._mms:
+            advise_random(mm)
+        advise_random(self._init_mask)
 
     @property
     def state_bytes_total(self) -> int:
@@ -244,19 +257,49 @@ class CohortPrefetcher:
 
 
 def resolve_state_store(
-    config_fed, state_bytes: int
+    config_fed, state_bytes: int, n_clients: int = 0, population=None
 ) -> str:
-    """"device" | "mmap" from FedConfig.state_store and the state size."""
+    """"device" | "mmap" | "sharded" from FedConfig.state_store, the
+    state size, and the population. ``auto`` keeps the stack in HBM
+    while it fits the budget; past it, spill goes to the per-leaf mmap
+    tier — or, at/above the population threshold
+    (PopulationConfig.ocohort_threshold), to the record-major sharded
+    tier (population/state_tier.py: one contiguous record per client
+    instead of one scattered row per pytree leaf)."""
     mode = config_fed.state_store
     if mode == "auto":
-        return (
-            "device"
-            if state_bytes <= config_fed.state_budget_bytes
-            else "mmap"
+        if state_bytes <= config_fed.state_budget_bytes:
+            return "device"
+        threshold = (
+            population.ocohort_threshold if population is not None else 65536
         )
-    if mode not in ("device", "mmap"):
+        return "sharded" if n_clients and n_clients >= threshold else "mmap"
+    if mode not in ("device", "mmap", "sharded"):
         raise ValueError(
-            f"FedConfig.state_store must be 'auto', 'device' or 'mmap'; "
-            f"got {mode!r}"
+            f"FedConfig.state_store must be 'auto', 'device', 'mmap' or "
+            f"'sharded'; got {mode!r}"
         )
     return mode
+
+
+def make_spill_store(
+    mode: str, init_tree, n_clients: int, path=None, population=None
+):
+    """Construct the spill tier named by a resolved non-device mode —
+    the ONE mapping from mode string to store class, shared by SCAFFOLD
+    and Ditto (and any future stateful algorithm), so the two can never
+    wire the tiers differently."""
+    if mode == "sharded":
+        from fedml_tpu.population.state_tier import ShardedClientState
+
+        return ShardedClientState(
+            init_tree,
+            n_clients,
+            path,
+            shard_bits=(
+                population.state_shard_bits if population is not None else 16
+            ),
+        )
+    if mode == "mmap":
+        return MmapClientState(init_tree, n_clients, path)
+    raise ValueError(f"not a spill-store mode: {mode!r}")

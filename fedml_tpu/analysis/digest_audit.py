@@ -275,6 +275,19 @@ KNOWN_BENIGN = frozenset({
     "mesh.client_shards", "mesh.axis_name",
     "compile.warmup", "compile.cache_dir", "compile.min_compile_time_s",
     "compile.executable_cache", "compile.recompile_budget",
+    # PopulationConfig (fedml_tpu/population/): every leaf steers HOST-
+    # SIDE structures — which sampler implementation draws the cohort,
+    # where the packed index / sharded state records live on disk, and
+    # the telemetry/checkpoint bounds. None can reach a traced program:
+    # the cohort a policy draws is a program INPUT (ids/shapes), the
+    # state tiers are exact byte stores outside jit, and the health/
+    # loss-map bounds only affect bookkeeping. A leaf here changing a
+    # lowered program would be a population-layer bug, not a digest gap.
+    "population.ocohort_threshold", "population.index_mmap_bytes",
+    "population.index_dir", "population.state_shard_bits",
+    "population.loss_map_capacity", "population.selection_memo_rounds",
+    "population.health_active_clients",
+    "population.health_trace_budget_bytes",
 })
 
 

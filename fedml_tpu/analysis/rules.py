@@ -33,6 +33,12 @@ Rule ids are kebab-case and stable (baseline files and inline
   into ProgramCache key fields or ``*_fingerprint`` helpers: ``id()``
   is never stable, ``repr`` only within a process — both poison any
   cross-process digest use (ROADMAP's serialized-executable item).
+- ``o-n-per-round``    — a loop/comprehension over the FULL population
+  (``range(... client_num_in_total ...)`` or an iteration of a
+  ``*num_clients``-sized range) in algorithms/ or scheduler/ outside a
+  build-time function: per-round O(N) work is the bug class the
+  population runtime (fedml_tpu/population/, PR 11) exists to remove —
+  round cost must be O(cohort), with N touched only at build time.
 
 See docs/ANALYSIS.md for the catalog with examples and the suppression
 syntax. The checks are heuristic by design — conservative enough to be
@@ -652,6 +658,81 @@ def check_nondet(ctx: FileContext) -> List[Finding]:
                 "constant ('random once per compile'), and results silently "
                 "depend on cache state — use jax.random with explicit keys "
                 "or hoist the value to a program input",
+                scope=scope_chain(node),
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# o-n-per-round
+# --------------------------------------------------------------------------
+
+# Function names that legitimately touch all N clients: construction,
+# checkpoint/restore (self-contained state embeds touched rows), config
+# plumbing, and one-time warmup/pre-enumeration. Everything else in
+# algorithms//scheduler/ is presumed on or near the round path — the
+# population contract (docs/POPULATION.md) is round cost O(cohort).
+_BUILD_TIME_NAMES = frozenset({
+    "__init__", "from_config", "warmup", "checkpoint_state",
+    "restore_state", "state_dict", "load_state_dict", "reset_to",
+})
+_BUILD_TIME_PREFIXES = ("make_", "_build", "build_")
+
+# Attribute/name endings that denote the full population size.
+_POPULATION_NAMES = ("client_num_in_total",)
+
+
+def _mentions_population(node: ast.AST) -> Optional[str]:
+    """The dotted population-size expression under ``node``, if any —
+    ``config.fed.client_num_in_total``, bare ``client_num_in_total``, or
+    a local alias like ``n_total`` read straight off one of those."""
+    for n in ast.walk(node):
+        q = qual_name(n) if isinstance(n, (ast.Name, ast.Attribute)) else None
+        if q and q.rsplit(".", 1)[-1] in _POPULATION_NAMES:
+            return q
+    return None
+
+
+def _enclosing_def_is_build_time(node: ast.AST) -> bool:
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = a.name
+            if name in _BUILD_TIME_NAMES or any(
+                name.startswith(p) for p in _BUILD_TIME_PREFIXES
+            ):
+                return True
+    return False
+
+
+@register(
+    "o-n-per-round",
+    "loop over the full client population outside build-time code",
+)
+def check_o_n_per_round(ctx: FileContext) -> List[Finding]:
+    if not ctx.in_dirs(("algorithms", "scheduler")):
+        return []
+    out: List[Finding] = []
+    loops = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.For):
+            loops.append((node, node.iter))
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                loops.append((node, gen.iter))
+    for node, it in loops:
+        q = _mentions_population(it)
+        if q is None or _enclosing_def_is_build_time(node):
+            continue
+        out.append(
+            Finding(
+                "o-n-per-round", ctx.path,
+                node.lineno, node.col_offset,
+                f"iteration over the full population ({q}) outside a "
+                "build-time function: per-round work must be O(cohort) — "
+                "draw through the population runtime's alias/rejection "
+                "samplers or hoist the O(N) pass to construction "
+                "(fedml_tpu/population/, docs/POPULATION.md)",
                 scope=scope_chain(node),
             )
         )

@@ -155,11 +155,15 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="How one chip runs the sampled clients: vmap (batched) "
                    "or scan (sequential — faster for conv models whose "
                    "small channels under-tile the MXU); auto picks per model")
-@click.option("--state_store", type=click.Choice(("auto", "device", "mmap")),
+@click.option("--state_store",
+              type=click.Choice(("auto", "device", "mmap", "sharded")),
               default="auto",
               help="Where scaffold/ditto keep their per-client state: HBM "
                    "stack (device), disk spill with cohort-only HBM rows "
-                   "(mmap), or auto by size vs --state_budget_bytes")
+                   "(mmap: one memmap per pytree leaf; sharded: record-"
+                   "major fixed-stride shards for million-client "
+                   "populations — population/state_tier.py), or auto by "
+                   "size vs --state_budget_bytes and population scale")
 @click.option("--state_budget_bytes", type=int, default=8 << 30,
               help="state_store=auto: spill the per-client state to disk "
                    "past this many bytes (default 8 GiB)")

@@ -116,9 +116,13 @@ class FedConfig:
     # stacked pytree in HBM (gather/scatter inside the jitted round),
     # "mmap" spills it to a disk-backed store (cohort rows ride to device
     # per round — the same disk→host→HBM tiering as data/mmap_store.py),
-    # "auto" picks device while the stack fits state_budget_bytes and
-    # spills beyond it. Round 3 REFUSED past the budget
-    # (VERDICT r3 Weak #3); now it spills instead.
+    # "sharded" spills to the record-major fixed-stride tier
+    # (population/state_tier.py — one contiguous record per client,
+    # sharded files; the million-client form), "auto" picks device while
+    # the stack fits state_budget_bytes and spills beyond it (sharded
+    # at/above PopulationConfig.ocohort_threshold clients, mmap below).
+    # Round 3 REFUSED past the budget (VERDICT r3 Weak #3); now it
+    # spills instead.
     state_store: str = "auto"
     state_budget_bytes: int = 8 << 30
     state_dir: str = ""  # "" = a fresh temp dir per run
@@ -253,6 +257,52 @@ class CompileConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PopulationConfig:
+    """Population-scale runtime knobs (fedml_tpu/population/ — the
+    O(cohort) machinery for 1M+ client registries, docs/POPULATION.md).
+
+    Every field here steers HOST-SIDE data structures (samplers, mmap
+    index/state layout, telemetry bounds); none can reach a compiled
+    program, so the whole section is classified KNOWN_BENIGN in the
+    digest audit (analysis/digest_audit.py)."""
+
+    # Client count at/above which the O(cohort) selection paths engage
+    # (alias-table weighted draw, rejection-sampled candidate pools,
+    # rejection-sampled straggler avoidance). Below it the legacy exact
+    # numpy draws run — identical cohorts to every historical run.
+    ocohort_threshold: int = 65536
+    # PopulationIndex (population/index.py): back the packed per-client
+    # metadata arrays with an on-disk memmap once they exceed this many
+    # bytes (0 = always in RAM). Only matters when index_dir is set.
+    index_mmap_bytes: int = 64 << 20
+    index_dir: str = ""  # "" = keep the packed index in RAM
+    # Sharded state tier (population/state_tier.py): clients per shard
+    # file = 1 << state_shard_bits (default 65536/shard — 1M clients
+    # land in 16 record files).
+    state_shard_bits: int = 16
+    # power_of_choice bias map bound (scheduler/policies.py): the
+    # scheduler keeps at most this many last-known client losses
+    # (insertion-ordered eviction). Bounds the "sched" checkpoint slot —
+    # an unbounded map grows O(N) at million-client populations.
+    loss_map_capacity: int = 65536
+    # How many most-recent rounds of the selection memo the scheduler
+    # checkpoint persists (resume only ever re-selects the in-flight
+    # round; the full memo would grow O(rounds) in the checkpoint).
+    selection_memo_rounds: int = 64
+    # Health registry bounds (telemetry/health.py): full-fidelity
+    # (timing window + dedupe memory) client records are an LRU active
+    # set of at most this many recently-seen clients; evicted records
+    # spill to a compact aggregate (~100 B/client).
+    health_active_clients: int = 65536
+    # Registry-wide byte budget for the full-fidelity fault-event log
+    # backing FaultTrace export. Past it the registry keeps exact fault
+    # TALLIES but stops recording events and marks affected clients
+    # trace_incomplete (FaultPlan.from_trace refuses them — a partial
+    # fleet must never replay silently).
+    health_trace_budget_bytes: int = 16 << 20
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh spec replacing the reference's gpu_mapping.yaml
     (fedml_api/distributed/utils/gpu_mapping.py:8-39)."""
@@ -273,6 +323,9 @@ class RunConfig:
     comm: CommConfig = dataclasses.field(default_factory=CommConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     compile: CompileConfig = dataclasses.field(default_factory=CompileConfig)
+    population: PopulationConfig = dataclasses.field(
+        default_factory=PopulationConfig
+    )
     model: str = "lr"
     seed: int = 0
 

@@ -66,6 +66,35 @@ def bucket_steps(ns: Sequence[int], batch_size: int, pad_bucket: int):
     return steps, bs, steps * bs
 
 
+# size_class as a lookup for steps <= 16 (pow2 rounding): exact integer
+# table instead of float log2, so the vectorized path can never drift
+# from the scalar one by rounding.
+_POW2_LUT = np.array(
+    [size_class(i) for i in range(17)], dtype=np.int64
+)
+
+
+def steps_class_array(counts, batch_size: int, pad_bucket: int) -> np.ndarray:
+    """Vectorized per-client singleton-bucket step counts:
+    ``steps_class_array(counts, bs, pb)[i] ==
+    bucket_steps([counts[i]], bs, pb)[0]`` for every i, as one numpy
+    pass — the O(N)-python-loop-free form :class:`PopulationIndex` and
+    :func:`partition_shape_classes` run at million-client populations.
+    ``batch_size == -1`` (full batch: bs = n, steps constant) is the one
+    mode this cannot express; callers keep the scalar loop there."""
+    if batch_size == -1:
+        raise ValueError("steps_class_array: full-batch mode has no "
+                         "shared bs; use bucket_steps per client")
+    c = np.asarray(counts, np.int64)
+    steps = -(-np.maximum(c, 0) // batch_size)  # ceil(n / bs)
+    steps = -(-steps // pad_bucket) * pad_bucket  # ceil_to pad_bucket
+    small = steps <= 16
+    out = np.where(
+        small, _POW2_LUT[np.minimum(steps, 16)], -(-steps // 8) * 8
+    )
+    return out.astype(np.int64)
+
+
 def partition_shape_classes(counts, batch_size: int, pad_bucket: int):
     """Every (steps, bs) jit-shape class this partition can produce, as
     ``{(steps, bs): first client index in that class}``.
@@ -76,12 +105,23 @@ def partition_shape_classes(counts, batch_size: int, pad_bucket: int):
     singleton buckets. This is the warmup pre-enumeration contract
     (compile/warmup.py): AOT-compiling the round/local-train program for
     each class here means rounds 1..R never hit a lazy shape-bucket
-    compile, no matter which cohorts the scheduler draws."""
-    classes: Dict[tuple, int] = {}
-    for i, n in enumerate(counts):
-        klass = bucket_steps([int(n)], batch_size, pad_bucket)[:2]
-        classes.setdefault(klass, i)
-    return classes
+    compile, no matter which cohorts the scheduler draws.
+
+    Vectorized (one numpy pass + ``np.unique``) for fixed batch sizes so
+    a million-client partition enumerates in milliseconds; full-batch
+    mode (``batch_size == -1``) keeps the scalar loop — there ``bs``
+    varies per client and populations are tiny (the CI oracle)."""
+    if batch_size == -1:
+        classes: Dict[tuple, int] = {}
+        for i, n in enumerate(counts):
+            klass = bucket_steps([int(n)], batch_size, pad_bucket)[:2]
+            classes.setdefault(klass, i)
+        return classes
+    steps = steps_class_array(counts, batch_size, pad_bucket)
+    uniq, first = np.unique(steps, return_index=True)
+    return {
+        (int(s), int(batch_size)): int(i) for s, i in zip(uniq, first)
+    }
 
 
 @dataclasses.dataclass
@@ -140,6 +180,18 @@ class FederatedDataset:
             np.concatenate(self.client_x, axis=0),
             np.concatenate(self.client_y, axis=0),
         )
+
+    def population_index(self):
+        """This partition's metadata as a packed
+        :class:`~fedml_tpu.population.PopulationIndex` — the split of
+        per-client METADATA (counts, weights, jit-shape classes) from
+        the materialized shards that lets selection, warmup
+        pre-enumeration, and the bucket math run without touching shard
+        containers. O(N) to build, once; the mmap store's subclass reads
+        it straight off the offsets vector."""
+        from fedml_tpu.population import PopulationIndex
+
+        return PopulationIndex.from_dataset(self)
 
 
 def pad_clients_to(batch: ClientBatch, target: int) -> ClientBatch:

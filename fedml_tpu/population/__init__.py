@@ -1,0 +1,38 @@
+"""fedml_tpu.population — the million-client population runtime.
+
+Everything per-ROUND that used to scale with the total client count N
+lives here as an O(cohort) structure, with N touched only at build
+time (docs/POPULATION.md):
+
+- :class:`PopulationIndex` — packed per-client partition metadata
+  (sample counts, weights, jit-shape classes), split from the
+  materialized shards; mmap-backed above a size threshold.
+- :class:`AliasSampler` — O(N)-build / O(cohort)-per-round weighted
+  cohort draws (`weighted`, `power_of_choice` candidate pools), plus
+  :func:`draw_uniform_distinct` rejection sampling for exclusion draws.
+- :class:`BoundedLossMap` / :class:`ActiveSet` — the bounded per-client
+  bookkeeping behind the scheduler's power_of_choice bias map and the
+  telemetry health registry's active set + compact spill.
+- ``state_tier.ShardedClientState`` — fixed-stride per-client record
+  store for SCAFFOLD/Ditto state (imported from the submodule directly:
+  it needs jax, and this package root stays numpy/stdlib-only so the
+  scheduler and telemetry can import it before jax initializes).
+
+Activation is config-driven (PopulationConfig, classified KNOWN_BENIGN
+in the digest audit): populations at/above ``ocohort_threshold`` engage
+the O(cohort) paths; below it every legacy draw and structure runs
+unchanged, byte-for-byte.
+"""
+
+from fedml_tpu.population.active import ActiveSet, BoundedLossMap, SpilledRecord
+from fedml_tpu.population.index import PopulationIndex
+from fedml_tpu.population.sampler import AliasSampler, draw_uniform_distinct
+
+__all__ = [
+    "ActiveSet",
+    "AliasSampler",
+    "BoundedLossMap",
+    "PopulationIndex",
+    "SpilledRecord",
+    "draw_uniform_distinct",
+]

@@ -1,0 +1,238 @@
+"""Sharded mmap client-state tier — fixed-stride per-client records for
+SCAFFOLD/Ditto state at million-client populations.
+
+algorithms/state_store.MmapClientState (the 100k-era spill tier) keeps
+one ``[N, *leaf_shape]`` memmap PER PYTREE LEAF: a cohort gather fans
+out into one fancy-index read per leaf — for a model with dozens of
+leaves that is dozens of scattered disk touches per client per round,
+and every leaf file's row for one client lives far from its other
+leaves' rows. This tier extends data/mmap_store.py's layout discipline
+(np.memmap + offsets + meta.json, streaming writes, schema-checked
+reopen) with a RECORD-MAJOR layout instead:
+
+    records_{s}.bin     np.memmap uint8 [rows_in_shard, stride]
+                        — client record = all leaves' bytes,
+                        concatenated at fixed offsets (one contiguous
+                        read/write per client per round)
+    init_mask.npy       np.lib.format bool [N] (lazy-init bitmap,
+                        exactly MmapClientState's)
+    meta.json           {n, shard_bits, stride, leaves, layout}
+
+Shards are ``1 << shard_bits`` clients each (PopulationConfig
+.state_shard_bits, default 65536/shard ⇒ 1M clients = 16 files):
+bounded per-file size for filesystem tooling, and a gather touches only
+the shards its cohort lands in. Files are created sparse (O(1) in data
+written, whatever N is) and rows are lazily initialized through the
+same bitmap contract as MmapClientState — a gather of an untouched row
+returns the algorithm's initial state with no write having happened.
+
+Math contract (the spill tier's): gather/scatter are exact byte copies,
+so a sharded run is BIT-IDENTICAL to the mmap-per-leaf run and to the
+in-HBM run at the same seed — pinned by tests/test_population.py
+against ScaffoldAPI. The API is MmapClientState's exactly (gather/
+scatter/flush/initialized_ids/reset_to/initialized_count), so
+state_store.CohortPrefetcher overlaps the NEXT cohort's record reads
+with the current round's device compute unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import weakref
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class ShardedClientState:
+    """[N] fixed-stride per-client records over sharded np.memmap files.
+
+    ``init_tree`` is ONE client's initial state (no leading N axis); its
+    tree structure, shapes, and dtypes define the record layout."""
+
+    def __init__(
+        self,
+        init_tree,
+        n_clients: int,
+        path: Optional[str] = None,
+        shard_bits: int = 16,
+    ):
+        self.n = int(n_clients)
+        self.shard_bits = int(shard_bits)
+        if not (4 <= self.shard_bits <= 24):
+            raise ValueError(
+                f"state_shard_bits must be in [4, 24], got {shard_bits}"
+            )
+        leaves, self._treedef = jax.tree_util.tree_flatten(init_tree)
+        self._init_leaves = [np.asarray(l) for l in leaves]
+        self._sizes = [l.nbytes for l in self._init_leaves]
+        self._offsets = np.concatenate(
+            [[0], np.cumsum(self._sizes)]
+        ).astype(np.int64)
+        self.stride = int(self._offsets[-1])
+        if self.stride <= 0:
+            raise ValueError("client state record is empty")
+        # one packed initial record — what an untouched row gathers as
+        self._init_record = np.concatenate(
+            [l.reshape(-1).view(np.uint8) for l in self._init_leaves]
+        )
+        path = path or None  # "" (FedConfig.state_dir default) == unset
+        self.path = path or tempfile.mkdtemp(prefix="fedml_tpu_popstate_")
+        if path is None:
+            # scratch temp dirs are cleaned up; user-supplied paths are
+            # THEIRS (resume target) — same stance as MmapClientState
+            self._cleanup = weakref.finalize(
+                self, shutil.rmtree, self.path, ignore_errors=True
+            )
+        else:
+            self._cleanup = None
+        os.makedirs(self.path, exist_ok=True)
+        schema = [
+            {"shape": list(l.shape), "dtype": str(l.dtype)}
+            for l in self._init_leaves
+        ]
+        meta = {
+            "layout": "record-v1",
+            "n": self.n,
+            "shard_bits": self.shard_bits,
+            "stride": self.stride,
+            "leaves": schema,
+        }
+        meta_path = os.path.join(self.path, "meta.json")
+        fresh = not os.path.exists(meta_path)
+        if not fresh:
+            # resume: reopen an existing store — layout must match
+            # exactly (a silent mismatch would interleave rows wrong)
+            with open(meta_path) as f:
+                existing = json.load(f)
+            if existing != meta:
+                raise ValueError(
+                    f"existing sharded state store at {self.path} has "
+                    f"layout {existing}, expected {meta}"
+                )
+        from fedml_tpu.data.mmap_store import advise_random
+
+        shard_rows = 1 << self.shard_bits
+        self._num_shards = -(-self.n // shard_rows) if self.n else 0
+        self._shards = []
+        for s in range(self._num_shards):
+            rows = min(shard_rows, self.n - s * shard_rows)
+            fp = os.path.join(self.path, f"records_{s:05d}.bin")
+            # np.memmap w+ creates the file SPARSE at full logical size
+            shard = np.memmap(
+                fp,
+                dtype=np.uint8,
+                mode="r+" if (not fresh and os.path.exists(fp)) else "w+",
+                shape=(rows, self.stride),
+            )
+            # cohort gathers are RANDOM rows by construction — without
+            # this the kernel readahead turns every row fault into a
+            # whole readahead window of sparse pages (184 ms vs 0.65 ms
+            # per 8-row gather at 1M clients; see data.mmap_store)
+            advise_random(shard)
+            self._shards.append(shard)
+        if fresh:
+            self._init_mask = np.lib.format.open_memmap(
+                os.path.join(self.path, "init_mask.npy"),
+                mode="w+",
+                dtype=np.bool_,
+                shape=(self.n,),
+            )
+            with open(meta_path, "w") as f:
+                json.dump(meta, f)
+        else:
+            self._init_mask = np.load(
+                os.path.join(self.path, "init_mask.npy"), mmap_mode="r+"
+            )
+        advise_random(self._init_mask)
+
+    @property
+    def state_bytes_total(self) -> int:
+        """Logical size of the full store (the number the HBM path would
+        have to pin) — actual disk use is cohort-sparse."""
+        return self.n * self.stride
+
+    # -- record (un)packing --
+    def _split_records(self, buf: np.ndarray, inited: np.ndarray):
+        """(C, stride) uint8 record buffer -> leaf pytree [C, ...];
+        rows with ``inited`` False are overwritten with the init state."""
+        C = buf.shape[0]
+        out = []
+        fill = not inited.all()
+        for off, base in zip(self._offsets[:-1], self._init_leaves):
+            raw = np.ascontiguousarray(buf[:, off:off + base.nbytes])
+            arr = raw.view(base.dtype).reshape((C,) + base.shape)
+            if fill:
+                arr[~inited] = base
+            out.append(arr)
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def _pack_records(self, rows_tree) -> np.ndarray:
+        leaves = jax.tree_util.tree_leaves(rows_tree)
+        C = len(np.asarray(leaves[0]))
+        buf = np.empty((C, self.stride), np.uint8)
+        for off, base, r in zip(
+            self._offsets[:-1], self._init_leaves, leaves
+        ):
+            r = np.ascontiguousarray(np.asarray(r, dtype=base.dtype))
+            buf[:, off:off + base.nbytes] = r.reshape(C, -1).view(np.uint8)
+        return buf
+
+    def _shard_rows(self, idx: np.ndarray):
+        """Group a cohort's ids by shard: yields (shard array slice,
+        local row ids, cohort positions) — one contiguous-file touch per
+        shard the cohort lands in."""
+        shard_of = idx >> self.shard_bits
+        row_of = idx & ((1 << self.shard_bits) - 1)
+        for s in np.unique(shard_of):
+            m = shard_of == s
+            yield self._shards[int(s)], row_of[m], m
+
+    # -- the MmapClientState API --
+    def gather(self, idx: Sequence[int]):
+        """Cohort rows as a HOST pytree [C, ...] (copies — safe to ship
+        to device). Untouched rows come back as the initial state."""
+        idx = np.asarray(idx, np.int64)
+        inited = np.asarray(self._init_mask[idx])
+        buf = np.empty((len(idx), self.stride), np.uint8)
+        for shard, rows, m in self._shard_rows(idx):
+            buf[m] = shard[rows]
+        return self._split_records(buf, inited)
+
+    def scatter(self, idx: Sequence[int], rows_tree) -> None:
+        """Write the cohort's updated records back (host arrays in)."""
+        idx = np.asarray(idx, np.int64)
+        buf = self._pack_records(rows_tree)
+        for shard, rows, m in self._shard_rows(idx):
+            shard[rows] = buf[m]
+        self._init_mask[idx] = True
+
+    def flush(self) -> None:
+        for shard in self._shards:
+            shard.flush()
+        self._init_mask.flush()
+
+    def initialized_ids(self) -> np.ndarray:
+        """Client ids whose rows have ever been scattered — with
+        :meth:`gather` of them, the store's ENTIRE information content
+        (checkpoints embed exactly this; see MmapClientState)."""
+        return np.flatnonzero(np.asarray(self._init_mask))
+
+    def reset_to(self, idx: Sequence[int], rows_tree) -> None:
+        """Roll back to {initial state everywhere except ``idx``, which
+        holds ``rows_tree``} — the restore side of the self-contained
+        checkpoint."""
+        inited = self.initialized_ids()
+        if len(inited):
+            for shard, rows, _ in self._shard_rows(inited):
+                shard[rows] = self._init_record
+            self._init_mask[inited] = False
+        if len(np.asarray(idx)):
+            self.scatter(idx, rows_tree)
+
+    def initialized_count(self) -> int:
+        return int(np.count_nonzero(self._init_mask))

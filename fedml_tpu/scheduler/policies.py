@@ -29,6 +29,19 @@ pure function of (seed, round_idx, policy inputs), never of call order or
 process state — the vmap simulator and the transport federations must
 select byte-identical cohorts from the same config, and a resumed run
 must be able to re-derive its in-flight cohort.
+
+Population scale (fedml_tpu/population/, docs/POPULATION.md): at/above
+``PopulationConfig.ocohort_threshold`` clients the non-uniform policies
+switch to O(cohort) draws — an alias table for ``weighted`` and the
+power_of_choice candidate pool, rejection sampling for
+``straggler_aware``'s exclusion draw — built once per run from the
+:class:`~fedml_tpu.population.PopulationIndex` and never touching all N
+per round. The switch is keyed on population size ONLY (identical in
+the simulator and every transport, so sim/transport cohort parity is
+preserved by construction); below the threshold the legacy exact numpy
+draws run byte-for-byte. ``uniform`` stays the reference-parity
+round-seeded draw at every scale — its O(N) permutation is the parity
+contract itself.
 """
 
 from __future__ import annotations
@@ -57,6 +70,47 @@ class SelectionContext:
     # ClientHealthRegistry-shaped object (straggler_aware); only
     # .straggler_ids() is required
     health: Optional[object] = None
+    # population.PopulationIndex for the O(cohort) draws; built lazily
+    # from sample_counts at/above ocohort_threshold when absent
+    index: Optional[object] = None
+    ocohort_threshold: int = 65536
+
+
+def _population_index(ctx: SelectionContext):
+    """The context's PopulationIndex when the O(cohort) sampling paths
+    should engage — explicit index, or lazily built from the packed
+    counts once the population crosses the threshold. Returns None below
+    the threshold (legacy exact draws) or when no counts exist."""
+    if ctx.index is not None:
+        return ctx.index
+    if (
+        ctx.sample_counts is not None
+        and ctx.num_clients >= ctx.ocohort_threshold
+        and len(ctx.sample_counts) == ctx.num_clients
+    ):
+        from fedml_tpu.population import PopulationIndex
+
+        ctx.index = PopulationIndex(np.asarray(ctx.sample_counts, np.int64))
+        return ctx.index
+    return None
+
+
+def _weighted_cohort(ctx: SelectionContext, rng, n: int, size: int) -> np.ndarray:
+    """THE size-weighted distinct draw both weighted selection and the
+    power_of_choice candidate pool use: the alias table's O(cohort)
+    rejection draw at population scale, the legacy exact numpy draw
+    below it. Distributionally identical (discarding duplicates from a
+    with-replacement categorical stream IS sequential sampling without
+    replacement); only the random stream differs, which is why the
+    switch is population-keyed, never data-keyed."""
+    pop = _population_index(ctx)
+    if (
+        pop is not None
+        and pop.num_clients == n
+        and pop.total_samples() > 0
+    ):
+        return pop.alias_table().draw_distinct(rng, size)
+    return _weighted_draw(rng, n, size, _size_probs(ctx))
 
 
 def _rng(ctx: SelectionContext, round_idx: int, salt: int = 0):
@@ -163,7 +217,7 @@ class WeightedPolicy(SelectionPolicy):
         n = ctx.num_clients
         k = min(k, n)
         rng = _rng(ctx, round_idx, salt=1)
-        return _weighted_draw(rng, n, k, _size_probs(ctx))
+        return _weighted_cohort(ctx, rng, n, k)
 
 
 @register_policy("power_of_choice")
@@ -187,7 +241,7 @@ class PowerOfChoicePolicy(SelectionPolicy):
         k = min(k, n)
         d = min(n, max(k, int(math.ceil(self.candidate_factor * k))))
         rng = _rng(ctx, round_idx, salt=2)
-        candidates = _weighted_draw(rng, n, d, _size_probs(ctx))
+        candidates = _weighted_cohort(ctx, rng, n, d)
         losses = ctx.losses or {}
         loss_of = lambda c: losses.get(int(c), math.inf)
         tiebreak = rng.permutation(d)
@@ -216,9 +270,20 @@ class StragglerAwarePolicy(SelectionPolicy):
         flagged: List[int] = []
         if ctx.health is not None:
             flagged = [c for c in ctx.health.straggler_ids() if c < n]
-        eligible = np.setdiff1d(np.arange(n), np.asarray(flagged, np.int64))
-        take = min(k, len(eligible))
-        sel = rng.choice(eligible, size=take, replace=False) if take else np.empty(0, np.int64)
+        if n >= ctx.ocohort_threshold:
+            # O(cohort) form: rejection-sample the uniform draw instead
+            # of materializing the O(N) eligible set every round (the
+            # flagged set is bounded by the health registry's active set)
+            from fedml_tpu.population import draw_uniform_distinct
+
+            take = min(k, n - len(flagged))
+            sel = draw_uniform_distinct(
+                rng, n, take, exclude=np.asarray(flagged, np.int64)
+            )
+        else:
+            eligible = np.setdiff1d(np.arange(n), np.asarray(flagged, np.int64))
+            take = min(k, len(eligible))
+            sel = rng.choice(eligible, size=take, replace=False) if take else np.empty(0, np.int64)
         if take < k:
             # top up with the least-bad stragglers: slowest last
             by_speed = sorted(
@@ -325,7 +390,13 @@ class ClientScheduler:
         tracer: Optional[object] = None,
         on_select: Optional[Callable[[int, np.ndarray], None]] = None,
         memoize: bool = True,
+        index: Optional[object] = None,
+        ocohort_threshold: int = 65536,
+        loss_map_capacity: int = 65536,
+        selection_memo_rounds: int = 64,
     ):
+        from fedml_tpu.population import BoundedLossMap
+
         self.num_clients = int(num_clients)
         self.k = int(k)
         self.policy_name = policy
@@ -339,9 +410,16 @@ class ClientScheduler:
                 if sample_counts is not None
                 else None
             ),
-            losses={},
+            # bounded: the power_of_choice bias map may never grow O(N)
+            # (it is the "sched" checkpoint slot — an unbounded dict
+            # over ever-seen clients at 1M clients IS the checkpoint);
+            # a missing entry already means "cold client, explore"
+            losses=BoundedLossMap(loss_map_capacity),
             health=health,
+            index=index,
+            ocohort_threshold=int(ocohort_threshold),
         )
+        self._memo_rounds = int(selection_memo_rounds)
         self._tracer = tracer
         self._on_select = on_select
         self._memoize = bool(memoize)
@@ -362,10 +440,39 @@ class ClientScheduler:
         ``scheduler/policy``/``scheduler/selected`` row) — ONE definition
         of both, so the sim/transport/fedbuff runtimes cannot drift."""
         policy = getattr(config.fed, "selection", "uniform")
+        pop_cfg = getattr(config, "population", None)
+        if pop_cfg is not None:
+            kw.setdefault("ocohort_threshold", pop_cfg.ocohort_threshold)
+            kw.setdefault("loss_map_capacity", pop_cfg.loss_map_capacity)
+            kw.setdefault(
+                "selection_memo_rounds", pop_cfg.selection_memo_rounds
+            )
         if "sample_counts" not in kw and data is not None and (
             data.num_clients == num_clients
         ):
-            kw["sample_counts"] = [len(cy) for cy in data.client_y]
+            # vectorized property (np.diff over the mmap store's offsets;
+            # one build-time pass for list-backed datasets) — never the
+            # per-client Python len() loop at 1M clients
+            kw["sample_counts"] = np.asarray(
+                data.train_sample_counts, np.int64
+            )
+            if (
+                "index" not in kw
+                and num_clients >= kw.get("ocohort_threshold", 65536)
+            ):
+                # build the packed population index ONCE here (O(N),
+                # build time) so every runtime sharing this config —
+                # simulator, transports, fedbuff — engages the identical
+                # O(cohort) draws (cohort-parity by construction)
+                from fedml_tpu.population import PopulationIndex
+
+                kw["index"] = PopulationIndex.from_counts(
+                    kw["sample_counts"],
+                    path=(pop_cfg.index_dir or None) if pop_cfg else None,
+                    mmap_threshold_bytes=(
+                        pop_cfg.index_mmap_bytes if pop_cfg else 64 << 20
+                    ),
+                )
         if "on_select" not in kw and log_fn is not None:
             kw["on_select"] = lambda r, sel: log_fn(
                 {
@@ -406,6 +513,17 @@ class ClientScheduler:
         sel = np.asarray(sel, np.int64)
         if self._memoize:
             self._selections[r] = sel
+            # the LIVE memo is bounded too, not just the checkpointed
+            # one: a continuous serve-layer session runs rounds
+            # indefinitely, and an unbounded per-round dict is exactly
+            # the growth class the population runtime removes. Evicted
+            # rounds re-derive as pure functions of (seed, round) — the
+            # same property state_dict's bound already relies on. The
+            # floor keeps the fused chunk planner's lookahead and the
+            # short-run test contracts (full-run selections()) intact.
+            cap = max(self._memo_rounds, 64)
+            while len(self._selections) > cap:
+                del self._selections[next(iter(self._selections))]
         if self._tracer is not None:
             with self._tracer.span(
                 "select",
@@ -440,7 +558,9 @@ class ClientScheduler:
         self._ctx.losses[int(client_id)] = float(loss)
 
     def selections(self) -> Dict[int, List[int]]:
-        """Memoized decisions so far, JSON-ready ({round: [ids]})."""
+        """Memoized decisions so far, JSON-ready ({round: [ids]}) — the
+        most recent ``max(selection_memo_rounds, 64)`` rounds (the live
+        memo is bounded; see :meth:`select`)."""
         return {r: [int(c) for c in sel] for r, sel in sorted(self._selections.items())}
 
     # -- checkpoint support (utils/checkpoint.py "sched" slot) --
@@ -448,8 +568,16 @@ class ClientScheduler:
         """Pytree of numpy arrays (checkpoint-flattenable): the per-round
         selection memo + the loss map. Enough to re-select the in-flight
         round byte-identically after a resume — policies are otherwise
-        pure functions of (seed, round)."""
-        rounds = sorted(self._selections)
+        pure functions of (seed, round).
+
+        BOUNDED by construction (population-scale checkpoint contract,
+        pinned by tests/test_population.py): the loss map is a
+        BoundedLossMap (at most ``loss_map_capacity`` entries, never
+        O(N) at 1M clients), and only the most recent
+        ``selection_memo_rounds`` rounds' selections persist — a resume
+        only ever re-derives its in-flight round, and every policy is a
+        pure function of (seed, round) beyond that."""
+        rounds = sorted(self._selections)[-self._memo_rounds:]
         loss_ids = sorted(self._ctx.losses)
         return {
             "rounds": np.asarray(rounds, np.int64),
@@ -458,11 +586,13 @@ class ClientScheduler:
             ],
             "loss_ids": np.asarray(loss_ids, np.int64),
             "loss_vals": np.asarray(
-                [self._ctx.losses[i] for i in loss_ids], np.float64
+                [self._ctx.losses.get(i) for i in loss_ids], np.float64
             ),
         }
 
     def load_state_dict(self, state: dict) -> None:
+        from fedml_tpu.population import BoundedLossMap
+
         rounds = [int(r) for r in np.asarray(state["rounds"]).ravel()]
         self._selections = {
             r: np.asarray(sel, np.int64)
@@ -470,4 +600,7 @@ class ClientScheduler:
         }
         ids = np.asarray(state["loss_ids"]).ravel()
         vals = np.asarray(state["loss_vals"]).ravel()
-        self._ctx.losses = {int(i): float(v) for i, v in zip(ids, vals)}
+        losses = BoundedLossMap(self._ctx.losses.capacity)
+        for i, v in zip(ids, vals):
+            losses[int(i)] = float(v)
+        self._ctx.losses = losses

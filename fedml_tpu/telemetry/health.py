@@ -24,6 +24,22 @@ homogeneous fleet has no stragglers. This is the hook FedBuff needs for
 staleness-aware scheduling (a straggler's next assignment can be
 discounted up front).
 
+Population bounds (fedml_tpu/population/, docs/POPULATION.md): a
+million-client × serve-tenants deployment cannot carry a dict of
+per-client deques, so full-fidelity records (timing window + dedupe
+memory, ~KBs each) live in an LRU **active set** of at most
+``max_active_clients`` recently-seen clients; eviction folds the exact
+counters (participation, last-seen, fault tallies) into a ~100-byte
+compact spill record that is restored seamlessly if the client
+reappears — totals stay exact, timing windows (definitionally lossy
+sliding stats) restart. The straggler scan is bounded by the active set.
+The full-fidelity fault-event log behind :meth:`export_trace` is
+registry-wide and append-only under a **byte budget**
+(``trace_budget_bytes``): past it, fault TALLIES stay exact but events
+stop recording and every affected client is loudly marked
+``trace_incomplete`` — ``FaultPlan.from_trace`` keeps refusing such
+clients rather than replaying a partial fleet.
+
 Prometheus exposure stays aggregate on purpose (client cardinality can be
 millions): clients-seen gauge, straggler-count gauge, and one train-time
 histogram across all clients."""
@@ -34,6 +50,7 @@ import threading
 from collections import deque
 from typing import Dict, List, Optional
 
+from fedml_tpu.population import ActiveSet, SpilledRecord
 from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
 from fedml_tpu.telemetry.spans import SpanEvent, Tracer
 
@@ -42,12 +59,10 @@ _TRAIN_BUCKETS = (
     30.0, 60.0, 300.0, 1800.0,
 )
 
-
-# Per-client cap on the full-fidelity fault event log backing
-# export_trace(): past it the record keeps counting (the `faults` tallies
-# stay exact) but the trace is marked incomplete — FaultPlan.from_trace
-# refuses truncated clients rather than replay a partial fleet.
-_MAX_TRACE_EVENTS = 65536
+# Estimated footprint of one fault-log event (cid, round, kind, detail)
+# against the registry-wide trace budget — a small tuple of scalars; the
+# estimate errs high so the budget binds before RSS does.
+_EVENT_BYTES = 96
 
 
 class _ClientRecord:
@@ -57,22 +72,20 @@ class _ClientRecord:
         "times",
         "seen_rounds",
         "faults",
-        "fault_events",
-        "trace_complete",
     )
 
-    def __init__(self, window: int):
-        self.last_seen_round = -1
-        self.rounds_participated = 0
+    def __init__(self, window: int, spilled: Optional[SpilledRecord] = None):
+        # a client returning from the compact spill resumes its EXACT
+        # counters; the timing window restarts (sliding stats are lossy
+        # by definition — that is why they spill to nothing)
+        self.last_seen_round = spilled.last_seen_round if spilled else -1
+        self.rounds_participated = (
+            spilled.rounds_participated if spilled else 0
+        )
+        self.faults: Dict[str, int] = dict(spilled.faults) if spilled else {}
         self.times: deque = deque(maxlen=window)
         # bounded dedupe memory: only the most recent window of round ids
         self.seen_rounds: deque = deque(maxlen=window)
-        # injected/observed faults by kind (scheduler/faults.py feeds this
-        # via observe_fault): {"dropout": n, "crash": n, ...}
-        self.faults: Dict[str, int] = {}
-        # full-fidelity event log for trace replay: (round, kind, detail)
-        self.fault_events: List[tuple] = []
-        self.trace_complete = True
 
     def mean(self) -> Optional[float]:
         if not self.times:
@@ -87,6 +100,14 @@ class _ClientRecord:
         return xs[idx]
 
 
+def _spill(rec: _ClientRecord) -> SpilledRecord:
+    return SpilledRecord(
+        last_seen_round=rec.last_seen_round,
+        rounds_participated=rec.rounds_participated,
+        faults=rec.faults,
+    )
+
+
 class ClientHealthRegistry:
     def __init__(
         self,
@@ -95,12 +116,24 @@ class ClientHealthRegistry:
         straggler_margin: float = 1.2,
         registry: Optional[MetricsRegistry] = None,
         span_name: str = "local_train",
+        max_active_clients: int = 65536,
+        trace_budget_bytes: int = 16 << 20,
     ):
         self.window = int(window)
         self.straggler_quantile = float(straggler_quantile)
         self.straggler_margin = float(straggler_margin)
         self.span_name = span_name
-        self._clients: Dict[int, _ClientRecord] = {}
+        self.trace_budget_bytes = int(trace_budget_bytes)
+        self._clients: ActiveSet = ActiveSet(
+            capacity=max_active_clients, spill_fn=_spill
+        )
+        # registry-wide append-only fault-event log (cid, round, kind,
+        # detail) — full fidelity for trace replay, bounded in BYTES
+        # across all clients (the per-client cap it replaces was
+        # unbounded in aggregate at 1M clients × tenants)
+        self._fault_log: List[tuple] = []
+        self._trace_bytes = 0
+        self._trace_dropped: set = set()  # cids with dropped events
         self._lock = threading.Lock()
         self._observations = 0
         self._tracer: Optional[Tracer] = None
@@ -123,6 +156,15 @@ class ClientHealthRegistry:
             labelnames=("kind",),
         )
 
+    def _touch(self, cid: int) -> _ClientRecord:
+        return self._clients.touch(
+            cid, lambda spilled: _ClientRecord(self.window, spilled)
+        )
+
+    def _known_count(self) -> int:
+        # active + spilled are disjoint (touch revives a spilled record)
+        return len(self._clients) + len(self._clients.spilled)
+
     # -- feeding --
     def observe_train(
         self, client_id: int, round_idx: int, wall_s: float
@@ -132,23 +174,21 @@ class ClientHealthRegistry:
         cid = int(client_id)
         r = int(round_idx)
         with self._lock:
-            rec = self._clients.get(cid)
-            if rec is None:
-                rec = self._clients[cid] = _ClientRecord(self.window)
+            rec = self._touch(cid)
             if r in rec.seen_rounds:
                 return False
             rec.seen_rounds.append(r)
             rec.last_seen_round = max(rec.last_seen_round, r)
             rec.rounds_participated += 1
             rec.times.append(float(wall_s))
-            n_clients = len(self._clients)
+            n_clients = self._known_count()
             self._observations += 1
             n_obs = self._observations
         self._g_seen.set(n_clients)
         self._h_train.observe(float(wall_s))
-        # the straggler set costs a sort over all client means — refresh the
-        # gauge on a throttle, not per observation (hot round loops at
-        # production fleet sizes would otherwise pay O(N log N) per client);
+        # the straggler set costs a sort over the ACTIVE clients' means —
+        # refresh the gauge on a throttle, not per observation (hot round
+        # loops would otherwise pay O(active log active) per client);
         # straggler_ids()/snapshot() always recompute fresh
         if n_obs % 32 == 0 or n_clients <= 32:
             self.straggler_ids()
@@ -165,23 +205,30 @@ class ClientHealthRegistry:
         one exists (slowdown seconds) so a replayed trace reproduces it."""
         cid = int(client_id)
         with self._lock:
-            rec = self._clients.get(cid)
-            if rec is None:
-                rec = self._clients[cid] = _ClientRecord(self.window)
+            rec = self._touch(cid)
             rec.faults[kind] = rec.faults.get(kind, 0) + 1
-            if len(rec.fault_events) < _MAX_TRACE_EVENTS:
-                rec.fault_events.append((int(round_idx), kind, float(detail)))
+            if self._trace_bytes + _EVENT_BYTES <= self.trace_budget_bytes:
+                self._fault_log.append(
+                    (cid, int(round_idx), kind, float(detail))
+                )
+                self._trace_bytes += _EVENT_BYTES
             else:
-                rec.trace_complete = False
+                # budget exhausted: tallies stay exact, the trace does
+                # not — mark THIS client incomplete (loudly, in
+                # export_trace and snapshot) so replay refuses it
+                self._trace_dropped.add(cid)
             rec.last_seen_round = max(rec.last_seen_round, int(round_idx))
-            n_clients = len(self._clients)
+            n_clients = self._known_count()
         self._g_seen.set(n_clients)
         self._c_faults.inc(kind=kind)
 
     def faults(self, client_id: int) -> Dict[str, int]:
         with self._lock:
             rec = self._clients.get(int(client_id))
-            return dict(rec.faults) if rec else {}
+            if rec is not None:
+                return dict(rec.faults)
+            spilled = self._clients.spilled.get(int(client_id))
+            return dict(spilled.faults) if spilled else {}
 
     def _on_span(self, ev: SpanEvent) -> None:
         if ev.name != self.span_name:
@@ -209,19 +256,32 @@ class ClientHealthRegistry:
             self._tracer = None
 
     # -- queries (the aggregator-facing API) --
+    @property
+    def trace_incomplete(self) -> bool:
+        """True when the registry-wide trace budget has dropped events —
+        the loud marker that export_trace's fleet is partial."""
+        with self._lock:
+            return bool(self._trace_dropped)
+
     def clients_seen(self) -> List[int]:
         with self._lock:
-            return sorted(self._clients)
+            return sorted(self._clients.known_ids())
 
     def last_seen_round(self, client_id: int) -> int:
         with self._lock:
             rec = self._clients.get(int(client_id))
-            return rec.last_seen_round if rec else -1
+            if rec is not None:
+                return rec.last_seen_round
+            spilled = self._clients.spilled.get(int(client_id))
+            return spilled.last_seen_round if spilled else -1
 
     def rounds_participated(self, client_id: int) -> int:
         with self._lock:
             rec = self._clients.get(int(client_id))
-            return rec.rounds_participated if rec else 0
+            if rec is not None:
+                return rec.rounds_participated
+            spilled = self._clients.spilled.get(int(client_id))
+            return spilled.rounds_participated if spilled else 0
 
     def mean_train_s(self, client_id: int) -> Optional[float]:
         with self._lock:
@@ -238,7 +298,10 @@ class ClientHealthRegistry:
         (>= the straggler_quantile of all means) AND materially slower
         than the fleet (> straggler_margin × the median mean). The margin
         keeps a homogeneous fleet straggler-free: without it, scheduler
-        noise would always flag SOMEONE as "slowest decile"."""
+        noise would always flag SOMEONE as "slowest decile". The scan is
+        bounded by the ACTIVE set — an evicted client has no current
+        timing window, so it cannot be flagged (recently-seen clients
+        are exactly the ones a scheduler could select around)."""
         with self._lock:
             means = {
                 cid: rec.mean()
@@ -271,29 +334,44 @@ class ClientHealthRegistry:
         = last observed round + 1. Only meaningful for ROUND-keyed
         runtimes: a FedBuff server feeds this registry with events keyed
         by dispatch tag, which cannot replay (the CLI skips the export
-        there)."""
+        there). Clients whose events fell past the registry-wide trace
+        budget export ``trace_complete: false`` — replay refuses them."""
         from fedml_tpu.scheduler.faults import FaultTrace
 
         with self._lock:
-            items = [
-                (cid, rec, list(rec.fault_events)) for cid, rec in
-                self._clients.items()
-            ]
-        clients = {}
+            active = {
+                cid: (
+                    rec.last_seen_round,
+                    rec.rounds_participated,
+                    rec.mean(),
+                    rec.percentile(0.9),
+                )
+                for cid, rec in self._clients.items()
+            }
+            spilled = {
+                cid: (sp.last_seen_round, sp.rounds_participated, None, None)
+                for cid, sp in self._clients.spilled.items()
+            }
+            events = list(self._fault_log)
+            dropped = set(self._trace_dropped)
+        stats = {**spilled, **active}
+        per_client: Dict[int, Dict[str, list]] = {}
         horizon = 0
-        for cid, rec, events in items:
-            faults: Dict[str, list] = {}
-            for r, kind, detail in events:
-                faults.setdefault(kind, []).append([int(r), float(detail)])
-                horizon = max(horizon, int(r) + 1)
-            horizon = max(horizon, rec.last_seen_round + 1)
+        for cid, r, kind, detail in events:
+            per_client.setdefault(cid, {}).setdefault(kind, []).append(
+                [int(r), float(detail)]
+            )
+            horizon = max(horizon, int(r) + 1)
+        clients = {}
+        for cid, (last_seen, participated, mean_s, p90_s) in stats.items():
+            horizon = max(horizon, last_seen + 1)
             clients[int(cid)] = {
-                "last_seen_round": rec.last_seen_round,
-                "rounds_participated": rec.rounds_participated,
-                "mean_train_s": rec.mean(),
-                "p90_train_s": rec.percentile(0.9),
-                "faults": faults,
-                "trace_complete": rec.trace_complete,
+                "last_seen_round": last_seen,
+                "rounds_participated": participated,
+                "mean_train_s": mean_s,
+                "p90_train_s": p90_s,
+                "faults": per_client.get(cid, {}),
+                "trace_complete": cid not in dropped,
             }
         return FaultTrace(
             rounds=int(rounds) if rounds is not None else horizon,
@@ -302,11 +380,15 @@ class ClientHealthRegistry:
 
     def snapshot(self) -> dict:
         """JSON-ready view: {client_id: {last_seen_round, rounds_participated,
-        mean_train_s, p50_train_s, p90_train_s, straggler}}."""
+        mean_train_s, p50_train_s, p90_train_s, straggler}}. Spilled
+        (LRU-evicted) clients appear with their exact counters and null
+        timing stats."""
         stragglers = set(self.straggler_ids())
         out = {}
         with self._lock:
             items = list(self._clients.items())
+            spilled = list(self._clients.spilled.items())
+            dropped = set(self._trace_dropped)
         for cid, rec in items:
             out[str(cid)] = {
                 "last_seen_round": rec.last_seen_round,
@@ -317,4 +399,32 @@ class ClientHealthRegistry:
                 "straggler": cid in stragglers,
                 "faults": dict(rec.faults),
             }
+            if cid in dropped:
+                out[str(cid)]["trace_incomplete"] = True
+        for cid, sp in spilled:
+            out[str(cid)] = {
+                "last_seen_round": sp.last_seen_round,
+                "rounds_participated": sp.rounds_participated,
+                "mean_train_s": None,
+                "p50_train_s": None,
+                "p90_train_s": None,
+                "straggler": False,
+                "faults": dict(sp.faults),
+            }
+            if cid in dropped:
+                out[str(cid)]["trace_incomplete"] = True
         return out
+
+    @classmethod
+    def from_config(cls, config, **kw) -> "ClientHealthRegistry":
+        """Build with the run's population bounds
+        (PopulationConfig.health_active_clients /
+        .health_trace_budget_bytes) — ONE definition, shared by every
+        runtime that owns a registry (vmap simulator, sync transports,
+        fedbuff), so the serve layer's per-tenant registries are all
+        bounded the same way."""
+        pop = getattr(config, "population", None)
+        if pop is not None:
+            kw.setdefault("max_active_clients", pop.health_active_clients)
+            kw.setdefault("trace_budget_bytes", pop.health_trace_budget_bytes)
+        return cls(**kw)

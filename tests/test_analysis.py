@@ -37,7 +37,7 @@ def _rules_of(report):
 def test_rule_catalog_complete():
     assert set(RULES) == {
         "uncached-jit", "baked-constant", "host-sync", "nondet-in-trace",
-        "repr-in-digest",
+        "repr-in-digest", "o-n-per-round",
     }
 
 
@@ -329,6 +329,54 @@ def test_repr_elsewhere_silent(tmp_path):
 
 
 # -- suppressions + baseline ------------------------------------------------
+
+
+def test_o_n_per_round_fires_on_population_loop(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        def train_round(self, round_idx):
+            for cid in range(self.config.fed.client_num_in_total):
+                self.report(cid)
+            sums = [w[c] for c in range(config.fed.client_num_in_total)]
+            return sums
+        """,
+    )
+    assert _rules_of(report) == ["o-n-per-round", "o-n-per-round"]
+
+
+def test_o_n_per_round_silent_on_build_time_and_cohort_loops(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        def __init__(self, config):
+            # build-time O(N) pass: allowed
+            self.counts = [c for c in range(config.fed.client_num_in_total)]
+
+        def make_round(config):
+            n_total = config.fed.client_num_in_total
+            for i in range(n_total):  # build-time factory: allowed
+                pass
+
+        def train_round(self, sampled):
+            for cid in sampled:  # cohort loop: allowed
+                self.report(cid)
+        """,
+    )
+    assert _rules_of(report) == []
+
+
+def test_o_n_per_round_out_of_scope_dirs_silent(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        def export(self):
+            for cid in range(self.config.fed.client_num_in_total):
+                yield cid
+        """,
+        rel="fedml_tpu/telemetry/snippet.py",
+    )
+    assert _rules_of(report) == []
 
 
 def test_justified_suppression_silences_finding(tmp_path):

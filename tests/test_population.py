@@ -1,0 +1,577 @@
+"""fedml_tpu/population/ — the million-client population runtime
+(ISSUE 11 / ROADMAP item 1).
+
+Pins the subsystem's contracts:
+- alias sampler statistical correctness (chi-square against the weight
+  vector) and determinism (same seed ⇒ byte-identical cohorts across
+  processes; legacy-identical below the threshold);
+- PopulationIndex shape classes == the scalar partition_shape_classes,
+  save/load/mmap roundtrip;
+- ShardedClientState bit-parity with MmapClientState, and a SCAFFOLD
+  run bit-identical across the mmap and sharded spill tiers;
+- bounded scheduler checkpoint (the O(N)-loss-map regression);
+- bounded health registry: LRU active set preserves exact counters
+  through eviction, registry-wide trace byte budget marks clients
+  trace_incomplete and replay refuses them;
+- sim/transport cohort parity with the O(cohort) paths forced on.
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from fedml_tpu.population import (
+    AliasSampler,
+    BoundedLossMap,
+    PopulationIndex,
+    draw_uniform_distinct,
+)
+
+jax = pytest.importorskip("jax")
+
+
+# ---------------------------------------------------------------------------
+# alias sampler — statistical correctness
+# ---------------------------------------------------------------------------
+
+
+def test_alias_sampler_chi_square_matches_weights():
+    """With-replacement alias draws must follow the weight vector: a
+    chi-square statistic over 200k draws stays within a 6-sigma normal
+    approximation of its df — deterministic seed, no scipy."""
+    rng = np.random.default_rng(7)
+    w = rng.random(256) ** 2 + 1e-3
+    t = AliasSampler(w)
+    m = 200_000
+    draws = t.sample(np.random.default_rng(1234), m)
+    obs = np.bincount(draws, minlength=256).astype(np.float64)
+    exp = t.p * m
+    chi2 = float(np.sum((obs - exp) ** 2 / exp))
+    df = 255
+    assert chi2 < df + 6 * np.sqrt(2 * df), chi2
+    # and not suspiciously UNIFORM either: against equal weights the
+    # same statistic must blow up (the draws really are biased)
+    exp_uniform = np.full(256, m / 256)
+    chi2_uniform = float(np.sum((obs - exp_uniform) ** 2 / exp_uniform))
+    assert chi2_uniform > 10 * df, chi2_uniform
+
+
+def test_alias_distinct_draw_matches_legacy_distribution():
+    """draw_distinct (rejection + dedupe) is distributionally identical
+    to the legacy exact without-replacement draw: per-client inclusion
+    frequencies over many rounds agree within sampling noise."""
+    rng = np.random.default_rng(3)
+    w = rng.random(40) + 0.05
+    t = AliasSampler(w)
+    n_rounds, k = 4000, 6
+    inc_alias = np.zeros(40)
+    inc_legacy = np.zeros(40)
+    p = w / w.sum()
+    for r in range(n_rounds):
+        inc_alias[t.draw_distinct(np.random.default_rng([5, r]), k)] += 1
+        inc_legacy[
+            np.random.default_rng([6, r]).choice(40, k, replace=False, p=p)
+        ] += 1
+    diff = np.abs(inc_alias - inc_legacy) / n_rounds
+    assert diff.max() < 0.04, diff.max()
+
+
+def test_alias_distinct_draw_properties():
+    t = AliasSampler(np.arange(1, 101, dtype=np.float64))
+    d = t.draw_distinct(np.random.default_rng(0), 17)
+    assert len(d) == 17 and len(set(d.tolist())) == 17
+    # zero-weight tolerance: request beyond the weighted support fills
+    # uniformly from the zero-weight ids (the Dirichlet-shard contract)
+    w = np.zeros(50)
+    w[:8] = 1.0
+    d = AliasSampler(w).draw_distinct(np.random.default_rng(1), 20)
+    assert len(set(d.tolist())) == 20
+    assert set(range(8)) <= set(d.tolist())
+
+
+def test_draw_uniform_distinct_excludes_and_bounds():
+    ex = np.asarray([1, 2, 3], np.int64)
+    d = draw_uniform_distinct(np.random.default_rng(0), 1_000_000, 12, exclude=ex)
+    assert len(set(d.tolist())) == 12
+    assert not (set(d.tolist()) & {1, 2, 3})
+    # dense fallback when the request is a large population fraction:
+    # the draw clamps to the eligible set and still excludes
+    d = draw_uniform_distinct(np.random.default_rng(0), 10, 9, exclude=ex)
+    assert len(d) == 7 and sorted(d.tolist()) == [0, 4, 5, 6, 7, 8, 9]
+
+
+def test_alias_draws_byte_identical_across_processes():
+    """Same (weights, seed) ⇒ byte-identical cohort in a fresh process —
+    the scheduler's cross-process determinism contract."""
+    code = (
+        "import numpy as np\n"
+        "from fedml_tpu.population import AliasSampler\n"
+        "t = AliasSampler(np.arange(1, 1001, dtype=np.float64))\n"
+        "d = t.draw_distinct(np.random.default_rng([9, 42]), 16)\n"
+        "print(','.join(map(str, d.tolist())))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, check=True,
+        env={**__import__('os').environ, "JAX_PLATFORMS": "cpu"},
+    ).stdout.strip()
+    t = AliasSampler(np.arange(1, 1001, dtype=np.float64))
+    here = t.draw_distinct(np.random.default_rng([9, 42]), 16)
+    assert out == ",".join(map(str, here.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# threshold semantics — legacy below, O(cohort) above
+# ---------------------------------------------------------------------------
+
+
+def _ctx(n, counts=None, threshold=65536):
+    from fedml_tpu.scheduler.policies import SelectionContext
+
+    return SelectionContext(
+        seed=5,
+        num_clients=n,
+        sample_counts=(
+            np.asarray(counts, np.int64) if counts is not None else None
+        ),
+        ocohort_threshold=threshold,
+    )
+
+
+def test_weighted_policy_legacy_below_threshold():
+    """Below the population threshold the weighted draw is the legacy
+    exact numpy draw, byte-for-byte — historical cohorts never change."""
+    from fedml_tpu.scheduler.policies import (
+        WeightedPolicy, _rng, _size_probs, _weighted_draw,
+    )
+
+    counts = np.arange(1, 33)
+    ctx = _ctx(32, counts)
+    sel = WeightedPolicy().select(4, 6, ctx)
+    rng = _rng(_ctx(32, counts), 4, salt=1)
+    legacy = _weighted_draw(rng, 32, 6, _size_probs(_ctx(32, counts)))
+    np.testing.assert_array_equal(sel, legacy)
+    assert ctx.index is None  # the O(cohort) machinery never engaged
+
+
+def test_weighted_policy_alias_at_threshold():
+    from fedml_tpu.scheduler.policies import WeightedPolicy
+
+    counts = np.arange(1, 33)
+    ctx = _ctx(32, counts, threshold=16)
+    sel = WeightedPolicy().select(4, 6, ctx)
+    assert ctx.index is not None  # engaged and cached on the context
+    assert len(set(sel.tolist())) == 6 and sel.max() < 32
+    # round-keyed determinism through the same context
+    np.testing.assert_array_equal(sel, WeightedPolicy().select(4, 6, ctx))
+
+
+def test_power_of_choice_alias_candidates_respect_losses():
+    from fedml_tpu.scheduler.policies import PowerOfChoicePolicy
+
+    counts = np.full(64, 10)
+    ctx = _ctx(64, counts, threshold=16)
+    ctx.losses = {i: (10.0 if i % 2 else 0.1) for i in range(64)}
+    sel = PowerOfChoicePolicy(candidate_factor=4.0).select(1, 8, ctx)
+    # high-loss (odd) clients dominate the kept top-k
+    assert sum(int(c) % 2 for c in sel) >= 6, sel
+
+
+# ---------------------------------------------------------------------------
+# PopulationIndex
+# ---------------------------------------------------------------------------
+
+
+def test_population_index_shape_classes_match_scalar():
+    from fedml_tpu.data.base import bucket_steps, partition_shape_classes
+
+    rng = np.random.default_rng(0)
+    counts = rng.integers(0, 900, 3000)
+    for bs, pb in ((16, 1), (8, 4), (32, 8)):
+        legacy = {}
+        for i, n in enumerate(counts):
+            legacy.setdefault(bucket_steps([int(n)], bs, pb)[:2], i)
+        assert partition_shape_classes(counts, bs, pb) == legacy
+        assert PopulationIndex(counts).shape_classes(bs, pb) == legacy
+    # full-batch mode keeps the scalar loop and still agrees
+    legacy = {}
+    for i, n in enumerate(counts[:64]):
+        legacy.setdefault(bucket_steps([int(n)], -1, 1)[:2], i)
+    assert PopulationIndex(counts[:64]).shape_classes(-1, 1) == legacy
+
+
+def test_population_index_save_load_and_mmap_backing(tmp_path):
+    counts = np.random.default_rng(1).integers(1, 100, 10_000)
+    idx = PopulationIndex.from_counts(
+        counts, path=str(tmp_path / "idx"), mmap_threshold_bytes=1024
+    )
+    # above the threshold the packed counts reopen mmap-backed, from a
+    # content-digest-keyed subdirectory of the (shareable) parent dir
+    assert isinstance(idx.counts, np.memmap)
+    np.testing.assert_array_equal(np.asarray(idx.counts), counts)
+    subs = [p for p in (tmp_path / "idx").iterdir() if p.is_dir()]
+    assert len(subs) == 1 and subs[0].name.startswith("pop_10000_")
+    re = PopulationIndex.load(str(subs[0]))
+    np.testing.assert_array_equal(np.asarray(re.counts), counts)
+    assert re.total_samples() == int(counts.sum())
+    np.testing.assert_array_equal(
+        re.cohort_counts([5, 17, 99]), counts[[5, 17, 99]]
+    )
+    # a second session with the SAME dataset reuses the one copy; a
+    # DIFFERENT dataset gets its own subdir (no cross-session clobber)
+    PopulationIndex.from_counts(
+        counts, path=str(tmp_path / "idx"), mmap_threshold_bytes=1024
+    )
+    other = np.random.default_rng(2).integers(1, 100, 10_000)
+    o = PopulationIndex.from_counts(
+        other, path=str(tmp_path / "idx"), mmap_threshold_bytes=1024
+    )
+    np.testing.assert_array_equal(np.asarray(o.counts), other)
+    np.testing.assert_array_equal(np.asarray(idx.counts), counts)  # intact
+    assert len([p for p in (tmp_path / "idx").iterdir() if p.is_dir()]) == 2
+    # below the threshold: plain in-RAM array, nothing persisted
+    small = PopulationIndex.from_counts(counts[:4], path=None)
+    assert not isinstance(small.counts, np.memmap)
+
+
+def test_live_selection_memo_is_bounded():
+    from fedml_tpu.scheduler import ClientScheduler
+
+    sched = ClientScheduler(
+        num_clients=100, k=4, policy="weighted", seed=0,
+        sample_counts=np.full(100, 10), selection_memo_rounds=16,
+    )
+    for r in range(300):
+        sched.select(r)
+    assert len(sched._selections) == 64  # max(memo_rounds, 64) floor
+    assert min(sched._selections) == 236  # most recent rounds kept
+    # evicted rounds re-derive identically (pure in (seed, round))
+    fresh = ClientScheduler(
+        num_clients=100, k=4, policy="weighted", seed=0,
+        sample_counts=np.full(100, 10),
+    )
+    np.testing.assert_array_equal(sched.select(5), fresh.select(5))
+
+
+def test_dataset_population_index_accessors():
+    from fedml_tpu.data.base import FederatedDataset
+
+    data = FederatedDataset(
+        name="t",
+        client_x=[np.zeros((i + 1, 2), np.float32) for i in range(5)],
+        client_y=[np.zeros((i + 1,), np.int32) for i in range(5)],
+        test_x=np.zeros((2, 2), np.float32),
+        test_y=np.zeros((2,), np.int32),
+        num_classes=2,
+    )
+    idx = data.population_index()
+    np.testing.assert_array_equal(idx.counts, [1, 2, 3, 4, 5])
+
+
+# ---------------------------------------------------------------------------
+# sharded state tier
+# ---------------------------------------------------------------------------
+
+
+def _tree():
+    return {
+        "a": np.zeros((3, 4), np.float32),
+        "b": {"c": np.arange(5, dtype=np.int32)},
+    }
+
+
+def test_sharded_state_bit_parity_with_mmap_store():
+    from fedml_tpu.algorithms.state_store import MmapClientState
+    from fedml_tpu.population.state_tier import ShardedClientState
+
+    n = 500
+    s1 = ShardedClientState(_tree(), n, shard_bits=6)
+    s2 = MmapClientState(_tree(), n)
+    rng = np.random.default_rng(0)
+    for _ in range(15):
+        ids = rng.choice(n, 9, replace=False)
+        rows = {
+            "a": rng.normal(size=(9, 3, 4)).astype(np.float32),
+            "b": {"c": rng.integers(0, 9, (9, 5)).astype(np.int32)},
+        }
+        s1.scatter(ids, rows)
+        s2.scatter(ids, rows)
+        probe = rng.choice(n, 16, replace=False)
+        g1, g2 = s1.gather(probe), s2.gather(probe)
+        np.testing.assert_array_equal(g1["a"], g2["a"])
+        np.testing.assert_array_equal(g1["b"]["c"], g2["b"]["c"])
+    np.testing.assert_array_equal(s1.initialized_ids(), s2.initialized_ids())
+    assert s1.initialized_count() == s2.initialized_count()
+    # reset_to: both roll back to {init except kept rows}
+    keep = s1.initialized_ids()[:3]
+    kept_rows = s1.gather(keep)
+    s1.reset_to(keep, kept_rows)
+    s2.reset_to(keep, kept_rows)
+    g1, g2 = s1.gather(np.arange(n)), s2.gather(np.arange(n))
+    np.testing.assert_array_equal(g1["a"], g2["a"])
+
+
+def test_sharded_state_lazy_init_and_reopen(tmp_path):
+    from fedml_tpu.population.state_tier import ShardedClientState
+
+    path = str(tmp_path / "store")
+    s = ShardedClientState(_tree(), 100, path=path, shard_bits=5)
+    g = s.gather([42])
+    np.testing.assert_array_equal(g["b"]["c"][0], np.arange(5))  # init row
+    assert s.initialized_count() == 0
+    s.scatter([42], {
+        "a": np.ones((1, 3, 4), np.float32),
+        "b": {"c": np.full((1, 5), 7, np.int32)},
+    })
+    s.flush()
+    # reopen: same layout resumes; rows survive
+    s2 = ShardedClientState(_tree(), 100, path=path, shard_bits=5)
+    np.testing.assert_array_equal(s2.gather([42])["b"]["c"][0], np.full(5, 7))
+    assert s2.initialized_count() == 1
+    # layout mismatch refuses loudly
+    with pytest.raises(ValueError):
+        ShardedClientState(_tree(), 101, path=path, shard_bits=5)
+    with pytest.raises(ValueError):
+        ShardedClientState(_tree(), 100, path=path, shard_bits=6)
+
+
+def _scaffold_cfg(n, store, state_dir):
+    from fedml_tpu.config import (
+        DataConfig, FedConfig, RunConfig, TrainConfig,
+    )
+
+    return RunConfig(
+        data=DataConfig(batch_size=8, device_cache=False),
+        fed=FedConfig(
+            client_num_in_total=n, client_num_per_round=4, comm_round=3,
+            epochs=1, frequency_of_the_test=100,
+            state_store=store, state_dir=state_dir,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+
+
+def test_scaffold_sharded_tier_bit_identical_to_mmap():
+    """The money contract: a SCAFFOLD run on the sharded record-major
+    tier is BIT-IDENTICAL to the mmap-per-leaf run at the same seed
+    (test_state_spill pins mmap == device, so all three agree)."""
+    from fedml_tpu.algorithms.scaffold import ScaffoldAPI
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    data = synthetic_classification(
+        num_clients=12, num_classes=3, feat_shape=(6,),
+        samples_per_client=24, partition_method="homo", seed=0,
+    )
+    outs = {}
+    for store in ("mmap", "sharded"):
+        model = create_model("lr", "synthetic", (6,), 3)
+        api = ScaffoldAPI(
+            _scaffold_cfg(12, store, tempfile.mkdtemp()), data, model
+        )
+        assert api._state_mode == store
+        for r in range(3):
+            api.train_round(r)
+        outs[store] = (
+            jax.device_get(api.global_vars),
+            jax.device_get(api.c_server),
+            api._c_store.gather(api._c_store.initialized_ids()),
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs["mmap"]),
+        jax.tree_util.tree_leaves(outs["sharded"]),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_resolve_state_store_sharded_auto():
+    from fedml_tpu.algorithms.state_store import resolve_state_store
+    from fedml_tpu.config import FedConfig, PopulationConfig
+
+    fed = FedConfig(state_store="auto", state_budget_bytes=1000)
+    pop = PopulationConfig(ocohort_threshold=1000)
+    assert resolve_state_store(fed, 999, n_clients=5000, population=pop) == "device"
+    assert resolve_state_store(fed, 1001, n_clients=5000, population=pop) == "sharded"
+    assert resolve_state_store(fed, 1001, n_clients=10, population=pop) == "mmap"
+    assert resolve_state_store(FedConfig(state_store="sharded"), 1) == "sharded"
+    with pytest.raises(ValueError):
+        resolve_state_store(FedConfig(state_store="hbm"), 1)
+
+
+# ---------------------------------------------------------------------------
+# bounded scheduler checkpoint (the O(N) loss-map regression)
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_loss_map_eviction_order():
+    m = BoundedLossMap(3)
+    for i in range(5):
+        m[i] = float(i)
+    assert sorted(m.keys()) == [2, 3, 4]
+    m[2] = 9.0  # refresh
+    m[5] = 5.0  # evicts 3 (stalest), not 2
+    assert sorted(m.keys()) == [2, 4, 5]
+    assert m.get(3) is None and m.get(2) == 9.0
+
+
+def test_scheduler_checkpoint_stays_bounded():
+    """Feed far more client losses and rounds than the bounds: the
+    persisted `sched` slot must stay at the configured capacity — the
+    O(N)-checkpoint-growth regression test (ISSUE 11 satellite)."""
+    from fedml_tpu.scheduler import ClientScheduler
+
+    sched = ClientScheduler(
+        num_clients=200_000, k=4, policy="power_of_choice", seed=0,
+        sample_counts=np.full(200_000, 10),
+        loss_map_capacity=512, selection_memo_rounds=16,
+    )
+    for cid in range(0, 200_000, 2):  # 100k reported losses
+        sched.report_loss(cid, float(cid % 17))
+    for r in range(64):
+        sched.select(r)
+    state = sched.state_dict()
+    assert len(state["loss_ids"]) == 512
+    assert len(state["rounds"]) == 16
+    assert int(state["rounds"][0]) == 48  # the most RECENT rounds persist
+    total_bytes = sum(
+        np.asarray(v).nbytes
+        for v in [state["rounds"], state["loss_ids"], state["loss_vals"]]
+    ) + sum(np.asarray(s).nbytes for s in state["selections"])
+    assert total_bytes < 64 * 1024, total_bytes
+    # roundtrip preserves the bound and the entries
+    fresh = ClientScheduler(
+        num_clients=200_000, k=4, policy="power_of_choice", seed=0,
+        sample_counts=np.full(200_000, 10), loss_map_capacity=512,
+    )
+    fresh.load_state_dict(state)
+    assert len(fresh._ctx.losses) == 512
+    np.testing.assert_array_equal(fresh.select(60), sched.select(60))
+
+
+# ---------------------------------------------------------------------------
+# bounded health registry
+# ---------------------------------------------------------------------------
+
+
+def _registry(**kw):
+    from fedml_tpu.telemetry.health import ClientHealthRegistry
+    from fedml_tpu.telemetry.metrics import MetricsRegistry
+
+    return ClientHealthRegistry(registry=MetricsRegistry(), **kw)
+
+
+def test_health_active_set_eviction_preserves_exact_counters():
+    reg = _registry(max_active_clients=4)
+    for r in range(3):
+        for cid in range(10):
+            reg.observe_train(cid, r, 0.1)
+    # all 10 participated 3 rounds — exact through eviction + revival
+    assert reg.clients_seen() == list(range(10))
+    for cid in range(10):
+        assert reg.rounds_participated(cid) == 3, cid
+        assert reg.last_seen_round(cid) == 2
+    # only the active set carries timing windows
+    with_means = [c for c in range(10) if reg.mean_train_s(c) is not None]
+    assert len(with_means) == 4
+    snap = reg.snapshot()
+    assert len(snap) == 10
+    assert all(rec["rounds_participated"] == 3 for rec in snap.values())
+
+
+def test_health_fault_tallies_exact_through_eviction():
+    reg = _registry(max_active_clients=2)
+    for cid in range(6):
+        reg.observe_fault(cid, 0, "dropout")
+        reg.observe_fault(cid, 1, "dropout")
+    for cid in range(6):
+        assert reg.faults(cid) == {"dropout": 2}, cid
+    trace = reg.export_trace()
+    assert all(
+        rec["faults"]["dropout"] == [[0, 0.0], [1, 0.0]]
+        for rec in trace.clients.values()
+    )
+
+
+def test_health_trace_budget_marks_incomplete_and_replay_refuses():
+    from fedml_tpu.scheduler.faults import FaultPlan
+
+    reg = _registry(trace_budget_bytes=96 * 5)  # room for 5 events
+    for i in range(8):
+        reg.observe_fault(100 + i, i, "dropout")
+    assert reg.trace_incomplete
+    trace = reg.export_trace()
+    complete = [c for c, r in trace.clients.items() if r["trace_complete"]]
+    dropped = [c for c, r in trace.clients.items() if not r["trace_complete"]]
+    assert len(complete) == 5 and len(dropped) == 3
+    # tallies stay exact even for dropped clients
+    assert all(reg.faults(c) == {"dropout": 1} for c in dropped)
+    # refusal semantics: a truncated fleet must not replay silently
+    with pytest.raises(ValueError, match="cannot replay"):
+        FaultPlan.from_trace(trace)
+    # an unexhausted registry replays fine
+    ok = _registry()
+    ok.observe_fault(1, 0, "dropout")
+    assert not ok.trace_incomplete
+    FaultPlan.from_trace(ok.export_trace())
+
+
+def test_health_from_config_applies_population_bounds():
+    from fedml_tpu.config import PopulationConfig, RunConfig
+    from fedml_tpu.telemetry.health import ClientHealthRegistry
+    from fedml_tpu.telemetry.metrics import MetricsRegistry
+
+    cfg = RunConfig(
+        population=PopulationConfig(
+            health_active_clients=7, health_trace_budget_bytes=123,
+        )
+    )
+    reg = ClientHealthRegistry.from_config(cfg, registry=MetricsRegistry())
+    assert reg._clients.capacity == 7
+    assert reg.trace_budget_bytes == 123
+
+
+# ---------------------------------------------------------------------------
+# parity with the O(cohort) paths forced on
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["weighted", "power_of_choice"])
+def test_sim_transport_parity_with_ocohort_engaged(policy):
+    """The existing parity contract, re-pinned with the population
+    threshold forced below N so every draw goes through the alias
+    machinery: simulator and loopback transport still select
+    byte-identical cohorts from one config."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+    from fedml_tpu.config import (
+        DataConfig, FedConfig, PopulationConfig, RunConfig, TrainConfig,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+
+    data = synthetic_classification(
+        num_clients=16, num_classes=3, feat_shape=(6,),
+        samples_per_client=24, partition_method="hetero", seed=0,
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8, device_cache=False),
+        fed=FedConfig(
+            client_num_in_total=16, client_num_per_round=4, comm_round=3,
+            selection=policy, frequency_of_the_test=10,
+        ),
+        train=TrainConfig(lr=0.1),
+        population=PopulationConfig(ocohort_threshold=8),
+        seed=2,
+    )
+    model = create_model("lr", "synthetic", (6,), 3)
+    api = FedAvgAPI(cfg, data, model)
+    assert api.scheduler._ctx.index is not None
+    api.train()
+    server = run_loopback_federation(cfg, data, model)
+    assert api.scheduler.selections() == server.scheduler.selections()
