@@ -1358,11 +1358,14 @@ class _Emitter:
         "scale_1m", "fedbuff_async", "process_cold_start",
     )
 
-    def __init__(self, t0: float, detail_path: str):
+    def __init__(self, t0: float, detail_path: str,
+                 compare_path: str = None, regress_tol_pct: float = 10.0):
         import threading
 
         self.t0 = t0
         self.detail_path = detail_path
+        self.compare_path = compare_path
+        self.regress_tol_pct = float(regress_tol_pct)
         self.lock = threading.Lock()
         self.finalized = False
         self._exit_code = 0
@@ -1411,8 +1414,24 @@ class _Emitter:
             self._assemble_headline()
             dev = _expected_deviations(self.record)
             self.record["expected_deviations"] = dev
+            compare_failed = False
+            regressions = []
+            if self.compare_path:
+                cmp_rec = _compare_against(
+                    self.record, self.compare_path, self.regress_tol_pct
+                )
+                self.record["compare"] = cmp_rec
+                regressions = cmp_rec.get("regressions", [])
+                # an unreadable baseline must NOT read as "no regressions"
+                # — a typo'd --compare path would turn the gate green
+                # forever; fail loudly AFTER emitting the record
+                compare_failed = bool(cmp_rec.get("error"))
             self._emit(partial=partial)
-            self._exit_code = 3 if dev else 0
+            # pin deviations (3) outrank throughput regressions (4):
+            # a stale claim must be fixed before the delta means anything
+            self._exit_code = (
+                3 if dev else (4 if (regressions or compare_failed) else 0)
+            )
             return self._exit_code
 
     # -- internals (call under lock) --
@@ -1526,6 +1545,16 @@ def _compact_record(rec: dict, elapsed_s: float, partial: bool) -> dict:
         out["error"] = rec["error"]
     if "error_backend" in rec:
         out["error_backend"] = rec["error_backend"][:300]
+    if "compare" in rec:
+        cmp_rec = rec["compare"]
+        out["compare"] = {
+            "baseline": cmp_rec.get("baseline_file"),
+            "regressions": len(cmp_rec.get("regressions", ())),
+        }
+        if cmp_rec.get("missing_sections"):
+            out["compare"]["missing"] = len(cmp_rec["missing_sections"])
+        if "error" in cmp_rec:
+            out["compare"]["error"] = cmp_rec["error"][:120]
     if "finalize_note" in rec:
         out["finalize_note"] = rec["finalize_note"]
     # hard ceiling: the driver parses the last line out of a ~2000-char
@@ -1538,6 +1567,89 @@ def _compact_record(rec: dict, elapsed_s: float, partial: bool) -> dict:
             ),
             "total": len(_Emitter._SECTION_SLOTS),
         }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bench-to-bench regression oracle (`--compare BENCH_prev.json`)
+#
+# The bench trajectory used to be judged by hand-reading JSON files across
+# rounds. `--compare` makes it mechanical: every section that reports
+# rounds_per_sec in BOTH records gets a delta row (±% vs the named
+# baseline) in the new record's `compare` block, and any section slower
+# than `--regress_tol` percent exits 4 — distinct from the pin-deviation
+# exit 3, so CI can tell "a claim went stale" from "the code got slower".
+# ---------------------------------------------------------------------------
+
+
+def _section_rps(v) -> "float | None":
+    if isinstance(v, dict) and isinstance(
+        v.get("rounds_per_sec"), (int, float)
+    ):
+        return float(v["rounds_per_sec"])
+    return None
+
+
+def compare_records(record: dict, baseline: dict, tol_pct: float) -> dict:
+    """Pure delta table between two bench records (tested directly —
+    tests/test_bench_compare.py). ``regressions`` lists every comparable
+    section whose r/s fell more than ``tol_pct`` percent."""
+    sections = {}
+    regressions = []
+
+    def row(name, nv, ov):
+        r = {"rounds_per_sec": nv, "baseline_rounds_per_sec": ov}
+        if nv is not None and ov:
+            r["delta_pct"] = round((nv - ov) / ov * 100.0, 1)
+            if r["delta_pct"] < -float(tol_pct):
+                r["regressed"] = True
+                regressions.append(
+                    f"{name}: {nv} r/s vs baseline {ov} "
+                    f"({r['delta_pct']:+.1f}% < -{tol_pct}% tol)"
+                )
+        sections[name] = r
+
+    missing = []
+    for k in _Emitter._SECTION_SLOTS:
+        nv, ov = _section_rps(record.get(k)), _section_rps(baseline.get(k))
+        if nv is None and ov is None:
+            continue
+        if nv is None and ov:
+            # the baseline measured this section but the new run did not
+            # (crashed/skipped/budget-truncated): NOT counted as a
+            # regression — partial passes are routine under the bench
+            # budget and the skip row self-describes why — but listed
+            # LOUDLY so a silently-vanished section can't read as green
+            missing.append(k)
+        row(k, nv, ov)
+    hv, hb = record.get("value"), baseline.get("value")
+    if isinstance(hv, (int, float)) or isinstance(hb, (int, float)):
+        row(
+            "headline",
+            float(hv) if isinstance(hv, (int, float)) else None,
+            float(hb) if isinstance(hb, (int, float)) else None,
+        )
+    return {
+        "regress_tol_pct": float(tol_pct),
+        "sections": sections,
+        "missing_sections": missing,
+        "regressions": regressions,
+    }
+
+
+def _compare_against(record: dict, path: str, tol_pct: float) -> dict:
+    try:
+        with open(path) as f:
+            baseline = json.load(f)
+    except Exception as e:  # noqa: BLE001 — a bad baseline must not kill
+        # the record that took the whole budget to produce
+        return {
+            "baseline_file": os.path.basename(str(path)),
+            "error": f"baseline unreadable: {type(e).__name__}: {e}",
+            "regressions": [],
+        }
+    out = compare_records(record, baseline, tol_pct)
+    out["baseline_file"] = os.path.basename(str(path))
     return out
 
 
@@ -1581,9 +1693,32 @@ def _expected_deviations(rec: dict) -> list:
 
 
 def main():
+    import argparse
     import signal
     import sys
     import threading
+
+    ap = argparse.ArgumentParser(
+        description="fedml_tpu headline benchmark (one JSON record line)"
+    )
+    ap.add_argument(
+        "--compare", default=None, metavar="BENCH_prev.json",
+        help="Emit a per-section regression delta table (r/s ±%% vs this "
+             "baseline record) into the new record's `compare` block and "
+             "exit 4 when any section regresses past --regress_tol",
+    )
+    ap.add_argument(
+        "--regress_tol", type=float, default=10.0, metavar="PCT",
+        help="Regression tolerance in percent for --compare (default 10)",
+    )
+    # parse_known_args, NOT parse_args: main() historically ignored argv
+    # entirely, and stray/legacy arguments must never abort the process
+    # before the emitter's kill-proofing exists (a record-less exit is
+    # the exact failure mode the finalize machinery prevents)
+    args, unknown = ap.parse_known_args()
+    if unknown:
+        print(f"bench.py: ignoring unrecognized arguments {unknown}",
+              file=sys.stderr)
 
     t0 = time.perf_counter()  # the probe below counts against the budget
     budget_s = float(os.environ.get("FEDML_TPU_BENCH_BUDGET_S", 2100))
@@ -1594,7 +1729,10 @@ def main():
             os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
         ),
     )
-    emitter = _Emitter(t0, detail_path)
+    emitter = _Emitter(
+        t0, detail_path,
+        compare_path=args.compare, regress_tol_pct=args.regress_tol,
+    )
 
     # --- the three kill-proofing layers (module comment above) ---
     def _finalize_and_exit(why):

@@ -337,7 +337,7 @@ echo "== serve soak smoke: 3 concurrent tenants, churning fleet, shared executab
 # mark and the end, scrapeable per-tenant metrics from one /metrics
 # endpoint, tenant-labeled restart counters.
 timeout 600 python - <<'PY'
-import tempfile, threading, time, urllib.request
+import json, tempfile, threading, time, urllib.request
 
 import jax
 import numpy as np
@@ -451,6 +451,40 @@ for t in ("soak_a", "soak_b", "soak_c"):
     assert f'tenant="{t}"' in body, f"missing {t} in /metrics"
 assert body.count("# TYPE fedml_comm_messages_sent_total counter") == 1
 
+# live introspection mid-flight (serve/introspect.py), same port as
+# /metrics: /status with ADVANCING rounds, /tenants/soak_d showing its
+# self-healing restart, /compile, and the k8s-shaped /healthz
+def _fetch(path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.prom_port}{path}") as r:
+        return r.status, json.loads(r.read().decode())
+code, st1 = _fetch("/status")
+assert code == 200 and st1["tenant_count"] == 4, st1
+assert st1["tenants"]["soak_a"]["state"] == "running", st1
+r1 = st1["tenants"]["soak_a"]["rounds_completed"]
+_until(lambda: a.server.server_steps > r1 + 1, "/status rounds advancing")
+code, st2 = _fetch("/status")
+assert st2["tenants"]["soak_a"]["rounds_completed"] > r1, (st1, st2)
+assert st2["tenants"]["soak_a"]["device"], st2
+_until(lambda: d.restarts >= 1, "soak_d's supervised restart")
+code, td = _fetch("/tenants/soak_d")
+assert code == 200 and td["status"]["supervisor/restarts"] == 1, td
+assert len(td["flight"]["tail"]) >= 1, td
+# restarts_total already visible MID-FLIGHT, tenant-labeled
+mid = urllib.request.urlopen(
+    f"http://127.0.0.1:{srv.prom_port}/metrics").read().decode()
+assert any(
+    ln.startswith("fedml_session_restarts_total{")
+    and 'tenant="soak_d"' in ln and ln.endswith(" 1.0")
+    for ln in mid.splitlines()), "soak_d restart not in mid-flight scrape"
+code, comp = _fetch("/compile")
+assert code == 200 and "programs" in comp, comp
+code, hz = _fetch("/healthz")
+assert code == 200 and hz["status"] == "ok", hz
+print(f"  introspection ok: /status rounds {r1} -> "
+      f"{st2['tenants']['soak_a']['rounds_completed']}, soak_d restart "
+      f"visible in /tenants + /metrics, /compile + /healthz answering")
+
 churner.join(timeout=120)
 results = srv.wait(timeout=420)
 end_rss = rss_mb()
@@ -468,7 +502,12 @@ for la, lb in zip(jax.tree_util.tree_leaves(ref.global_vars),
     np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 assert results["soak_d"]["summary"]["supervisor/restarts"] == 1
 assert results["soak_d"]["summary"]["supervisor/health"] == "degraded"
-assert 'fedml_session_restarts_total{tenant="soak_d"} 1.0' in final_metrics
+# tenant-scoped samples carry tenant= AND device= labels now
+assert any(
+    ln.startswith("fedml_session_restarts_total{")
+    and 'tenant="soak_d"' in ln and 'device="' in ln
+    and ln.endswith(" 1.0")
+    for ln in final_metrics.splitlines()), "soak_d restarts not labeled"
 import shutil
 shutil.rmtree(heal_dir, ignore_errors=True)
 total_rounds = (a.server.server_steps + b.server.server_steps
@@ -525,6 +564,43 @@ print("  serve CLI ok: per-tenant rows in one summary.json + full "
       "per-tenant logs")
 PY
 rm -rf "$SRVDIR"
+
+echo "== serve SLO smoke: breach -> degraded (0 restarts) + --slo_strict exit 4 =="
+# An absurd slo_round_s makes every round a breach: without --slo_strict
+# the run exits 0 with the breach in slo/* keys and health degraded —
+# WITHOUT consuming the restart budget (a breach is a signal, not a
+# crash); with --slo_strict the same spec must exit 4 (the CI hook).
+SLODIR=$(mktemp -d)
+cat > "$SLODIR/spec.json" <<'EOF'
+{"tenants": [
+  {"name": "slo_t", "algorithm": "fedavg", "runtime": "loopback",
+   "model": "lr", "dataset": "synthetic", "client_num_in_total": 6,
+   "client_num_per_round": 3, "comm_round": 2, "batch_size": 8,
+   "frequency_of_the_test": 100, "slo_round_s": 1e-9,
+   "restart_budget": 2}
+]}
+EOF
+python -m fedml_tpu serve --spec "$SLODIR/spec.json" > "$SLODIR/out.json"
+python - "$SLODIR" <<'PY'
+import json, sys
+t = json.load(open(f"{sys.argv[1]}/out.json"))["slo_t"]
+assert t["ok"], t                       # breaches never fail the tenant...
+assert t["slo/breached"] == 1, t        # ...but they are loudly recorded
+assert t["slo/round_s"] >= 1, t
+assert t["supervisor/health"] == "degraded", t
+assert t["supervisor/restarts"] == 0, t  # degraded WITHOUT burning budget
+print(f"  slo ok: {int(t['slo/breaches_total'])} breach(es), health "
+      "degraded, 0 restarts burned")
+PY
+set +e
+python -m fedml_tpu serve --spec "$SLODIR/spec.json" --slo_strict > /dev/null 2>&1
+SLORC=$?
+set -e
+if [ "$SLORC" -ne 4 ]; then
+  echo "  ERROR: --slo_strict exited $SLORC, expected 4"; exit 1
+fi
+echo "  slo_strict ok: breaching tenant -> exit 4"
+rm -rf "$SLODIR"
 
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

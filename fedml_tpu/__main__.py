@@ -1,14 +1,22 @@
 import sys
 
 # `python -m fedml_tpu serve ...` — the multi-tenant service subcommand
-# (fedml_tpu/serve/). Dispatched here by argv inspection so the single-run
-# surface stays exactly `python -m fedml_tpu --algorithm ...` (turning the
-# CLI into a click group would have broken every existing invocation).
+# (fedml_tpu/serve/), and `python -m fedml_tpu status ...` — the live
+# introspection pretty-printer over a running service's /status endpoint
+# (fedml_tpu/serve/introspect.py). Dispatched here by argv inspection so
+# the single-run surface stays exactly `python -m fedml_tpu --algorithm
+# ...` (turning the CLI into a click group would have broken every
+# existing invocation).
 if len(sys.argv) > 1 and sys.argv[1] == "serve":
     from fedml_tpu.serve.cli import serve_main
 
     del sys.argv[1]
     serve_main()
+elif len(sys.argv) > 1 and sys.argv[1] == "status":
+    from fedml_tpu.serve.introspect import status_main
+
+    del sys.argv[1]
+    status_main()
 else:
     from fedml_tpu.cli import main
 

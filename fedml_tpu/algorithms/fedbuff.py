@@ -494,14 +494,18 @@ class FedBuffServerManager(ServerManager):
             self.server_steps % self.config.fed.frequency_of_the_test == 0
             or self.server_steps == fed.comm_round
         ):
-            loss, acc = evaluate(
-                self.model,
-                self.global_vars,
-                self.data.test_x,
-                self.data.test_y,
-                task=self.task,
-                eval_fn=self._eval_fn,
-            )
+            # keyed to the server_step span just recorded (it carried the
+            # PRE-increment version), so the flight recorder merges this
+            # eval into that step's folded record
+            with self._tracer.span("eval", round=self.version - 1):
+                loss, acc = evaluate(
+                    self.model,
+                    self.global_vars,
+                    self.data.test_x,
+                    self.data.test_y,
+                    task=self.task,
+                    eval_fn=self._eval_fn,
+                )
             row["Test/Loss"], row["Test/Acc"] = loss, acc
         self.history.append(row)
         self.log_fn(row)

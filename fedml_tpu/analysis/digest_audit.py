@@ -288,6 +288,7 @@ KNOWN_BENIGN = frozenset({
     "population.loss_map_capacity", "population.selection_memo_rounds",
     "population.health_active_clients",
     "population.health_trace_budget_bytes",
+    "population.flight_rounds", "population.flight_budget_bytes",
 })
 
 

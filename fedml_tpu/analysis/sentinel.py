@@ -138,6 +138,16 @@ def persistent_cache_hit_count() -> int:
         return _cache_hits
 
 
+def global_recompiles() -> int:
+    """Process-lifetime ACTUAL compiles: backend-compile events minus
+    persistent-cache hits (a hit deserializes an already-compiled
+    program — not a compile). The ONE definition of "recompile" for
+    unscoped consumers, mirroring ``TelemetryScope.recompiles`` for the
+    scoped case."""
+    with _lock:
+        return max(0, _backend_compiles - _cache_hits)
+
+
 class RecompileSentinel:
     """Snapshot-diff watcher over a region of execution.
 

@@ -137,8 +137,10 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="Write host-side telemetry here: trace.json (Chrome "
                    "trace events — round/broadcast/local_train/aggregate/"
                    "eval spans, viewable in Perfetto next to the "
-                   "--profile_dir device trace) and health.json (per-client "
-                   "participation/train-time/straggler registry)")
+                   "--profile_dir device trace), health.json (per-client "
+                   "participation/train-time/straggler registry) and "
+                   "flight.json (the last-K-rounds flight-recorder ring: "
+                   "per-round phase wall times + rolling p50/p95)")
 @click.option("--prom_port", type=int, default=None,
               help="Serve Prometheus text exposition on "
                    "http://127.0.0.1:PORT/metrics for the duration of the "
@@ -595,20 +597,33 @@ def build_config(opt) -> RunConfig:
     )
 
 
-def _telemetry_start(opt):
+def _telemetry_start(opt, config=None):
     """Start run-scoped telemetry sinks (the tracer itself is always on —
     spans cost microseconds; these flags decide whether anything is
     EXPORTED). Returns an opaque state for _telemetry_finish, or None when
-    no telemetry flag is set."""
+    no telemetry flag is set. ``config`` supplies the flight-recorder
+    ring bounds (PopulationConfig.flight_*)."""
     if opt.get("prom_port") is None and opt.get("telemetry_dir") is None:
         return None
-    from fedml_tpu.telemetry import get_comm_meter, get_tracer
+    from fedml_tpu.telemetry import FlightRecorder, get_comm_meter, get_tracer
 
     # run-scoped trace + comm totals: the exported trace.json and the
     # summary.json telemetry row describe THIS run, not whatever earlier
     # runs happened in the same process (CliRunner tests, notebook sweeps)
     get_tracer().reset()
     state = {"exporter": None, "comm_baseline": get_comm_meter().snapshot()}
+    # flight recorder (telemetry/flight.py): fold the run's round spans
+    # into the bounded last-K ring — flight/* summary block + flight.json
+    # under --telemetry_dir, p50/p95 gauges under --prom_port
+    from fedml_tpu.analysis.sentinel import global_recompiles
+
+    flight_kw = dict(
+        comm_meter=get_comm_meter(), recompiles_fn=global_recompiles
+    )
+    state["flight"] = (
+        FlightRecorder.from_config(config, **flight_kw)
+        if config is not None else FlightRecorder(**flight_kw)
+    ).attach(get_tracer())
     if opt.get("prom_port") is not None:
         from fedml_tpu.telemetry import PrometheusExporter
 
@@ -643,6 +658,10 @@ def _telemetry_finish(state, opt, logger, health=None):
     from fedml_tpu.telemetry import get_tracer, telemetry_summary
 
     logger.log(telemetry_summary(baseline=state.get("comm_baseline")))
+    flight = state.get("flight")
+    if flight is not None:
+        logger.log(flight.summary_row())  # the flight/* summary block
+        flight.detach()
     tdir = opt.get("telemetry_dir")
     if tdir:
         tdir = Path(tdir)
@@ -650,6 +669,17 @@ def _telemetry_finish(state, opt, logger, health=None):
         suffix = _telemetry_suffix(opt)
         trace_path = tdir / f"trace{suffix}.json"
         get_tracer().write_chrome_trace(str(trace_path))
+        if flight is not None:
+            with open(tdir / f"flight{suffix}.json", "w") as f:
+                json.dump(
+                    {
+                        "rounds_folded": flight.rounds_folded,
+                        "ring_capacity": flight.capacity,
+                        "percentiles": flight.percentiles(),
+                        "records": flight.tail(),
+                    },
+                    f, indent=2,
+                )
         if health is not None:
             with open(tdir / f"health{suffix}.json", "w") as f:
                 json.dump(health.snapshot(), f, indent=2)
@@ -875,7 +905,7 @@ def run(**opt):
             str(opt["log_dir"]) if opt["log_dir"] else None,
             use_wandb=opt.get("enable_wandb", False),
         )
-        telemetry = _telemetry_start(opt)
+        telemetry = _telemetry_start(opt, config)
         api_cell = []
 
         def log_fn(row):

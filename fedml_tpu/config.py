@@ -300,6 +300,13 @@ class PopulationConfig:
     # trace_incomplete (FaultPlan.from_trace refuses them — a partial
     # fleet must never replay silently).
     health_trace_budget_bytes: int = 16 << 20
+    # Round flight recorder bounds (telemetry/flight.py): the per-round
+    # ring keeps at most flight_rounds folded records AND never more
+    # than flight_budget_bytes of them (whichever bound is tighter wins
+    # — a month-long serve tenant stays O(K), never O(rounds), exactly
+    # like the fault-event log above).
+    flight_rounds: int = 64
+    flight_budget_bytes: int = 64 << 10
 
 
 @dataclasses.dataclass(frozen=True)

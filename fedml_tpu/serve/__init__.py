@@ -23,6 +23,16 @@ checkpoints, and per-tenant observability. This package is that service:
   jittered exponential backoff, bounded by a per-tenant restart budget
   and a crash-loop breaker (self-healing; ``restart=`` on
   ``create_session`` / ``restart_budget`` in a tenant spec).
+- :mod:`fedml_tpu.serve.introspect` — :class:`Introspector`: read-only
+  JSON endpoints (``/status``, ``/tenants/<name>``, ``/compile``, a
+  tenant-aware ``/healthz``) on the Prometheus port, plus the
+  ``python -m fedml_tpu status`` pretty-printer.
+- :mod:`fedml_tpu.serve.slo` — :class:`SloPolicy` /
+  :class:`SloWatchdog`: declarative per-tenant objectives (round time,
+  rolling p95, throughput floor, recompile ceiling, straggler fraction)
+  evaluated against the flight recorder each round; breaches degrade a
+  tenant without consuming restart budget, and ``--slo_strict`` turns
+  them into a CI failure.
 
 Co-tenant federations with the same model family share compiled programs
 for free: the ProgramCache digest (fedml_tpu/compile/) is process-wide by
@@ -30,8 +40,10 @@ design, and the per-scope compile attribution in the recompile sentinel
 proves it (``compile/recompiles == 0`` on the second same-family tenant —
 the ci.sh soak gate). See docs/SERVING.md."""
 
+from fedml_tpu.serve.introspect import Introspector
 from fedml_tpu.serve.session import FedSession
 from fedml_tpu.serve.server import FederationServer
+from fedml_tpu.serve.slo import SloPolicy, SloWatchdog
 from fedml_tpu.serve.supervisor import (
     RestartBudgetExhausted,
     RestartPolicy,
@@ -41,7 +53,10 @@ from fedml_tpu.serve.supervisor import (
 __all__ = [
     "FedSession",
     "FederationServer",
+    "Introspector",
     "RestartBudgetExhausted",
     "RestartPolicy",
+    "SloPolicy",
+    "SloWatchdog",
     "SupervisedSession",
 ]

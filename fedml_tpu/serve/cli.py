@@ -12,7 +12,12 @@ fedbuff), ``runtime`` (loopback|shm|mqtt), ``checkpoint_path``,
 self-healing keys (fedml_tpu/serve/supervisor.py): ``restart_budget``
 (int — supervise the tenant: a crash restarts it from its rolling
 checkpoint, at most this many times), ``restart_backoff_s``,
-``restart_backoff_max_s``, ``breaker_window``.
+``restart_backoff_max_s``, ``breaker_window`` — plus the SLO keys
+(fedml_tpu/serve/slo.py): ``slo_round_s``, ``slo_p95_round_s``,
+``slo_min_rounds_per_s``, ``slo_max_recompiles``,
+``slo_straggler_frac`` (breaches flip the tenant to ``degraded`` and
+count in ``fedml_slo_breaches_total`` without consuming restart
+budget; ``--slo_strict`` turns any breach into exit 4).
 
 Spec document shape: ``{"tenants": [...]}`` or a bare JSON list.
 
@@ -28,7 +33,9 @@ misconfigured spec: **0** every tenant finished (including "recovered
 after N restarts" — the restart count rides the JSON output), **1**
 tenant runtime failures, **2** misconfigured spec (parse-time, or a
 session build rejecting its config), **3** every failure is a
-supervised tenant whose restart budget / crash-loop breaker gave up."""
+supervised tenant whose restart budget / crash-loop breaker gave up,
+**4** (only under ``--slo_strict``) every tenant finished but at least
+one breached a declared SLO."""
 
 from __future__ import annotations
 
@@ -55,6 +62,16 @@ class _RestartsExhaustedExit(click.ClickException):
     exit 3 (flaky tenant), distinct from exit 2 (misconfigured spec)."""
 
     exit_code = 3
+
+
+class _SloBreachExit(click.ClickException):
+    """--slo_strict and at least one tenant breached a declared SLO —
+    exit 4: the run FINISHED (numerics fine, tenants done) but missed
+    its objectives. Distinct from runtime failure (1), misconfigured
+    spec (2) and restart exhaustion (3) so CI can treat an SLO miss as
+    its own signal."""
+
+    exit_code = 4
 
 
 def _cli_defaults() -> dict:
@@ -115,6 +132,20 @@ def build_tenant(spec: dict):
     for key in _SESSION_KEYS:
         if key in spec:
             session_kw[key] = spec.pop(key)
+    # SLO keys (serve/slo.py) — declarative per-tenant objectives the
+    # watchdog evaluates against the flight recorder each round. A
+    # malformed value is a PARSE-TIME spec error (exit 2), like every
+    # other guard here — not a runtime failure
+    from fedml_tpu.serve.slo import SloPolicy
+
+    try:
+        slo = SloPolicy.from_spec(spec)
+    except (TypeError, ValueError) as e:
+        raise click.UsageError(
+            f"tenant {session_kw.get('name')!r}: invalid SLO value — {e}"
+        )
+    if slo is not None:
+        session_kw["slo"] = slo
     restart_kw = {k: spec.pop(k) for k in _RESTART_KEYS if k in spec}
     if restart_kw:
         from fedml_tpu.serve.supervisor import RestartPolicy
@@ -202,7 +233,14 @@ def build_tenant(spec: dict):
 @click.option("--stagger_s", type=float, default=0.0,
               help="Delay between tenant starts (lets the first tenant "
                    "of a model family pay the compiles the rest share)")
-def serve_main(spec, log_dir, prom_port, duration_s, stagger_s):
+@click.option("--slo_strict", is_flag=True, default=False,
+              help="Exit 4 when any tenant breached a declared SLO "
+                   "(slo_round_s / slo_p95_round_s / slo_min_rounds_per_s"
+                   " / slo_max_recompiles / slo_straggler_frac spec keys)"
+                   " — the CI hook; without it breaches only degrade the "
+                   "tenant and land in slo/* summary keys + "
+                   "fedml_slo_breaches_total")
+def serve_main(spec, log_dir, prom_port, duration_s, stagger_s, slo_strict):
     """Run N federation tenants concurrently in one process."""
     import time
 
@@ -277,7 +315,15 @@ def serve_main(spec, log_dir, prom_port, duration_s, stagger_s):
         name: r.get("error_kind") or "runtime"
         for name, r in out.items() if not r["ok"]
     }
+    breached = sorted(
+        name for name, r in out.items() if r.get("slo/breached")
+    )
     if not failed:
+        if slo_strict and breached:
+            raise _SloBreachExit(
+                f"tenants breached their declared SLOs: {breached} "
+                "(see slo/* summary keys and fedml_slo_breaches_total)"
+            )
         return
     if any(kind == "config" for kind in failed.values()):
         # misconfigured specs take precedence: the operator must fix the

@@ -1,0 +1,272 @@
+"""Live introspection API — read-only JSON endpoints on the service's
+Prometheus port, plus the ``python -m fedml_tpu status`` pretty-printer.
+
+The Prometheus scrape answers "chart it later"; an operator staring at a
+wedged tenant needs "what is it doing RIGHT NOW" as one curl. The
+:class:`Introspector` mounts these routes on the serve layer's existing
+:class:`~fedml_tpu.telemetry.prometheus.PrometheusExporter` (one port,
+one ops surface — the read path ROADMAP item 2's admin control plane
+builds on):
+
+- ``GET /status`` — server uptime + one brief per tenant: lifecycle
+  state, health (healthy/degraded/failed, incl. SLO-degraded), rounds
+  completed/target, restarts + budget remaining, current round age
+  (seconds since the flight recorder last folded — a wedged tenant shows
+  a climbing age while its state still says "running"), device kind.
+- ``GET /tenants/<name>`` — that tenant's deep view: full status row,
+  the flight-recorder tail + rolling percentiles, a bounded health
+  summary (clients seen, straggler ids), checkpoint freshness.
+- ``GET /compile`` — the process-wide compile story: program-cache
+  hit/miss, hardened persistent-cache and executable-store counters,
+  sentinel-observed backend compiles (zero-cold-start verification for
+  a serving replica, from the outside).
+- ``GET /healthz`` — 200 while every tenant is non-failed, 503 with the
+  failed tenant names otherwise (the k8s-shaped probe; degraded tenants
+  stay 200 — they are serving).
+
+Everything is read-only and loopback-bound by default; the write-path
+admin surface (live tenant add/remove) is deliberately NOT here yet —
+this PR is its read substrate."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional, Tuple
+
+import click
+
+
+class Introspector:
+    """Route table over one :class:`FederationServer` (serve/server.py)."""
+
+    ROUTES = ("/status", "/tenants/", "/compile", "/healthz")
+
+    def __init__(self, server):
+        self.server = server
+        self.started_at = time.time()
+
+    def install(self, exporter) -> "Introspector":
+        exporter.add_route("/status", self._r_status)
+        exporter.add_route("/tenants/", self._r_tenant)
+        exporter.add_route("/compile", self._r_compile)
+        exporter.add_route("/healthz", self._r_healthz)
+        return self
+
+    # -- per-tenant brief ----------------------------------------------------
+
+    def _brief(self, s) -> dict:
+        st = s.status()
+        flight = getattr(s, "flight", None)
+        brief = {
+            "state": st.get("state"),
+            "health": st.get("health", getattr(s, "health_state", None)),
+            "algorithm": st.get("algorithm"),
+            "mode": st.get("mode"),
+            "runtime": st.get("runtime"),
+            "device": st.get("device"),
+            "workers": st.get("workers"),
+            "rounds_completed": st.get("server_steps", st.get("round")),
+            "rounds_target": st.get(
+                "target_steps", st.get("target_rounds")
+            ),
+            "restarts": st.get("supervisor/restarts", 0),
+        }
+        budget = st.get("supervisor/restart_budget")
+        if budget is not None:
+            brief["restart_budget_remaining"] = int(budget) - int(
+                st.get("supervisor/restarts", 0)
+            )
+        if st.get("slo_breaches"):
+            brief["slo_breaches"] = st["slo_breaches"]
+        if flight is not None:
+            age = flight.last_fold_age_s()
+            brief["current_round_age_s"] = (
+                round(age, 3) if age is not None else None
+            )
+            rate = flight.rounds_per_s()
+            if rate is not None:
+                brief["rounds_per_s"] = round(rate, 3)
+        return brief
+
+    # -- routes --------------------------------------------------------------
+
+    def _r_status(self, path: str) -> Tuple[int, dict]:
+        sessions = self.server.sessions()
+        return 200, {
+            "service": "fedml_tpu.serve",
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "tenant_count": len(sessions),
+            "tenants": {s.name: self._brief(s) for s in sessions},
+        }
+
+    def _r_tenant(self, path: str) -> Tuple[int, object]:
+        from urllib.parse import unquote
+
+        name = unquote(path[len("/tenants/"):])
+        if "/" in name:
+            return 404, {"error": f"no such resource {path!r}"}
+        try:
+            s = self.server.session(name)
+        except KeyError:
+            return 404, {"error": f"unknown tenant {name!r}"}
+        out = {"tenant": name, "status": _jsonable_dict(s.status())}
+        flight = getattr(s, "flight", None)
+        if flight is not None:
+            out["flight"] = {
+                "tail": flight.tail(32),
+                "percentiles": flight.percentiles(),
+                "rounds_folded": flight.rounds_folded,
+                "rounds_per_s": flight.rounds_per_s(),
+                "last_fold_age_s": flight.last_fold_age_s(),
+            }
+        server_mgr = getattr(s, "server", None)
+        health = getattr(server_mgr, "health", None)
+        if health is not None:
+            out["health"] = {
+                # O(1) count — clients_seen() would SORT a million-client
+                # registry under its lock on every scrape
+                "clients_seen": health.known_client_count(),
+                "stragglers": health.straggler_ids()[:32],
+                "trace_incomplete": health.trace_incomplete,
+            }
+        cp = getattr(s, "checkpoint_path", None)
+        if cp:
+            npz = str(cp) + ".npz"
+            exists = os.path.exists(npz)
+            out["checkpoint"] = {
+                "path": str(cp),
+                "exists": exists,
+                "age_s": (
+                    round(time.time() - os.path.getmtime(npz), 3)
+                    if exists else None
+                ),
+            }
+        return 200, out
+
+    def _r_compile(self, path: str) -> Tuple[int, dict]:
+        from fedml_tpu.analysis.sentinel import (
+            backend_compile_count,
+            persistent_cache_hit_count,
+        )
+        from fedml_tpu.compile import compile_snapshot
+
+        out = {
+            "backend_compiles": backend_compile_count(),
+            "persistent_cache_hits": persistent_cache_hit_count(),
+        }
+        out.update(compile_snapshot())
+        return 200, out
+
+    def _r_healthz(self, path: str) -> Tuple[int, dict]:
+        failed = [
+            s.name
+            for s in self.server.sessions()
+            if getattr(s, "health_state", None) == "failed"
+            or s.state == "failed"
+        ]
+        if failed:
+            return 503, {"status": "failed", "failed_tenants": sorted(failed)}
+        return 200, {
+            "status": "ok", "tenants": len(self.server.sessions())
+        }
+
+
+def _jsonable_dict(d: dict) -> dict:
+    from fedml_tpu.serve.server import _jsonable
+
+    return {k: _jsonable(v) for k, v in d.items()}
+
+
+# ---------------------------------------------------------------------------
+# `python -m fedml_tpu status` — the terminal pretty-printer over /status
+# ---------------------------------------------------------------------------
+
+_COLS = (
+    ("TENANT", "name"), ("STATE", "state"), ("HEALTH", "health"),
+    ("ROUNDS", "rounds"), ("RESTARTS", "restarts"),
+    ("ROUND_AGE", "current_round_age_s"), ("R/S", "rounds_per_s"),
+    ("DEVICE", "device"),
+)
+
+
+def _fetch(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def render_status(doc: dict) -> str:
+    """The /status document as an aligned terminal table (pure function —
+    tested without a live server)."""
+    rows = []
+    for name, b in sorted(doc.get("tenants", {}).items()):
+        done, target = b.get("rounds_completed"), b.get("rounds_target")
+        rounds = f"{done}/{target}" if done is not None else "-"
+        age = b.get("current_round_age_s")
+        row = {
+            "name": name,
+            "state": str(b.get("state", "-")),
+            "health": str(b.get("health", "-")),
+            "rounds": rounds,
+            "restarts": str(b.get("restarts", 0)),
+            "current_round_age_s": f"{age:.1f}s" if age is not None else "-",
+            "rounds_per_s": (
+                f"{b['rounds_per_s']:.2f}" if b.get("rounds_per_s") else "-"
+            ),
+            "device": str(b.get("device") or "-"),
+        }
+        if b.get("slo_breaches"):
+            row["health"] += (
+                f" (slo:{sum(b['slo_breaches'].values())})"
+            )
+        rows.append(row)
+    widths = {
+        key: max([len(hdr)] + [len(r[key]) for r in rows])
+        for hdr, key in _COLS
+    }
+    lines = [
+        f"fedml_tpu serve — {doc.get('tenant_count', len(rows))} tenant(s), "
+        f"up {doc.get('uptime_s', 0):.0f}s"
+    ]
+    lines.append("  ".join(hdr.ljust(widths[key]) for hdr, key in _COLS))
+    for r in rows:
+        lines.append("  ".join(r[key].ljust(widths[key]) for _, key in _COLS))
+    return "\n".join(lines)
+
+
+@click.command(name="status")
+@click.option("--url", default="http://127.0.0.1:9464",
+              help="Base URL of a running service's metrics/introspection "
+                   "port (serve --prom_port)")
+@click.option("--tenant", default=None,
+              help="Show one tenant's deep view (/tenants/<name>: flight "
+                   "tail, health summary, checkpoint age) as JSON")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="Raw JSON instead of the table")
+def status_main(url: str, tenant: Optional[str], as_json: bool):
+    """Pretty-print a running federation service's /status."""
+    from urllib.parse import quote
+
+    base = url.rstrip("/")
+    target = (
+        f"{base}/tenants/{quote(tenant, safe='')}" if tenant
+        else f"{base}/status"
+    )
+    try:
+        doc = _fetch(target)
+    except Exception as e:  # noqa: BLE001 — connection errors are the UX
+        raise click.ClickException(
+            f"could not reach {target}: {e} (is the service running with "
+            "--prom_port?)"
+        )
+    if tenant or as_json:
+        click.echo(json.dumps(doc, indent=2, default=str))
+        return
+    click.echo(render_status(doc))
+
+
+if __name__ == "__main__":
+    status_main()

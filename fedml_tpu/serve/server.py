@@ -26,7 +26,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from fedml_tpu.serve.session import FedSession
+from fedml_tpu.serve.session import FedSession, _device_kind
 from fedml_tpu.telemetry import (
     TelemetryScope,
     TenantedRegistryView,
@@ -47,6 +47,7 @@ class FederationServer:
         self._order: List[str] = []
         self._lock = threading.Lock()
         self._exporter = None
+        self._introspector = None
         self._prom_port = prom_port
         self.logger = None
         if log_dir:
@@ -93,7 +94,15 @@ class FederationServer:
             self._sessions[session.name] = session
             self._order.append(session.name)
         if session.scope is not None:
-            self.view.add_tenant(session.name, session.scope.registry)
+            # device label groundwork (ROADMAP item 2): tenant-scoped
+            # samples carry the backend their session dispatches to,
+            # so a multi-slice placement can tell tenants' devices apart
+            # on one /metrics
+            self.view.add_tenant(
+                session.name,
+                session.scope.registry,
+                extra={"device": _device_kind()},
+            )
         return session
 
     def session(self, name: str) -> FedSession:
@@ -118,9 +127,17 @@ class FederationServer:
             ensure_backend_listener()
             self._exporter = PrometheusExporter(
                 port=self._prom_port, registry=self.view
-            ).start()
+            )
+            # read-only introspection rides the same port: /status,
+            # /tenants/<name>, /compile, and the tenant-aware /healthz
+            # (serve/introspect.py)
+            from fedml_tpu.serve.introspect import Introspector
+
+            self._introspector = Introspector(self).install(self._exporter)
+            self._exporter.start()
             logging.info(
-                "serve: prometheus metrics on http://127.0.0.1:%d/metrics",
+                "serve: prometheus metrics on http://127.0.0.1:%d/metrics "
+                "(introspection: /status /tenants/<name> /compile /healthz)",
                 self._exporter.port,
             )
         for s in self.sessions():

@@ -53,6 +53,19 @@ SESSION_ALGORITHMS = ("fedavg", "fedprox", "fedopt", "fedbuff")
 SESSION_RUNTIMES = ("loopback", "shm", "mqtt")
 
 
+def _device_kind() -> str:
+    """The backend this process dispatches to — the per-tenant ``device``
+    label groundwork for multi-device tenant placement (ROADMAP item 2).
+    One process still means one backend; when sessions get mesh-slice
+    handles this becomes a per-session fact."""
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:  # noqa: BLE001 — jax-free contexts (pure unit tests)
+        return "unknown"
+
+
 class FedSession:
     """One federation as a long-lived object (see module docstring).
 
@@ -80,6 +93,7 @@ class FedSession:
         resume: bool = False,
         max_workers: Optional[int] = None,
         scope: Optional[TelemetryScope] = None,
+        slo=None,
     ):
         if algorithm not in SESSION_ALGORITHMS:
             raise ValueError(
@@ -120,6 +134,16 @@ class FedSession:
         self.resume = bool(resume)
         self.max_workers = max_workers
         self.scope = scope
+        # SLO policy (serve/slo.py) — evaluated against the flight
+        # recorder each round; breaches degrade, they never crash
+        if slo is not None:
+            from fedml_tpu.serve.slo import SloPolicy
+
+            if not isinstance(slo, SloPolicy):
+                raise ValueError(
+                    f"slo must be a serve.slo.SloPolicy, got {type(slo)!r}"
+                )
+        self.slo = slo
         self.mode = "fedbuff" if algorithm == "fedbuff" else "sync"
         # endpoint namespace: unique per session OBJECT so two sessions
         # built from identical specs still cannot collide (satellite fix:
@@ -141,6 +165,10 @@ class FedSession:
         self._finalized = False
         self._lock = threading.Lock()
         self._next_rank = 1
+        self.device: Optional[str] = None  # backend kind, set at start()
+        self.flight = None  # FlightRecorder, built at start()
+        self._slo_watchdog = None
+        self._own_flight = False  # detach-at-cleanup when not scope-owned
         self.state = "created"  # created -> running -> done|failed
         # which phase failed: "build" (config guards / checkpoint restore
         # rejected the session before anything ran — the serve CLI's
@@ -423,14 +451,85 @@ class FedSession:
             self._cleanup()
             raise
 
+    def _init_flight(self) -> None:
+        """Build/reuse the tenant's flight recorder + SLO watchdog. One
+        recorder per SCOPE (shared across supervised restart attempts —
+        one tenant, one flight history; ``attach`` is idempotent per
+        tracer); unscoped sessions ADOPT an ambient recorder when the
+        CLI exported one, own a private one only when SLOs demand it,
+        and otherwise skip recording entirely (a plain wrapper run has
+        no reader — and its owned recorder is detached at cleanup so
+        runs don't stack listeners on the global tracer). Must run under
+        the session's scope activation so the gauges land in the tenant
+        registry."""
+        from fedml_tpu.telemetry import get_comm_meter
+        from fedml_tpu.telemetry.flight import (
+            FlightRecorder,
+            attached_recorder,
+        )
+
+        self.device = _device_kind()
+        scope = self.scope
+        rec = getattr(scope, "flight", None) if scope is not None else None
+        if rec is None and scope is None:
+            # unscoped wrapper run under the CLI: the ambient tracer may
+            # already carry the run's recorder (_telemetry_start) —
+            # adopt it (not owned: the CLI detaches it) instead of
+            # double-folding every round through a second one
+            rec = attached_recorder(get_tracer())
+        if rec is None and scope is None and self.slo is None:
+            # plain wrapper run (no tenant scope, no ambient recorder,
+            # no SLOs): nobody would ever read the ring — skip the
+            # per-round fold work and keep stale fedml_flight_* values
+            # out of the global registry
+            return
+        if rec is None:
+            if scope is not None:
+                recompiles_fn = scope.recompiles
+            else:
+                from fedml_tpu.analysis.sentinel import global_recompiles
+
+                recompiles_fn = global_recompiles
+            rec = FlightRecorder.from_config(
+                self.config,
+                comm_meter=get_comm_meter(),
+                recompiles_fn=recompiles_fn,
+            )
+            if scope is not None:
+                scope.flight = rec
+            else:
+                self._own_flight = True
+        rec.attach(get_tracer())
+        # fence off the previous attempt's records (supervised restart):
+        # the re-run's rounds must fold fresh records, not merge into the
+        # crashed attempt's partials; no-op on a first start
+        rec.begin_attempt()
+        self.flight = rec
+        if self.slo is not None:
+            from fedml_tpu.serve.slo import SloWatchdog
+
+            wd = (
+                getattr(scope, "slo_watchdog", None)
+                if scope is not None else None
+            )
+            if wd is None:
+                wd = SloWatchdog(self.slo, flight=rec, tenant=self.name)
+                if scope is not None:
+                    scope.slo_watchdog = wd
+            self._slo_watchdog = wd
+
     def _start_built(self) -> "FedSession":
         with activate_scope(self.scope):
+            self._init_flight()
             if self.comm_factory is None:
                 self.comm_factory = self._default_comm_factory()
             if self.mode == "fedbuff":
                 self._build_fedbuff()
             else:
                 self._build_sync()
+            if self.flight is not None:
+                # straggler spread folds from the attempt's live registry
+                self.flight.health = getattr(self.server, "health", None)
             already_done = False
             if self.resume and self.checkpoint_path:
                 already_done = self._restore()
@@ -624,6 +723,18 @@ class FedSession:
         if self._tmpdir is not None:
             shutil.rmtree(self._tmpdir, ignore_errors=True)
             self._tmpdir = None
+        if self.scope is None and self._slo_watchdog is not None:
+            # an unscoped session's watchdog is SESSION-lived even when
+            # the recorder was adopted from the CLI (not owned): left
+            # subscribed, a dead session's watchdog would keep breaching
+            # on every later fold of the process-long ambient recorder.
+            # Scope-resident watchdogs persist across restarts on purpose.
+            self._slo_watchdog.close()
+        if self._own_flight and self.flight is not None:
+            # unscoped sessions attached their recorder to the AMBIENT
+            # tracer — leave it there and every wrapper run would stack
+            # one more listener for the process lifetime
+            self.flight.detach()
 
     # -- tenant control (fedml_tpu/serve/server.py) ------------------------
 
@@ -719,16 +830,34 @@ class FedSession:
 
     # -- observability -----------------------------------------------------
 
+    @property
+    def slo_breached(self) -> bool:
+        wd = self._slo_watchdog
+        return bool(wd is not None and wd.breached)
+
+    @property
+    def health_state(self) -> str:
+        """healthy | degraded (an SLO breached — the tenant still runs) |
+        failed. The supervisor's richer version layers restart counts on
+        top (serve/supervisor.py)."""
+        if self.state == "failed":
+            return "failed"
+        return "degraded" if self.slo_breached else "healthy"
+
     def status(self) -> dict:
         """JSON-ready snapshot for the service ops surface."""
         row = {
             "name": self.name,
             "state": self.state,
+            "health": self.health_state,
             "algorithm": self.algorithm,
             "runtime": self.runtime,
             "mode": self.mode,
             "workers": len(self.clients),
+            "device": self.device,
         }
+        if self._slo_watchdog is not None:
+            row["slo_breaches"] = self._slo_watchdog.breach_counts()
         server = self.server
         if server is not None:
             if self.mode == "fedbuff":
@@ -766,6 +895,10 @@ class FedSession:
             row["comm_bytes_sent"] = sum(snap["bytes_sent"].values())
             row["comm/retries"] = sum(snap.get("send_retries", {}).values())
             row["comm/gave_up"] = sum(snap.get("send_gave_up", {}).values())
+        if self.flight is not None:
+            row.update(self.flight.summary_row())
+        if self._slo_watchdog is not None:
+            row.update(self._slo_watchdog.summary_row())
         return row
 
     @property

@@ -1,0 +1,210 @@
+"""SLO watchdogs — declarative per-tenant objectives evaluated against
+the flight recorder every round.
+
+PR 10 drew the "degraded vs failed" line from crashes: a tenant is
+degraded once the supervisor has burned restarts on it. But a tenant can
+rot long before it crashes — rounds stretching past budget, a shape
+class escaping warmup into mid-run recompiles, a straggler-heavy cohort
+— and nothing surfaced it. An :class:`SloPolicy` makes those objectives
+declarative (tenant-spec keys, serve/cli.py):
+
+- ``slo_round_s`` — any single round's wall time over this breaches;
+- ``slo_p95_round_s`` — the rolling p95 over the flight ring breaches;
+- ``slo_min_rounds_per_s`` — rolling throughput under this breaches
+  (evaluated once the ring holds ``min_samples`` records, so a tenant's
+  compile-heavy opening rounds don't trip it vacuously);
+- ``slo_max_recompiles`` — cumulative scope-attributed XLA compiles past
+  this breach once per offending round (the warmup-escape tripwire);
+- ``slo_straggler_frac`` — the FLEET fraction flagged straggler
+  (``stragglers / clients_seen``, both registry-wide — never divided by
+  the smaller per-round cohort) over this breaches.
+
+The :class:`SloWatchdog` subscribes to a tenant's
+:class:`~fedml_tpu.telemetry.flight.FlightRecorder` fold stream; each
+breach increments tenant-labeled ``fedml_slo_breaches_total{slo=...}``,
+lands in ``slo/*`` summary keys, and flips the tenant's ``health_state``
+to ``degraded`` — WITHOUT consuming restart budget or touching the
+supervision loop: a breach is an operator signal, not a crash. The serve
+CLI's ``--slo_strict`` turns any breach into a nonzero exit (the CI
+hook); the watchdog itself never stops a federation.
+
+The watchdog lives on the tenant's TelemetryScope next to the flight
+recorder, so supervised restarts keep ONE monotonic breach history per
+tenant (one tenant, one metric stream — the PR-10 scope contract)."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Dict, Optional
+
+from fedml_tpu.telemetry.flight import FlightRecorder
+from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
+
+# Tenant-spec keys (serve/cli.py) -> SloPolicy fields
+SLO_SPEC_KEYS = {
+    "slo_round_s": "round_s",
+    "slo_p95_round_s": "p95_round_s",
+    "slo_min_rounds_per_s": "min_rounds_per_s",
+    "slo_max_recompiles": "max_recompiles",
+    "slo_straggler_frac": "straggler_frac",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SloPolicy:
+    """Per-tenant objectives; None disables that check."""
+
+    round_s: Optional[float] = None
+    p95_round_s: Optional[float] = None
+    min_rounds_per_s: Optional[float] = None
+    max_recompiles: Optional[int] = None
+    straggler_frac: Optional[float] = None
+    # throughput/p95 need a populated ring before they mean anything
+    min_samples: int = 3
+
+    def active(self) -> bool:
+        return any(
+            getattr(self, f) is not None
+            for f in (
+                "round_s", "p95_round_s", "min_rounds_per_s",
+                "max_recompiles", "straggler_frac",
+            )
+        )
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> Optional["SloPolicy"]:
+        """Pop the ``slo_*`` keys out of a tenant spec dict (mutates it,
+        like the restart-key parsing) and build a policy — None when the
+        spec sets no SLOs."""
+        kw = {}
+        for spec_key, field in SLO_SPEC_KEYS.items():
+            if spec_key in spec:
+                v = spec.pop(spec_key)
+                if v is not None:
+                    kw[field] = (
+                        int(v) if field == "max_recompiles" else float(v)
+                    )
+        if not kw:
+            return None
+        return cls(**kw)
+
+
+class SloWatchdog:
+    """Evaluate one tenant's :class:`SloPolicy` on every folded round."""
+
+    def __init__(
+        self,
+        policy: SloPolicy,
+        flight: FlightRecorder,
+        registry: Optional[MetricsRegistry] = None,
+        tenant: Optional[str] = None,
+    ):
+        self.policy = policy
+        self.flight = flight
+        self.tenant = tenant
+        self._lock = threading.Lock()
+        self.breaches: Dict[str, int] = {}
+        self.breached = False
+        self._recompiles_cum = 0
+        self._recompile_breached = False
+        r = registry or get_registry()
+        self._c_breach = r.counter(
+            "fedml_slo_breaches_total",
+            "Declared-SLO breaches observed by the tenant's watchdog",
+            ("slo",),
+        )
+        flight.add_listener(self.on_record)
+
+    def close(self) -> None:
+        self.flight.remove_listener(self.on_record)
+
+    # -- evaluation (flight-recorder fold listener) -------------------------
+
+    def _breach(self, slo: str, detail: str) -> None:
+        with self._lock:
+            self.breaches[slo] = self.breaches.get(slo, 0) + 1
+            self.breached = True
+        self._c_breach.inc(1, slo=slo)
+        logging.warning(
+            "SLO breach%s: %s — %s",
+            f" (tenant {self.tenant})" if self.tenant else "", slo, detail,
+        )
+
+    def on_record(self, rec: dict) -> None:
+        p = self.policy
+        if p.round_s is not None and rec["t_s"] > p.round_s:
+            self._breach(
+                "round_s",
+                f"round {rec['round']} took {rec['t_s']:.3f}s "
+                f"(slo {p.round_s}s)",
+            )
+        if p.p95_round_s is not None:
+            if self.flight.size() >= p.min_samples:
+                p95 = self.flight.percentiles().get("round", {}).get("p95")
+                if p95 is not None and p95 > p.p95_round_s:
+                    self._breach(
+                        "p95_round_s",
+                        f"rolling p95 {p95:.3f}s (slo {p.p95_round_s}s)",
+                    )
+        if p.min_rounds_per_s is not None:
+            rate = self.flight.rounds_per_s()
+            if (
+                rate is not None
+                and self.flight.size() >= p.min_samples
+                and rate < p.min_rounds_per_s
+            ):
+                self._breach(
+                    "min_rounds_per_s",
+                    f"rolling {rate:.3f} r/s (slo {p.min_rounds_per_s})",
+                )
+        if p.max_recompiles is not None and "recompiles" in rec:
+            with self._lock:
+                self._recompiles_cum += rec["recompiles"]
+                over = (
+                    self._recompiles_cum > p.max_recompiles
+                    and not self._recompile_breached
+                )
+                if over:
+                    self._recompile_breached = True
+                cum = self._recompiles_cum
+            if over:
+                self._breach(
+                    "max_recompiles",
+                    f"{cum} scope-attributed compiles "
+                    f"(slo {p.max_recompiles})",
+                )
+        if (
+            p.straggler_frac is not None
+            and rec.get("stragglers")
+            and rec.get("clients_seen")
+        ):
+            # straggler set and denominator are BOTH fleet-wide (the
+            # registry's known clients) — dividing by the per-round
+            # cohort would let the fraction exceed 1 and breach
+            # spuriously on large fleets with small cohorts
+            frac = rec["stragglers"] / rec["clients_seen"]
+            if frac > p.straggler_frac:
+                self._breach(
+                    "straggler_frac",
+                    f"{rec['stragglers']}/{rec['clients_seen']} of the "
+                    f"fleet are stragglers (slo {p.straggler_frac})",
+                )
+
+    # -- reporting -----------------------------------------------------------
+
+    def breach_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.breaches)
+
+    def summary_row(self) -> dict:
+        """Flat ``slo/*`` keys for the tenant's summary row."""
+        with self._lock:
+            row = {
+                "slo/breached": int(self.breached),
+                "slo/breaches_total": sum(self.breaches.values()),
+            }
+            for slo, n in sorted(self.breaches.items()):
+                row[f"slo/{slo}"] = n
+        return row

@@ -411,11 +411,16 @@ class SupervisedSession:
 
     @property
     def health_state(self) -> str:
-        """healthy (never restarted) | degraded (running/finished with
-        restarts burned) | failed (quarantined or terminal error)."""
+        """healthy | degraded (restarts burned, OR an SLO breached —
+        serve/slo.py: a breach degrades WITHOUT consuming restart
+        budget) | failed (quarantined or terminal error)."""
         if self.state == "failed":
             return "failed"
-        return "degraded" if self.restarts else "healthy"
+        slo_breached = bool(
+            self.session is not None
+            and getattr(self.session, "slo_breached", False)
+        )
+        return "degraded" if (self.restarts or slo_breached) else "healthy"
 
     def _supervisor_row(self) -> dict:
         return {
@@ -434,6 +439,7 @@ class SupervisedSession:
             else {"name": self.name}
         )
         row["state"] = self.state
+        row["health"] = self.health_state  # supervisor view wins
         row.update(self._supervisor_row())
         return row
 
@@ -443,12 +449,25 @@ class SupervisedSession:
             else {"state": self.state}
         )
         row["state"] = self.state
+        row["health"] = self.health_state
         row.update(self._supervisor_row())
         return row
 
     @property
     def server(self):
         return self.session.server if self.session is not None else None
+
+    @property
+    def flight(self):
+        """The tenant's flight recorder — scope-resident, so it survives
+        restart attempts (one tenant, one flight history)."""
+        if self.scope is not None and getattr(self.scope, "flight", None):
+            return self.scope.flight
+        return self.session.flight if self.session is not None else None
+
+    @property
+    def device(self):
+        return self.session.device if self.session is not None else None
 
     @property
     def history(self):

@@ -18,6 +18,12 @@ many bytes crossed each transport":
 - :mod:`fedml_tpu.telemetry.health` — server-side per-client health
   registry (last-seen round, participation, train-time percentiles,
   straggler flag) fed from the span stream or explicit observations.
+- :mod:`fedml_tpu.telemetry.flight` — round flight recorder: a bounded
+  last-K-rounds ring folding the span stream into one record per round
+  (phase wall times, comm/compile deltas, straggler spread), with
+  rolling p50/p95 gauges and a ``flight/*`` summary block — the live
+  substrate behind the serve layer's introspection endpoints and SLO
+  watchdogs.
 - :mod:`fedml_tpu.telemetry.prometheus` — stdlib-only ``/metrics`` HTTP
   endpoint (off by default; CLI flag ``--prom_port``).
 - :mod:`fedml_tpu.telemetry.scope` — thread-scoped
@@ -31,6 +37,7 @@ Everything here is stdlib-only on purpose: telemetry must be importable
 before (and without) jax, and must never add a hot-path dependency."""
 
 from fedml_tpu.telemetry.comm import CommMeter, get_comm_meter
+from fedml_tpu.telemetry.flight import FlightRecorder
 from fedml_tpu.telemetry.health import ClientHealthRegistry
 from fedml_tpu.telemetry.metrics import (
     Counter,
@@ -60,6 +67,7 @@ __all__ = [
     "ClientHealthRegistry",
     "CommMeter",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",

@@ -267,6 +267,13 @@ class ClientHealthRegistry:
         with self._lock:
             return sorted(self._clients.known_ids())
 
+    def known_client_count(self) -> int:
+        """Distinct clients observed (active + spilled) — the cheap
+        counterpart of ``len(clients_seen())`` for per-round callers
+        (the flight recorder's fold path must not sort the active set)."""
+        with self._lock:
+            return self._known_count()
+
     def last_seen_round(self, client_id: int) -> int:
         with self._lock:
             rec = self._clients.get(int(client_id))

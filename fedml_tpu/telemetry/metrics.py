@@ -305,8 +305,10 @@ class TenantedRegistryView:
     registries — what ONE Prometheus exporter serves for a multi-tenant
     federation service (fedml_tpu/serve/).
 
-    Tenant registries' samples get a ``tenant="<name>"`` label injected;
-    the base registry's samples stay unlabeled. The exposition format
+    Tenant registries' samples get a ``tenant="<name>"`` label injected
+    (plus any per-tenant ``extra`` labels — the serve layer attaches
+    ``device="tpu|cpu|..."`` for the ROADMAP multi-device placement
+    work); the base registry's samples stay unlabeled. The exposition format
     requires each metric name to appear in exactly one HELP/TYPE block,
     so rendering groups samples across registries by metric name (N
     tenants recording ``fedml_comm_bytes_sent_total`` yield one block
@@ -322,11 +324,19 @@ class TenantedRegistryView:
         self._lock = threading.Lock()
         self._base = base
         self._label = label
-        self._tenants: Dict[str, MetricsRegistry] = {}
+        self._tenants: Dict[str, Tuple[MetricsRegistry, Dict[str, str]]] = {}
 
-    def add_tenant(self, name: str, registry: MetricsRegistry) -> None:
+    def add_tenant(
+        self,
+        name: str,
+        registry: MetricsRegistry,
+        extra: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Register a tenant registry; ``extra`` label pairs (e.g.
+        ``{"device": "tpu"}``) ride alongside the tenant label on every
+        sample."""
         with self._lock:
-            self._tenants[str(name)] = registry
+            self._tenants[str(name)] = (registry, dict(extra or {}))
 
     def remove_tenant(self, name: str) -> None:
         with self._lock:
@@ -336,12 +346,19 @@ class TenantedRegistryView:
         with self._lock:
             return sorted(self._tenants)
 
+    @staticmethod
+    def _fragment(label: str, name: str, extra: Dict[str, str]) -> str:
+        parts = [f'{label}="{_escape_label(name)}"'] + [
+            f'{k}="{_escape_label(v)}"' for k, v in sorted(extra.items())
+        ]
+        return ",".join(parts)
+
     def render(self) -> str:
         with self._lock:
             sources = [("", self._base)] if self._base is not None else []
             sources += [
-                (f'{self._label}="{_escape_label(name)}"', reg)
-                for name, reg in sorted(self._tenants.items())
+                (self._fragment(self._label, name, extra), reg)
+                for name, (reg, extra) in sorted(self._tenants.items())
             ]
         groups: Dict[str, tuple] = {}
         for extra, reg in sources:

@@ -1,42 +1,94 @@
 """Stdlib-only Prometheus exporter — a ``/metrics`` text-exposition
-endpoint over ``http.server``, off by default (CLI flag ``--prom_port``).
+endpoint over ``http.server``, off by default (CLI flag ``--prom_port``),
+plus a small read-only route table for JSON introspection endpoints
+(fedml_tpu/serve/introspect.py registers ``/status``, ``/tenants/<name>``,
+``/compile`` and a tenant-aware ``/healthz`` on the SAME server — one
+port, one ops surface).
 
 No prometheus_client dependency: the registry (telemetry/metrics.py)
 renders the text format itself. The server runs on a daemon thread and
 binds loopback by default — an experiment driver is not a public service;
 point a Prometheus scrape job (or ``curl``) at
 ``http://127.0.0.1:<port>/metrics``. ``port=0`` binds an ephemeral port
-(tests read ``exporter.port`` after ``start()``)."""
+(tests read ``exporter.port`` after ``start()``).
+
+Routing contract: ``/metrics`` (and the legacy ``/`` alias) serve the
+exposition; registered routes answer their exact path — a route key
+ending in ``/`` matches as a prefix (``/tenants/`` serves
+``/tenants/<name>``); EVERYTHING else is 404 (never a silent metrics
+answer — the server hosts multiple endpoints now). Route callables take
+the request path and return ``(status, payload)`` where a dict/list
+payload is JSON-encoded; a raising route answers 500 without taking the
+server down."""
 
 from __future__ import annotations
 
+import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+Route = Callable[[str], Tuple[int, object]]
+
 
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry  # injected per-server subclass
+    routes: Dict[str, Route]  # injected per-server subclass (shared dict)
+
+    def _send(self, status: int, ctype: str, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _route_for(self, path: str) -> Optional[Route]:
+        fn = self.routes.get(path)
+        if fn is not None:
+            return fn
+        # snapshot: add_route may mutate the live dict from another
+        # thread mid-scrape (it is documented to work after start())
+        for prefix, cand in list(self.routes.items()):
+            if (
+                prefix.endswith("/")
+                and path.startswith(prefix)
+                and len(path) > len(prefix)
+            ):
+                return cand
+        return None
 
     def do_GET(self):  # noqa: N802 — http.server API
-        if self.path.split("?", 1)[0] in ("/metrics", "/"):
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
             body = self.registry.render().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-        elif self.path == "/healthz":
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
-            self.end_headers()
-            self.wfile.write(b"ok\n")
+            return self._send(200, CONTENT_TYPE, body)
+        fn = self._route_for(path)
+        if fn is None:
+            if path == "/healthz":
+                # liveness default when no introspection routes are
+                # installed (the single-run exporter) — the serve layer
+                # overrides this with the tenant-aware probe
+                return self._send(200, "text/plain", b"ok\n")
+            return self.send_error(404)
+        try:
+            status, payload = fn(path)
+        except Exception:  # noqa: BLE001 — a route must not kill the server
+            logging.exception("introspection route %s failed", path)
+            return self.send_error(500)
+        if isinstance(payload, (dict, list)):
+            body = json.dumps(payload, default=str).encode("utf-8")
+            ctype = "application/json"
+        elif isinstance(payload, bytes):
+            body, ctype = payload, "text/plain; charset=utf-8"
         else:
-            self.send_error(404)
+            body = str(payload).encode("utf-8")
+            ctype = "text/plain; charset=utf-8"
+        self._send(int(status), ctype, body)
 
     def log_message(self, fmt, *args):  # silence per-scrape stderr lines
         pass
@@ -50,12 +102,22 @@ class PrometheusExporter:
         port: int = 9464,
         addr: str = "127.0.0.1",
         registry: Optional[MetricsRegistry] = None,
+        routes: Optional[Dict[str, Route]] = None,
     ):
         self.addr = addr
         self._requested_port = int(port)
         self.registry = registry or get_registry()
+        # live dict shared with the handler class: add_route works before
+        # AND after start()
+        self.routes: Dict[str, Route] = dict(routes or {})
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def add_route(self, path: str, fn: Route) -> "PrometheusExporter":
+        """Register ``fn(path) -> (status, payload)`` at ``path`` (a
+        trailing ``/`` makes it a prefix route)."""
+        self.routes[str(path)] = fn
+        return self
 
     @property
     def port(self) -> int:
@@ -68,11 +130,13 @@ class PrometheusExporter:
         if self._server is not None:
             return self
         registry = self.registry
+        routes = self.routes
 
         class Handler(_Handler):
             pass
 
         Handler.registry = registry
+        Handler.routes = routes
         self._server = ThreadingHTTPServer(
             (self.addr, self._requested_port), Handler
         )

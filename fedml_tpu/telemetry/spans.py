@@ -203,6 +203,12 @@ class Tracer:
             if fn in self._listeners:
                 self._listeners.remove(fn)
 
+    def listeners(self) -> List[Callable[[SpanEvent], None]]:
+        """Snapshot of the subscribed listeners (the supported read
+        accessor — consumers must not reach into the private list)."""
+        with self._lock:
+            return list(self._listeners)
+
     def events(self) -> List[SpanEvent]:
         with self._lock:
             return list(self._events)

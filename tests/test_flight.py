@@ -1,0 +1,394 @@
+"""Round flight recorder (telemetry/flight.py): bounded ring semantics,
+byte budget, span-stream folding, and sim-vs-transport record parity."""
+
+import json
+
+from fedml_tpu.telemetry.flight import _RECORD_BYTES, FlightRecorder
+from fedml_tpu.telemetry.metrics import MetricsRegistry
+from fedml_tpu.telemetry.spans import Tracer
+
+
+def _drive_round(tracer, r, clients=4, with_eval=False):
+    with tracer.span("select", round=r, policy="uniform", clients=clients):
+        pass
+    with tracer.span("round", round=r):
+        with tracer.span("broadcast", round=r, clients=clients):
+            pass
+        with tracer.span("local_train", round=r, clients=clients):
+            pass
+        with tracer.span("aggregate", round=r, n_uploads=clients):
+            pass
+        if with_eval:
+            with tracer.span("eval", round=r):
+                pass
+
+
+# ---------------------------------------------------------------------------
+# bounded-ring / byte-budget contract (the acceptance-criteria pin)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_stays_flat_over_500_rounds():
+    """The K-round ring must never grow with round count: 500 folded
+    rounds leave exactly `capacity` records, an empty pending table, and
+    a flat serialized footprint between the 100-round and 500-round
+    marks."""
+    tracer = Tracer()
+    reg = MetricsRegistry()
+    rec = FlightRecorder(max_rounds=32, registry=reg)
+    rec.attach(tracer)
+    size_at_100 = None
+    for r in range(500):
+        _drive_round(tracer, r)
+        if r == 99:
+            size_at_100 = len(json.dumps(rec.tail()))
+    assert rec.rounds_folded == 500
+    tail = rec.tail()
+    assert len(tail) == rec.capacity == 32
+    # ring holds exactly the LAST K rounds
+    assert [t["round"] for t in tail] == list(range(468, 500))
+    # flat memory: the serialized ring at 500 rounds is the size it was
+    # at 100 to within digit-count noise (record shape is fixed — the
+    # same phases every round; only numerals like "round": 468 vs 68
+    # differ)
+    assert abs(len(json.dumps(tail)) - size_at_100) < 0.02 * size_at_100
+    assert rec.approx_bytes() == 32 * _RECORD_BYTES
+    # nothing left half-open
+    assert not rec._pending
+    # gauges exported
+    assert reg.get("fedml_flight_rounds_folded").value() == 500
+    assert reg.get("fedml_flight_round_seconds").value(q="p50") > 0
+
+
+def test_byte_budget_tightens_capacity_below_max_rounds():
+    rec = FlightRecorder(max_rounds=10_000, budget_bytes=8 * _RECORD_BYTES)
+    assert rec.capacity == 8
+    # and the round-count bound wins when IT is tighter
+    rec2 = FlightRecorder(max_rounds=4, budget_bytes=1 << 20)
+    assert rec2.capacity == 4
+
+
+def test_pending_table_is_bounded_for_abandoned_rounds():
+    """Phase spans whose round never folds (fedbuff dispatch tags, a
+    crashed attempt mid-round) must not accumulate open state."""
+    tracer = Tracer()
+    rec = FlightRecorder(max_rounds=8)
+    rec.attach(tracer)
+    for r in range(200):  # broadcast only — the round never completes
+        with tracer.span("broadcast", round=r):
+            pass
+    assert len(rec._pending) <= 16
+    assert rec.rounds_folded == 0
+
+
+# ---------------------------------------------------------------------------
+# folding semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fold_captures_phases_cohort_and_straggler_spread():
+    tracer = Tracer()
+    rec = FlightRecorder(max_rounds=8)
+    rec.attach(tracer)
+    with tracer.span("round", round=0):
+        with tracer.span("broadcast", round=0, clients=3):
+            pass
+        # three client threads' local_train spans fold into spread stats
+        for _ in range(3):
+            with tracer.span("local_train", round=0):
+                pass
+        with tracer.span("aggregate", round=0, n_uploads=3):
+            pass
+    r = rec.last()
+    assert r["round"] == 0
+    assert set(r["phases"]) == {"broadcast", "local_train", "aggregate"}
+    assert r["clients"] == 3
+    assert r["train_n"] == 3
+    assert r["train_p50_s"] is not None
+    assert r["train_max_s"] >= r["train_p50_s"]
+    assert r["t_s"] > 0
+
+
+def test_late_eval_merges_into_folded_record():
+    """The vmap sim logs eval from its deferred metrics path — after the
+    round span already folded. The phase must merge into the ring record
+    instead of opening a phantom pending round."""
+    tracer = Tracer()
+    rec = FlightRecorder(max_rounds=8)
+    rec.attach(tracer)
+    _drive_round(tracer, 0)
+    with tracer.span("eval", round=0):
+        pass
+    assert "eval" in rec.last()["phases"]
+    assert not rec._pending
+
+
+def test_server_step_spans_fold_as_async_records():
+    """FedBuff has no round lifecycle: each server_step span IS one
+    record (keyed by version)."""
+    tracer = Tracer()
+    rec = FlightRecorder(max_rounds=8)
+    rec.attach(tracer)
+    for v in range(5):
+        with tracer.span("server_step", version=v, n_deltas=2,
+                         staleness_max=0):
+            pass
+    assert rec.rounds_folded == 5
+    assert rec.last()["phases"].get("server_step") is not None
+
+
+def test_comm_and_recompile_deltas_are_per_round():
+    class FakeMeter:
+        def __init__(self):
+            self.bytes = 0
+
+        def snapshot(self):
+            return {
+                "bytes_sent": {"m": self.bytes},
+                "bytes_received": {"m": self.bytes},
+                "messages_sent": {"m": self.bytes // 100},
+                "send_retries": {},
+            }
+
+    meter = FakeMeter()
+    compiles = {"n": 0}
+    tracer = Tracer()
+    rec = FlightRecorder(
+        max_rounds=8, comm_meter=meter, recompiles_fn=lambda: compiles["n"]
+    )
+    rec.attach(tracer)
+    meter.bytes = 1000
+    _drive_round(tracer, 0)
+    assert rec.last()["comm_bytes_sent"] == 1000
+    meter.bytes = 1500
+    compiles["n"] = 2
+    _drive_round(tracer, 1)
+    assert rec.last()["comm_bytes_sent"] == 500  # the DELTA, not the total
+    assert rec.last()["recompiles"] == 2
+    _drive_round(tracer, 2)
+    assert rec.last()["recompiles"] == 0
+
+
+def test_fold_listener_fires_and_errors_are_contained():
+    tracer = Tracer()
+    rec = FlightRecorder(max_rounds=8)
+    rec.attach(tracer)
+    seen = []
+
+    def boom(record):
+        seen.append(record["round"])
+        raise RuntimeError("listener bug")
+
+    rec.add_listener(boom)
+    _drive_round(tracer, 0)
+    _drive_round(tracer, 1)
+    assert seen == [0, 1]
+    assert rec.rounds_folded == 2  # the listener's crash stayed contained
+
+
+def test_attach_is_idempotent_and_switchable():
+    t1, t2 = Tracer(), Tracer()
+    rec = FlightRecorder(max_rounds=4)
+    rec.attach(t1)
+    rec.attach(t1)  # no double-subscription
+    _drive_round(t1, 0)
+    assert rec.rounds_folded == 1
+    rec.attach(t2)  # switching detaches from t1
+    _drive_round(t1, 1)
+    assert rec.rounds_folded == 1
+    _drive_round(t2, 2)
+    assert rec.rounds_folded == 2
+
+
+def test_begin_attempt_fences_restarted_rounds():
+    """The supervised-restart contract: a crashed attempt's partial
+    round record stays as crash history, and the re-run of that round
+    folds a FRESH record — its phases never merge into the dead one."""
+    tracer = Tracer()
+    rec = FlightRecorder(max_rounds=8)
+    rec.attach(tracer)
+    # attempt 1: round 0 completes, round 1 crashes mid-round (the round
+    # span still records on exception — only broadcast ran)
+    _drive_round(tracer, 0)
+    with tracer.span("broadcast", round=1):
+        pass
+    with tracer.span("round", round=1):
+        pass  # truncated: no local_train/aggregate
+    crashed = rec.tail()[-1]
+    assert crashed["round"] == 1
+    assert set(crashed["phases"]) == {"broadcast"}
+    # attempt 2 (supervisor rebuild): fence, then re-run round 1 fully
+    rec.begin_attempt()
+    _drive_round(tracer, 1)
+    tail = rec.tail()
+    # the crashed partial is untouched history; the re-run is a new record
+    assert [t["round"] for t in tail] == [0, 1, 1]
+    assert set(tail[1]["phases"]) == {"broadcast"}  # still the crash shape
+    assert {"broadcast", "local_train", "aggregate"} <= set(
+        tail[2]["phases"]
+    )
+    # late merges target the NEW record for that round, not the sealed one
+    with tracer.span("eval", round=1):
+        pass
+    tail = rec.tail()
+    assert "eval" in tail[2]["phases"] and "eval" not in tail[1]["phases"]
+    assert not rec._pending
+
+
+def test_rounds_per_s_excludes_the_restart_gap():
+    """A supervised restart's crash + backoff gap must not depress the
+    rolling rate (it would fire spurious slo_min_rounds_per_s breaches
+    for up to K rounds after every recovery)."""
+    clock = {"t": 0.0}
+    tracer = Tracer()
+    rec = FlightRecorder(max_rounds=16, clock=lambda: clock["t"])
+    rec.attach(tracer)
+    for r in range(3):  # attempt 1: one round per second
+        clock["t"] += 1.0
+        _fold_round(tracer, r)
+    rec.begin_attempt()
+    clock["t"] += 120.0  # the crash + backoff gap
+    for r in range(3, 6):  # attempt 2: still one round per second
+        clock["t"] += 1.0
+        _fold_round(tracer, r)
+    # only the current attempt's records count: 2 intervals / 2 s = 1 r/s
+    assert rec.rounds_per_s() == 1.0
+    assert rec.summary_row()["flight/rounds_per_s"] == 1.0
+
+
+def _fold_round(tracer, r):
+    with tracer.span("round", round=r):
+        pass
+
+
+def test_plain_unscoped_session_skips_recording_entirely():
+    """No scope, no ambient recorder, no SLOs -> no flight recorder: the
+    wrapper entry points must not pay per-round fold work (or pollute
+    the global registry's gauges) for data nobody reads."""
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+    from fedml_tpu.serve import FedSession
+
+    data = synthetic_classification(
+        num_clients=4, num_classes=3, feat_shape=(10,),
+        samples_per_client=16, partition_method="homo", seed=0,
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=4, client_num_per_round=2, comm_round=1,
+            epochs=1, frequency_of_the_test=100,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1), seed=0,
+    )
+    s = FedSession(
+        cfg, data, create_model("lr", "synthetic", (10,), 3), name="plain"
+    )
+    s.run()
+    assert s.flight is None
+    assert "flight/rounds_folded" not in s.summary_row()
+
+
+def test_session_adopts_ambient_recorder_instead_of_double_folding():
+    """A CLI run with telemetry attaches ONE recorder to the global
+    tracer; the wrapper FedSession must adopt it, not stack a second one
+    that double-folds every round and fights over the same gauges."""
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+    from fedml_tpu.serve import FedSession
+    from fedml_tpu.telemetry import get_global_tracer
+
+    tracer = get_global_tracer()
+    cli_rec = FlightRecorder(max_rounds=16)
+    cli_rec.attach(tracer)
+    try:
+        data = synthetic_classification(
+            num_clients=4, num_classes=3, feat_shape=(10,),
+            samples_per_client=16, partition_method="homo", seed=0,
+        )
+        cfg = RunConfig(
+            data=DataConfig(batch_size=8),
+            fed=FedConfig(
+                client_num_in_total=4, client_num_per_round=2,
+                comm_round=2, epochs=1, frequency_of_the_test=100,
+            ),
+            train=TrainConfig(client_optimizer="sgd", lr=0.1), seed=0,
+        )
+        s = FedSession(
+            cfg, data, create_model("lr", "synthetic", (10,), 3),
+            name="adopt",
+        )
+        s.run()
+        assert s.flight is cli_rec  # adopted, not duplicated
+        assert cli_rec.rounds_folded == 2  # each round folded ONCE
+    finally:
+        cli_rec.detach()
+
+
+def test_from_config_reads_population_bounds():
+    from fedml_tpu.config import PopulationConfig, RunConfig
+
+    cfg = RunConfig(
+        population=PopulationConfig(flight_rounds=5, flight_budget_bytes=1 << 20)
+    )
+    assert FlightRecorder.from_config(cfg).capacity == 5
+
+
+# ---------------------------------------------------------------------------
+# sim-vs-transport parity on the shared record fields
+# ---------------------------------------------------------------------------
+
+_SHARED_FIELDS = {
+    "round", "t_s", "ts", "phases", "clients", "train_n", "train_p50_s",
+    "train_max_s", "stragglers", "clients_seen",
+}
+
+
+def test_sim_and_transport_records_share_the_core_schema():
+    """A vmap-sim run and a loopback transport run must produce flight
+    records with the same core fields (values differ — the schema is the
+    parity contract the introspection endpoints consume)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import create_model
+    from fedml_tpu.serve import FedSession
+    from fedml_tpu.telemetry import TelemetryScope
+
+    data = synthetic_classification(
+        num_clients=6, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=0,
+    )
+    model = create_model("lr", "synthetic", (10,), 3)
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=3, comm_round=2,
+            epochs=1, frequency_of_the_test=100,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+    # transport: the session owns its recorder (scope-resident)
+    scope = TelemetryScope(tenant="parity")
+    FedSession(cfg, data, model, name="parity", scope=scope).run()
+    transport_rec = scope.flight.last()
+    # sim: attach a recorder to the global tracer the API records into
+    from fedml_tpu.telemetry import get_global_tracer
+
+    sim_flight = FlightRecorder(max_rounds=8)
+    sim_flight.attach(get_global_tracer())
+    try:
+        FedAvgAPI(cfg, data, model).train()
+    finally:
+        sim_flight.detach()
+    sim_rec = sim_flight.last()
+    assert sim_rec is not None and transport_rec is not None
+    assert _SHARED_FIELDS <= set(sim_rec)
+    assert _SHARED_FIELDS <= set(transport_rec)
+    for rec in (sim_rec, transport_rec):
+        assert rec["t_s"] > 0
+        assert rec["clients"] == 3
+        assert "broadcast" in rec["phases"] and "local_train" in rec["phases"]

@@ -1,0 +1,334 @@
+"""Live introspection API (serve/introspect.py + the exporter route
+table): /status, /tenants/<name>, /compile, /healthz contracts, unknown
+paths 404, scrape-under-churn validity, and the status CLI printer."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+from fedml_tpu.serve import FederationServer
+from fedml_tpu.telemetry import (
+    MetricsRegistry,
+    PrometheusExporter,
+    TenantedRegistryView,
+)
+
+
+def _data():
+    return synthetic_classification(
+        num_clients=6, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=0,
+    )
+
+
+def _model():
+    return create_model("lr", "synthetic", (10,), 3)
+
+
+def _cfg(comm_round=3, **fed_kw):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=3,
+            comm_round=comm_round, epochs=1, frequency_of_the_test=100,
+            **fed_kw,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _spin(pred, what, timeout=60.0):
+    t0 = time.monotonic()
+    while not pred():
+        assert time.monotonic() - t0 < timeout, f"timed out: {what}"
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# endpoint contracts against a live service
+# ---------------------------------------------------------------------------
+
+
+def test_status_tenants_compile_healthz_contracts(tmp_path):
+    data, model = _data(), _model()
+    srv = FederationServer(prom_port=0)
+    cp = str(tmp_path / "ck")
+    srv.create_session(
+        "intro_a", _cfg(comm_round=40), data, model, algorithm="fedavg",
+        checkpoint_path=cp, checkpoint_every=1,
+    )
+    srv.create_session("intro_b", _cfg(comm_round=3), data, model)
+    srv.start()
+    port = srv.prom_port
+    try:
+        a = srv.session("intro_a")
+        # mid-flight: rounds monotonically advancing in /status
+        _spin(lambda: a.server is not None and a.server.round_idx >= 2,
+              "intro_a progress")
+        st1 = _get(port, "/status")[1]
+        assert st1["tenant_count"] == 2
+        brief = st1["tenants"]["intro_a"]
+        assert brief["state"] == "running"
+        assert brief["health"] == "healthy"
+        assert brief["rounds_target"] == 40
+        assert brief["device"]
+        r1 = brief["rounds_completed"]
+        _spin(lambda: a.server.round_idx > r1 + 1, "rounds advancing")
+        st2 = _get(port, "/status")[1]
+        assert st2["tenants"]["intro_a"]["rounds_completed"] > r1
+        assert st2["uptime_s"] >= st1["uptime_s"]
+        # /tenants/<name>: flight tail + health + checkpoint freshness
+        status, doc = _get(port, "/tenants/intro_a")
+        assert status == 200
+        assert doc["tenant"] == "intro_a"
+        assert doc["status"]["name"] == "intro_a"
+        assert len(doc["flight"]["tail"]) >= 1
+        rec = doc["flight"]["tail"][-1]
+        assert {"round", "t_s", "phases"} <= set(rec)
+        assert "broadcast" in rec["phases"]
+        assert doc["flight"]["percentiles"]["round"]["p50"] > 0
+        assert doc["health"]["clients_seen"] >= 1
+        assert doc["checkpoint"]["exists"]
+        assert doc["checkpoint"]["age_s"] is not None
+        # /compile: the process-wide compile story
+        status, comp = _get(port, "/compile")
+        assert status == 200
+        assert "backend_compiles" in comp and "programs" in comp
+        # /healthz: every tenant non-failed -> 200
+        status, hz = _get(port, "/healthz")
+        assert status == 200 and hz["status"] == "ok"
+        # unknown paths are 404, not a silent metrics answer
+        for path in ("/nope", "/tenants/", "/status/extra"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10
+                )
+            assert ei.value.code == 404, path
+        # unknown tenant is 404 too
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tenants/ghost", timeout=10
+            )
+        assert ei.value.code == 404
+        srv.drain()
+        srv.wait()
+    finally:
+        srv.close()
+
+
+def test_healthz_goes_503_when_a_tenant_fails():
+    data, model = _data(), _model()
+
+    def crash(row):
+        if "t_s" in row:
+            raise RuntimeError("tenant bug")
+
+    srv = FederationServer(prom_port=0)
+    srv.create_session("doomed", _cfg(comm_round=3), data, model,
+                       log_fn=crash)
+    srv.start()
+    results = srv.wait()
+    assert not results["doomed"]["ok"]
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.prom_port}/healthz", timeout=10
+        )
+        raise AssertionError("healthz should be 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        doc = json.loads(e.read().decode())
+        assert doc["failed_tenants"] == ["doomed"]
+    srv.close()
+
+
+def test_tenant_metrics_carry_device_label():
+    data, model = _data(), _model()
+    srv = FederationServer(prom_port=0)
+    srv.create_session("dev_label", _cfg(comm_round=2), data, model)
+    srv.start()
+    srv.wait()
+    body = srv.render_metrics()
+    lines = [
+        ln for ln in body.splitlines()
+        if 'tenant="dev_label"' in ln
+    ]
+    assert lines
+    assert all('device="' in ln for ln in lines), lines[:3]
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# exporter route table + scrape-under-churn (the satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_unknown_paths_404_and_routes_answer():
+    reg = MetricsRegistry()
+    reg.counter("probe_total", "probe").inc()
+    exp = PrometheusExporter(port=0, registry=reg)
+    exp.add_route("/custom", lambda path: (200, {"hello": "world"}))
+    exp.add_route("/boom", lambda path: 1 / 0)
+    with exp:
+        port = exp.port
+        status, doc = _get(port, "/custom")
+        assert (status, doc) == (200, {"hello": "world"})
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert "probe_total 1.0" in body
+        # "/" stays a metrics alias (legacy scrape configs)
+        root = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        assert "probe_total 1.0" in root
+        # default healthz when no tenant-aware route overrides it
+        hz = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+        assert hz.status == 200
+        for path in ("/anything", "/metricsx", "/custom/extra"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10)
+            assert ei.value.code == 404, path
+        # a raising route answers 500 without killing the server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/boom")
+        assert ei.value.code == 500
+        assert urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz").status == 200
+
+
+def _assert_valid_exposition(body):
+    """Every sample line must parse and belong to exactly one HELP/TYPE
+    block — a torn render would interleave blocks or truncate lines."""
+    assert body.endswith("\n")
+    seen_types = {}
+    current = None
+    for ln in body.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            current = ln.split()[2]
+        elif ln.startswith("# TYPE "):
+            name = ln.split()[2]
+            assert name == current, (name, current)
+            assert name not in seen_types, f"duplicate TYPE block {name}"
+            seen_types[name] = True
+        else:
+            metric = ln.split("{", 1)[0].split(" ", 1)[0]
+            base = current
+            assert base is not None and metric.startswith(base), ln
+            # value parses as a float
+            float(ln.rsplit(" ", 1)[1])
+
+
+def test_concurrent_scrape_during_tenant_churn_renders_valid_exposition():
+    """The satellite fix's second half: a scrape racing add_tenant/
+    remove_tenant must always serve a structurally valid exposition (no
+    torn TenantedRegistryView output)."""
+    base = MetricsRegistry()
+    base.counter("base_total", "base").inc()
+    view = TenantedRegistryView(base=base)
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            name = f"t{i % 7}"
+            reg = MetricsRegistry()
+            reg.counter("churn_total", "per-tenant", ("k",)).inc(k="x")
+            reg.gauge("churn_gauge", "per-tenant").set(i)
+            view.add_tenant(name, reg, extra={"device": "cpu"})
+            if i % 3 == 0:
+                view.remove_tenant(f"t{(i + 3) % 7}")
+            i += 1
+
+    with PrometheusExporter(port=0, registry=view) as exp:
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 3.0
+            scrapes = 0
+            while time.monotonic() < deadline:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/metrics", timeout=10
+                ).read().decode()
+                _assert_valid_exposition(body)
+                scrapes += 1
+            assert scrapes > 10
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# status CLI
+# ---------------------------------------------------------------------------
+
+
+def test_render_status_table_is_aligned_and_complete():
+    from fedml_tpu.serve.introspect import render_status
+
+    doc = {
+        "uptime_s": 12.3,
+        "tenant_count": 2,
+        "tenants": {
+            "alpha": {
+                "state": "running", "health": "healthy",
+                "rounds_completed": 5, "rounds_target": 40,
+                "restarts": 0, "current_round_age_s": 0.12,
+                "rounds_per_s": 8.5, "device": "tpu",
+            },
+            "beta": {
+                "state": "done", "health": "degraded",
+                "rounds_completed": 3, "rounds_target": 3,
+                "restarts": 1, "slo_breaches": {"round_s": 2},
+                "device": "tpu",
+            },
+        },
+    }
+    out = render_status(doc)
+    lines = out.splitlines()
+    assert "2 tenant(s)" in lines[0]
+    assert lines[1].startswith("TENANT")
+    assert any("alpha" in ln and "5/40" in ln and "8.50" in ln
+               for ln in lines)
+    assert any("beta" in ln and "degraded (slo:2)" in ln for ln in lines)
+
+
+def test_status_cli_against_live_service():
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.introspect import status_main
+
+    data, model = _data(), _model()
+    srv = FederationServer(prom_port=0)
+    srv.create_session("cli_t", _cfg(comm_round=2), data, model)
+    srv.start()
+    srv.wait()
+    url = f"http://127.0.0.1:{srv.prom_port}"
+    r = CliRunner().invoke(status_main, ["--url", url])
+    assert r.exit_code == 0, r.output
+    assert "cli_t" in r.output and "TENANT" in r.output
+    r = CliRunner().invoke(status_main, ["--url", url, "--tenant", "cli_t"])
+    assert r.exit_code == 0, r.output
+    doc = json.loads(r.output)
+    assert doc["tenant"] == "cli_t"
+    assert "flight" in doc
+    srv.close()
+    # connection errors are a clean CLI failure, not a traceback
+    r = CliRunner().invoke(status_main, ["--url", url])
+    assert r.exit_code != 0
+    assert "could not reach" in r.output

@@ -1,0 +1,297 @@
+"""SLO watchdogs (serve/slo.py): breach detection against the flight
+recorder, degraded-not-restarted supervision, spec parsing, and the
+serve CLI's --slo_strict exit code."""
+
+import json
+import time
+
+import pytest
+
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+from fedml_tpu.serve import FederationServer, RestartPolicy, SloPolicy
+from fedml_tpu.serve.slo import SloWatchdog
+from fedml_tpu.telemetry.flight import FlightRecorder
+from fedml_tpu.telemetry.metrics import MetricsRegistry
+from fedml_tpu.telemetry.spans import Tracer
+
+
+def _data():
+    return synthetic_classification(
+        num_clients=6, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=0,
+    )
+
+
+def _model():
+    return create_model("lr", "synthetic", (10,), 3)
+
+
+def _cfg(comm_round=3, **fed_kw):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=3,
+            comm_round=comm_round, epochs=1, frequency_of_the_test=100,
+            **fed_kw,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=0,
+    )
+
+
+def _fold(tracer, r, sleep_s=0.0):
+    with tracer.span("round", round=r):
+        if sleep_s:
+            time.sleep(sleep_s)
+
+
+# ---------------------------------------------------------------------------
+# watchdog unit behavior (pure spans, no federation)
+# ---------------------------------------------------------------------------
+
+
+def test_round_s_breach_counts_per_offending_round():
+    tracer = Tracer()
+    reg = MetricsRegistry()
+    flight = FlightRecorder(max_rounds=8, registry=reg)
+    flight.attach(tracer)
+    wd = SloWatchdog(
+        SloPolicy(round_s=0.005), flight, registry=reg, tenant="t"
+    )
+    _fold(tracer, 0)  # fast round: no breach
+    assert not wd.breached
+    _fold(tracer, 1, sleep_s=0.02)
+    _fold(tracer, 2, sleep_s=0.02)
+    assert wd.breached
+    assert wd.breach_counts() == {"round_s": 2}
+    assert reg.get("fedml_slo_breaches_total").value(slo="round_s") == 2
+    row = wd.summary_row()
+    assert row["slo/breached"] == 1
+    assert row["slo/round_s"] == 2
+    assert row["slo/breaches_total"] == 2
+
+
+def test_p95_and_rate_wait_for_min_samples():
+    tracer = Tracer()
+    flight = FlightRecorder(max_rounds=16)
+    flight.attach(tracer)
+    wd = SloWatchdog(
+        SloPolicy(p95_round_s=1e-9, min_rounds_per_s=1e12, min_samples=3),
+        flight,
+        registry=MetricsRegistry(),
+    )
+    _fold(tracer, 0)
+    _fold(tracer, 1)
+    assert not wd.breached  # under min_samples, nothing trips yet
+    _fold(tracer, 2)
+    assert wd.breach_counts().get("p95_round_s", 0) >= 1
+    assert wd.breach_counts().get("min_rounds_per_s", 0) >= 1
+
+
+def test_max_recompiles_breaches_once_at_the_crossing():
+    compiles = {"n": 0}
+    tracer = Tracer()
+    flight = FlightRecorder(max_rounds=8, recompiles_fn=lambda: compiles["n"])
+    flight.attach(tracer)
+    wd = SloWatchdog(
+        SloPolicy(max_recompiles=2), flight, registry=MetricsRegistry()
+    )
+    compiles["n"] = 2
+    _fold(tracer, 0)
+    assert not wd.breached  # at the budget, not past it
+    compiles["n"] = 3
+    _fold(tracer, 1)
+    _fold(tracer, 2)  # still over, but already reported
+    assert wd.breach_counts() == {"max_recompiles": 1}
+
+
+def test_straggler_frac_is_a_fleet_fraction_not_per_cohort():
+    """Numerator AND denominator are fleet-wide: 2 stragglers in an
+    8-client fleet is 0.25 — NOT 2 over the 4-client cohort (0.5),
+    which would breach spuriously on any large fleet with small
+    cohorts."""
+
+    class FakeHealth:
+        def straggler_ids(self):
+            return [1, 2]
+
+        def known_client_count(self):
+            return 8
+
+    tracer = Tracer()
+    flight = FlightRecorder(max_rounds=8, health=FakeHealth())
+    flight.attach(tracer)
+    wd = SloWatchdog(
+        SloPolicy(straggler_frac=0.3), flight, registry=MetricsRegistry()
+    )
+    with tracer.span("round", round=0):
+        with tracer.span("broadcast", round=0, clients=4):
+            pass
+    assert flight.last()["clients_seen"] == 8
+    assert wd.breach_counts() == {}  # 2/8 = 0.25 <= 0.3 (cohort would lie)
+    wd2 = SloWatchdog(
+        SloPolicy(straggler_frac=0.2), flight, registry=MetricsRegistry()
+    )
+    with tracer.span("round", round=1):
+        pass
+    assert wd2.breach_counts() == {"straggler_frac": 1}  # 0.25 > 0.2
+
+
+def test_policy_spec_parsing_pops_keys():
+    spec = {"name": "t", "slo_round_s": 1.5, "slo_max_recompiles": 3,
+            "comm_round": 2}
+    p = SloPolicy.from_spec(spec)
+    assert p == SloPolicy(round_s=1.5, max_recompiles=3)
+    assert "slo_round_s" not in spec and "slo_max_recompiles" not in spec
+    assert spec["comm_round"] == 2  # non-SLO keys untouched
+    assert SloPolicy.from_spec({"name": "t"}) is None
+
+
+def test_serve_cli_bad_slo_value_is_a_spec_error(tmp_path):
+    """A non-numeric slo_* value is a PARSE-TIME misconfigured spec
+    (exit 2), like every other spec guard — not a raw traceback."""
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.cli import serve_main
+
+    spec = {"tenants": [{
+        "name": "bad_slo", "algorithm": "fedavg", "runtime": "loopback",
+        "model": "lr", "dataset": "synthetic", "client_num_in_total": 6,
+        "client_num_per_round": 2, "comm_round": 1, "batch_size": 8,
+        "slo_round_s": "fast",
+    }]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code == 2, (r.exit_code, r.output)
+    assert "invalid SLO value" in r.output
+
+
+# ---------------------------------------------------------------------------
+# breach -> degraded, NOT restarted (the supervision contract)
+# ---------------------------------------------------------------------------
+
+
+def test_breach_degrades_supervised_tenant_without_burning_restarts():
+    data, model = _data(), _model()
+    srv = FederationServer(prom_port=0)
+    sup = srv.create_session(
+        "slowpoke", _cfg(), data, model,
+        restart=RestartPolicy(budget=3, backoff_base_s=0.01),
+        slo=SloPolicy(round_s=1e-9),  # every round breaches
+    )
+    srv.start()
+    results = srv.wait()
+    assert results["slowpoke"]["ok"], results  # breaches never crash
+    assert sup.restarts == 0  # ...and never consume restart budget
+    assert sup.health_state == "degraded"
+    summary = results["slowpoke"]["summary"]
+    assert summary["slo/breached"] == 1
+    assert summary["slo/round_s"] >= 1
+    assert summary["supervisor/health"] == "degraded"
+    assert summary["supervisor/restarts"] == 0
+    # degraded shows in /status AND in the tenant-labeled breach counter
+    import urllib.request
+
+    st = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.prom_port}/status").read().decode())
+    assert st["tenants"]["slowpoke"]["health"] == "degraded"
+    assert st["tenants"]["slowpoke"]["restarts"] == 0
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.prom_port}/metrics").read().decode()
+    lines = [
+        ln for ln in body.splitlines()
+        if ln.startswith("fedml_slo_breaches_total{")
+        and 'tenant="slowpoke"' in ln
+    ]
+    assert lines, body[:2000]
+    # budget gauge untouched: all 3 restarts still available
+    budget = [
+        ln for ln in body.splitlines()
+        if ln.startswith("fedml_session_restart_budget_remaining{")
+        and 'tenant="slowpoke"' in ln
+    ]
+    assert budget and budget[0].endswith(" 3.0"), budget
+    srv.close()
+
+
+def test_unsupervised_session_health_state_degrades_on_breach():
+    from fedml_tpu.serve import FedSession
+    from fedml_tpu.telemetry import TelemetryScope
+
+    data, model = _data(), _model()
+    s = FedSession(
+        _cfg(comm_round=2), data, model, name="plain",
+        scope=TelemetryScope(tenant="plain"), slo=SloPolicy(round_s=1e-9),
+    )
+    s.run()
+    assert s.state == "done"
+    assert s.slo_breached
+    assert s.health_state == "degraded"
+    assert s.status()["health"] == "degraded"
+
+
+def test_session_rejects_non_policy_slo():
+    from fedml_tpu.serve import FedSession
+
+    with pytest.raises(ValueError, match="SloPolicy"):
+        FedSession(_cfg(), _data(), _model(), slo={"round_s": 1.0})
+
+
+# ---------------------------------------------------------------------------
+# serve CLI: spec keys + --slo_strict exit code
+# ---------------------------------------------------------------------------
+
+
+def _json_line(output):
+    for line in output.splitlines():
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(f"no JSON line in {output!r}")
+
+
+def test_serve_cli_slo_strict_exit_code(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.cli import serve_main
+
+    spec = {"tenants": [{
+        "name": "breachy", "algorithm": "fedavg", "runtime": "loopback",
+        "model": "lr", "dataset": "synthetic", "client_num_in_total": 6,
+        "client_num_per_round": 2, "comm_round": 2, "batch_size": 8,
+        "frequency_of_the_test": 100, "slo_round_s": 1e-9,
+    }]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    # without --slo_strict: exit 0, breaches reported in the JSON output
+    r = CliRunner().invoke(serve_main, ["--spec", str(p)])
+    assert r.exit_code == 0, r.output
+    out = _json_line(r.output)
+    assert out["breachy"]["ok"]
+    assert out["breachy"]["slo/breached"] == 1
+    # with --slo_strict: the dedicated exit code 4
+    r = CliRunner().invoke(serve_main, ["--spec", str(p), "--slo_strict"])
+    assert r.exit_code == 4, r.output
+    assert "breachy" in r.output
+
+
+def test_serve_cli_slo_strict_passes_on_sane_slo(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.serve.cli import serve_main
+
+    spec = {"tenants": [{
+        "name": "fine", "algorithm": "fedavg", "runtime": "loopback",
+        "model": "lr", "dataset": "synthetic", "client_num_in_total": 6,
+        "client_num_per_round": 2, "comm_round": 2, "batch_size": 8,
+        "frequency_of_the_test": 100, "slo_round_s": 3600.0,
+    }]}
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    r = CliRunner().invoke(serve_main, ["--spec", str(p), "--slo_strict"])
+    assert r.exit_code == 0, r.output
+    out = _json_line(r.output)
+    assert out["fine"]["ok"]
+    assert out["fine"]["slo/breached"] == 0

@@ -167,8 +167,21 @@ def test_corrupt_checkpoint_exhausts_budget_and_quarantines(tmp_path):
     body = urllib.request.urlopen(
         f"http://127.0.0.1:{srv.prom_port}/metrics"
     ).read().decode()
-    assert 'fedml_session_restarts_total{tenant="corrupt"} 2.0' in body
-    assert 'fedml_session_quarantined{tenant="corrupt"} 1.0' in body
+    # tenant-scoped samples also carry the device label (ROADMAP item 2
+    # groundwork), so match on the tenant pair + value
+    restart_lines = [
+        ln for ln in body.splitlines()
+        if ln.startswith("fedml_session_restarts_total{")
+        and 'tenant="corrupt"' in ln
+    ]
+    assert restart_lines and restart_lines[0].endswith(" 2.0"), restart_lines
+    assert 'device="' in restart_lines[0]
+    quarantine_lines = [
+        ln for ln in body.splitlines()
+        if ln.startswith("fedml_session_quarantined{")
+        and 'tenant="corrupt"' in ln
+    ]
+    assert quarantine_lines and quarantine_lines[0].endswith(" 1.0")
     srv.close()
 
 

@@ -378,6 +378,14 @@ def test_cli_loopback_telemetry_dir_end_to_end(tmp_path):
         summary["telemetry/comm_bytes_received"]
         == summary["telemetry/comm_bytes_sent"]
     )
+    # the flight recorder folded every round (telemetry/flight.py): ring
+    # file + flight/* summary block
+    flight = json.load(open(tdir / "flight.json"))
+    assert flight["rounds_folded"] >= 3
+    assert [r["round"] for r in flight["records"][-3:]] == [0, 1, 2]
+    assert flight["percentiles"]["round"]["p50"] > 0
+    assert summary["flight/rounds_folded"] >= 3
+    assert summary["flight/p50_round_s"] > 0
 
 
 def test_cli_vmap_telemetry_round_spans(tmp_path):
