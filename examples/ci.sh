@@ -602,6 +602,160 @@ fi
 echo "  slo_strict ok: breaching tenant -> exit 4"
 rm -rf "$SLODIR"
 
+echo "== serve control-plane soak: 2 device slices, HTTP add/drain mid-flight, priced admission refusal (docs/SERVING.md 'Admin control plane') =="
+# ROADMAP item-2 gate: the WRITE path on the metrics port. Two resident
+# tenants pinned to DISTINCT device slices (the 8 forced host CPU
+# devices above), a third ADDED mid-flight over HTTP onto the warm
+# family's slice — riding the PR-9 sharing gate through the admin path
+# (recompiles == 0, admission priced it warm) — a fourth REFUSED at the
+# admission door with its priced reason on /status, the long resident
+# DRAINED over HTTP, the supervised resident killed once and self-healed
+# on its slice (PR-10 gate), per-tenant device= labels carrying the
+# slice, a scrape never able to mutate (405/401), flat RSS.
+timeout 600 python - <<'PY'
+import json, tempfile, time, urllib.error, urllib.request
+
+from fedml_tpu.serve import (AdmissionController, FederationServer, Placer,
+                             build_slices)
+from fedml_tpu.serve.cli import build_tenant
+from fedml_tpu.serve.introspect import render_status
+
+TOKEN = "ci-soak-token"
+
+def rss_mb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    raise RuntimeError("no VmRSS")
+
+def spec(name, rounds, pin, **extra):
+    # one model family across every tenant, on purpose: the slice-0
+    # co-tenants must share executables through the admin add path
+    return {"name": name, "comm_round": rounds, "device_slice": pin,
+            "client_num_in_total": 8, "client_num_per_round": 4,
+            "batch_size": 8, "epochs": 1,
+            "frequency_of_the_test": 10**6, **extra}
+
+def _until(pred, what, budget=180):
+    t1 = time.time()
+    while not pred():
+        assert time.time() - t1 < budget, f"stalled waiting for {what}"
+        time.sleep(0.02)
+
+slices = build_slices(2)  # cpu:0-3 / cpu:4-7
+srv = FederationServer(
+    prom_port=0, placer=Placer(slices), admin_token=TOKEN,
+    admission=AdmissionController(max_tenants=3),
+)
+# resident_long: pinned slice 0, runs until DRAINED over HTTP
+c0, d0, m0, kw0 = build_tenant(spec("resident_long", 10**6, 0))
+long_t = srv.create_session("resident_long", c0, d0, m0, **kw0)
+# resident_heal: pinned slice 1, SUPERVISED, killed once mid-flight
+killed = {"done": False}
+def chaos(row):
+    if row.get("round") == 30 and "t_s" in row and not killed["done"]:
+        killed["done"] = True
+        raise RuntimeError("control-plane chaos kill")
+heal_dir = tempfile.mkdtemp(prefix="fedml_cp_heal_")
+c1, d1, m1, kw1 = build_tenant(spec(
+    "resident_heal", 120, 1, restart_budget=2, restart_backoff_s=0.05,
+    checkpoint_path=f"{heal_dir}/ck", checkpoint_every=1))
+heal_t = srv.create_session("resident_heal", c1, d1, m1,
+                            restart=kw1.pop("restart"), log_fn=chaos, **kw1)
+assert long_t.device_slice is slices[0]
+assert heal_t.device_slice is slices[1]
+srv.start()
+port = srv.prom_port
+
+def req(path, method="GET", body=None, token=None):
+    data = json.dumps(body).encode() if isinstance(body, dict) else body
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data,
+                               method=method)
+    if token:
+        r.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, {}
+
+_until(lambda: long_t.server is not None and long_t.server.round_idx >= 40,
+       "resident_long warm")
+warm_rss = rss_mb()
+
+# distinct slices visible per tenant on ONE /metrics endpoint
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics").read().decode()
+for name, sl in (("resident_long", slices[0]), ("resident_heal", slices[1])):
+    assert any(f'tenant="{name}"' in ln and f'device="{sl.label}"' in ln
+               for ln in body.splitlines()), f"{name} not on {sl.label}"
+
+# a scrape can never mutate: GET on a write route is 405, a write
+# without (or with a bad) bearer token is 401
+assert req("/tenants")[0] == 405
+assert req("/tenants", "POST", spec("sneak", 2, 0))[0] == 401
+assert req("/tenants", "POST", spec("sneak", 2, 0), token="wrong")[0] == 401
+
+# live ADD over HTTP onto the warm family's slice: admission must have
+# priced it WARM (measured digest probe), and the tenant must adopt the
+# co-tenant's executables — zero compiles attributed to it
+code, doc = req("/tenants", "POST", spec("hot_add", 40, 0), token=TOKEN)
+assert code == 201, doc
+assert doc["device"] == slices[0].label, doc
+assert doc["admission"]["priced"]["warm_in_process"] is True, doc
+hot = srv.session("hot_add")
+hot.wait(180)
+assert hot.state == "done"
+assert hot.scope.recompiles() == 0, hot.scope.recompiles()
+
+# the admission door: tenant 4 of max_tenants=3 -> 409 with the priced
+# reason, visible afterwards on /status and in fedml_admission_total
+code, doc = req("/tenants", "POST", spec("too_many", 2, 1), token=TOKEN)
+assert code == 409 and "max_tenants=3" in doc["error"], doc
+code, st = req("/status")
+assert code == 200 and st["admission"]["refused"] >= 1, st
+ref_d = [d for d in st["admission"]["decisions"] if d["tenant"] == "too_many"]
+assert ref_d and ref_d[-1]["decision"] == "refuse", st["admission"]
+assert "max_tenants=3" in ref_d[-1]["reason"]
+assert st["placement"][slices[0].label]["tenants"] == [
+    "hot_add", "resident_long"], st["placement"]
+# the status CLI's table reflects placement + the decision log
+table = render_status(st)
+assert "placement:" in table and "admission:" in table, table
+assert slices[0].label in table and "refuse" in table, table
+
+# DRAIN the long resident over HTTP mid-flight: open round completes
+drained_at = long_t.server.round_idx
+code, doc = req("/tenants/resident_long/drain", "POST", b"", token=TOKEN)
+assert code == 202, doc
+_until(lambda: heal_t.restarts >= 1, "resident_heal's supervised restart")
+results = srv.wait(timeout=300)
+end_rss = rss_mb()
+final = srv.render_metrics()
+srv.close()
+
+assert all(r["ok"] for r in results.values()), results
+assert killed["done"] and heal_t.restarts == 1
+assert results["resident_heal"]["summary"]["supervisor/restarts"] == 1
+assert results["resident_heal"]["summary"]["round"] == 120  # healed to target
+assert results["resident_long"]["summary"]["round"] >= drained_at
+assert 'fedml_admission_total{decision="refuse"} 1.0' in final
+assert 'fedml_admission_total{decision="admit"} 3.0' in final
+growth = end_rss - warm_rss
+assert growth < 64.0, f"RSS grew {growth:.1f} MB ({warm_rss:.0f} -> {end_rss:.0f})"
+import shutil
+shutil.rmtree(heal_dir, ignore_errors=True)
+print(f"  control plane ok: slices {slices[0].label}/{slices[1].label}, "
+      f"hot_add admitted warm (0 recompiles) + finished, too_many refused "
+      f"({ref_d[-1]['reason']!r}), resident_long drained at round "
+      f"{drained_at}, resident_heal self-healed on its slice, RSS "
+      f"{warm_rss:.0f} -> {end_rss:.0f} MB")
+PY
+
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 

@@ -73,22 +73,34 @@ class FedAvgAggregator:
         return jax.device_get(avg)
 
 
+def local_train_key_fields(model: ModelDef, config: RunConfig, task: str):
+    """THE digest key of the shared transport local-train program — one
+    definition serving both the factory below and the admission
+    controller's warm-program probe (fedml_tpu/serve/admission.py
+    recomputes a candidate tenant's digest to price its compile cost
+    from the content-addressed store; a drifted copy of these fields
+    would silently price the wrong program)."""
+    from fedml_tpu.compile import model_fingerprint
+
+    return {
+        "kind": "local_train",
+        "model": model_fingerprint(model),
+        "train": config.train,
+        "epochs": config.fed.epochs,
+        "task": task,
+    }
+
+
 def shared_local_train(model: ModelDef, config: RunConfig, task: str):
     """THE jitted client local-train program for a transport federation,
     deduped through the process-wide ProgramCache (fedml_tpu/compile/):
     every LocalTrainer, every runner, and every test module building the
     same (model, train config, epochs, task) shares one compile."""
-    from fedml_tpu.compile import get_program_cache, model_fingerprint
+    from fedml_tpu.compile import get_program_cache
 
-    return get_program_cache().get_or_build(
+    return get_program_cache().get_or_build(  # fedlint: disable=baked-constant -- key fields are the dict literal in local_train_key_fields directly above, shared verbatim with the admission controller's pricing probe (serve/admission.py) so the two can never drift; the helper reads only digested leaves (model fingerprint, config.train, epochs, task)
         "local_train",
-        {
-            "kind": "local_train",
-            "model": model_fingerprint(model),
-            "train": config.train,
-            "epochs": config.fed.epochs,
-            "task": task,
-        },
+        local_train_key_fields(model, config, task),
         lambda: jax.jit(
             make_local_train(model, config.train, config.fed.epochs, task=task)
         ),

@@ -289,6 +289,15 @@ KNOWN_BENIGN = frozenset({
     "population.health_active_clients",
     "population.health_trace_budget_bytes",
     "population.flight_rounds", "population.flight_budget_bytes",
+    # AdminConfig (fedml_tpu/serve/: admission.py, placement.py): pure
+    # service control-plane policy — WHERE the serve layer schedules a
+    # tenant (the device-slice pin changes which device dispatches, and
+    # the compile layer already keys per-device via the pinned-signature
+    # token in program_cache.py) and what the admission door requires
+    # (headroom/flops thresholds that decide WHETHER a tenant builds at
+    # all). None of it enters a factory's traced program.
+    "admin.device_slice", "admin.admit_min_headroom_mb",
+    "admin.admit_cost_cap_gflops",
 })
 
 

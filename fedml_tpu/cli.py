@@ -25,6 +25,7 @@ import click
 import numpy as np
 
 from fedml_tpu.config import (
+    AdminConfig,
     CommConfig,
     CompileConfig,
     DataConfig,
@@ -310,6 +311,23 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
                    "count — a fully warm process passes budget 0), so pick "
                    "a coarse upper bound; the observed count always lands "
                    "in summary.json (compile/recompiles). Off by default")
+@click.option("--device_slice", type=int, default=-1,
+              help="Serve-layer placement pin (AdminConfig.device_slice): "
+                   "run this tenant on slice N of the service's device "
+                   "slices (serve --device_slices; docs/SERVING.md). -1 = "
+                   "bin-pack onto the least-loaded slice. Single runs "
+                   "ignore it — the flag exists so tenant-spec keys stay "
+                   "the single-run flag surface")
+@click.option("--admit_min_headroom_mb", type=float, default=0.0,
+              help="Serve-layer admission requirement: refuse this tenant "
+                   "when host MemAvailable is below this many MB at the "
+                   "admission door (serve/admission.py). 0 = none; single "
+                   "runs ignore it")
+@click.option("--admit_cost_cap_gflops", type=float, default=0.0,
+              help="Serve-layer admission cap: refuse when the tenant's "
+                   "priced compute (measured XLA cost-analysis flops x "
+                   "cohort) exceeds this many GFLOP/round. 0 = none; "
+                   "single runs ignore it")
 @click.option("--rank", type=int, default=None,
               help="runtime=grpc: this process's rank (0 = server, 1..K = "
                    "clients; ref main_fedavg_rpc.py --fl_worker_index)")
@@ -591,6 +609,18 @@ def build_config(opt) -> RunConfig:
             min_compile_time_s=opt.get("compile_cache_min_s", 2.0),
             executable_cache=str(opt.get("executable_cache") or ""),
             recompile_budget=opt.get("recompile_budget"),
+        ),
+        admin=AdminConfig(
+            device_slice=int(
+                opt["device_slice"]
+                if opt.get("device_slice") is not None else -1
+            ),
+            admit_min_headroom_mb=float(
+                opt.get("admit_min_headroom_mb", 0.0) or 0.0
+            ),
+            admit_cost_cap_gflops=float(
+                opt.get("admit_cost_cap_gflops", 0.0) or 0.0
+            ),
         ),
         model=opt["model"],
         seed=opt["seed"],

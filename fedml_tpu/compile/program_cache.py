@@ -36,6 +36,39 @@ from fedml_tpu.compile.digest import call_signature, program_digest
 from fedml_tpu.telemetry import get_registry, get_tracer
 
 
+def _device_pin_token() -> Optional[tuple]:
+    """The thread-local ``jax.default_device`` pin as a signature token,
+    or None when the thread is unpinned.
+
+    Tenant placement (fedml_tpu/serve/placement.py) pins each tenant's
+    threads to a device slice, but XLA executables are compiled PER
+    DEVICE — the AOT dispatch map and the persistent executable store
+    are keyed by abstract call signature, which is device-blind. Without
+    this token a tenant pinned to device 3 could adopt a co-tenant's (or
+    a predecessor process's) executable committed to device 0 and
+    silently dispatch there, defeating the placement. Pinned threads
+    therefore fold the pin into the signature; unpinned threads (every
+    single-run path, the whole pre-placement world) keep signatures —
+    and on-disk executable keys — byte-identical to every historical
+    run."""
+    try:
+        import jax
+
+        d = jax.config.jax_default_device
+    except Exception:  # noqa: BLE001 — jax-free/old-jax contexts
+        return None
+    if d is None:
+        return None
+    return ("__device__", getattr(d, "platform", str(d)),
+            int(getattr(d, "id", -1)))
+
+
+def _pinned_signature(args) -> tuple:
+    sig = call_signature(args)
+    pin = _device_pin_token()
+    return sig if pin is None else sig + (pin,)
+
+
 class CachedProgram:
     """A jit-compiled program handle: callable, lowerable, warmable.
 
@@ -140,7 +173,7 @@ class CachedProgram:
             self._aot
             or (self._exec_probe_budget > 0 and self._exec_cache() is not None)
         ):
-            sig = call_signature(args)
+            sig = _pinned_signature(args)
             exe = self._aot.get(sig)
             if exe is None and self._exec_probe_budget > 0:
                 if sig not in self._exec_probed:
@@ -185,6 +218,28 @@ class CachedProgram:
     def lower(self, *args, **kwargs):
         return self.fn.lower(*args, **kwargs)
 
+    def measured_cost(self) -> Optional[dict]:
+        """The measured XLA cost analysis of this program's warmed /
+        adopted executables — ``{"flops", "bytes"}`` maxed over shape
+        classes (the cohort-max class is what a round dispatches), or
+        None when nothing has been AOT-compiled yet. The admission
+        controller (fedml_tpu/serve/admission.py) prices candidate
+        tenants from this: a MEASURED per-dispatch cost, not a guess."""
+        flops = [
+            st["flops"] for st in self._aot_stats.values()
+            if st.get("flops")
+        ]
+        byts = [
+            st["bytes"] for st in self._aot_stats.values()
+            if st.get("bytes")
+        ]
+        if not flops and not byts:
+            return None
+        return {
+            "flops": max(flops) if flops else None,
+            "bytes": max(byts) if byts else None,
+        }
+
     def warmup(self, *args, tracer=None) -> dict:
         """AOT-compile this program for the signature of ``args``
         (``jit(...).lower(...).compile()``) and keep the executable for
@@ -192,7 +247,7 @@ class CachedProgram:
         buffers in ``args`` are untouched. Idempotent per signature —
         a second warmup is a hit with ``compile_s == 0``. Returns
         ``{compile_s, flops, bytes, aot_cache_hit}``."""
-        sig = call_signature(args)
+        sig = _pinned_signature(args)
         st = self._aot_stats.get(sig)
         if st is not None:
             # a hit costs nothing: report compile_s=0 (the docstring
@@ -369,6 +424,13 @@ class ProgramCache:
         fuzzer's enumeration surface."""
         with self._lock:
             return list(self._programs.values())
+
+    def lookup(self, digest: str) -> Optional[CachedProgram]:
+        """The registered program for ``digest`` WITHOUT building or
+        counting a hit/miss — the admission controller's warm-program
+        probe (a probe is a question, not a use)."""
+        with self._lock:
+            return self._programs.get(digest)
 
     def _note_compile_time(
         self, dt: float, label: str = "?", digest: Optional[str] = None

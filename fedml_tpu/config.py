@@ -310,6 +310,33 @@ class PopulationConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdminConfig:
+    """Control-plane knobs a tenant carries to the serve layer
+    (fedml_tpu/serve/: placement.py, admission.py, admin.py —
+    docs/SERVING.md). Single runs ignore them.
+
+    Every field is HOST-SIDE service policy — which slice a tenant is
+    scheduled on and what the admission door requires — and none can
+    reach a compiled program, so the section is classified KNOWN_BENIGN
+    in the digest audit (analysis/digest_audit.py), exactly like
+    PopulationConfig."""
+
+    # Placement pin: run this tenant on slice index N of the service's
+    # device slices (serve --device_slices). -1 = let the placer bin-pack
+    # onto the least-loaded slice. Pinning two same-model-family tenants
+    # to ONE slice preserves their cross-tenant executable sharing (XLA
+    # compiles per device — crossing slices costs one compile).
+    device_slice: int = -1
+    # Admission: refuse this tenant when host MemAvailable is below this
+    # many MB at the door (0 = no headroom requirement).
+    admit_min_headroom_mb: float = 0.0
+    # Admission: refuse when the tenant's priced compute — measured
+    # per-dispatch XLA cost-analysis flops x cohort size — exceeds this
+    # many GFLOP per round (0 = no cap; unpriced candidates pass).
+    admit_cost_cap_gflops: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh spec replacing the reference's gpu_mapping.yaml
     (fedml_api/distributed/utils/gpu_mapping.py:8-39)."""
@@ -333,6 +360,7 @@ class RunConfig:
     population: PopulationConfig = dataclasses.field(
         default_factory=PopulationConfig
     )
+    admin: AdminConfig = dataclasses.field(default_factory=AdminConfig)
     model: str = "lr"
     seed: int = 0
 

@@ -33,6 +33,20 @@ checkpoints, and per-tenant observability. This package is that service:
   evaluated against the flight recorder each round; breaches degrade a
   tenant without consuming restart budget, and ``--slo_strict`` turns
   them into a CI failure.
+- :mod:`fedml_tpu.serve.admin` — :class:`AdminApi`: the WRITE path on
+  the same port (POST ``/tenants`` to add a tenant live, POST
+  ``/tenants/<name>/drain|stop|reload``), bearer-token gated
+  (``--admin_token``); GET on a mutating route is 405 by construction.
+- :mod:`fedml_tpu.serve.admission` — :class:`AdmissionController`:
+  price a candidate tenant from MEASURED signals (warm program digests +
+  XLA cost analysis, executable-store hit rate, RSS/headroom) before
+  ``create_session`` builds anything; refusals carry their priced
+  reason on ``/status`` and in ``fedml_admission_total``.
+- :mod:`fedml_tpu.serve.placement` — :class:`DeviceSlice` /
+  :class:`Placer`: partition the visible devices into slices and
+  bin-pack tenants onto them; a session dispatches on ITS slice via a
+  thread-local pin, and the supervisor escalates a crash-looping tenant
+  to re-placement on an untried slice.
 
 Co-tenant federations with the same model family share compiled programs
 for free: the ProgramCache digest (fedml_tpu/compile/) is process-wide by
@@ -40,7 +54,14 @@ design, and the per-scope compile attribution in the recompile sentinel
 proves it (``compile/recompiles == 0`` on the second same-family tenant —
 the ci.sh soak gate). See docs/SERVING.md."""
 
+from fedml_tpu.serve.admin import AdminApi
+from fedml_tpu.serve.admission import (
+    AdmissionController,
+    AdmissionDecision,
+    AdmissionRefused,
+)
 from fedml_tpu.serve.introspect import Introspector
+from fedml_tpu.serve.placement import DeviceSlice, Placer, build_slices
 from fedml_tpu.serve.session import FedSession
 from fedml_tpu.serve.server import FederationServer
 from fedml_tpu.serve.slo import SloPolicy, SloWatchdog
@@ -51,12 +72,19 @@ from fedml_tpu.serve.supervisor import (
 )
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "AdmissionRefused",
+    "AdminApi",
+    "DeviceSlice",
     "FedSession",
     "FederationServer",
     "Introspector",
+    "Placer",
     "RestartBudgetExhausted",
     "RestartPolicy",
     "SloPolicy",
     "SloWatchdog",
     "SupervisedSession",
+    "build_slices",
 ]

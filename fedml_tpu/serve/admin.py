@@ -1,0 +1,203 @@
+"""Admin control plane — the HTTP WRITE path on the service's metrics
+port.
+
+PR 12 built the read path (``/status`` / ``/tenants/<name>`` /
+``/compile`` / ``/healthz``); this module is ROADMAP item 2's write
+half: live tenant lifecycle over the SAME exporter route table
+(telemetry/prometheus.py — now method-aware), so one port stays the
+whole ops surface:
+
+=========  ==============================  =====================================
+method     path                            action
+=========  ==============================  =====================================
+POST       ``/tenants``                    admit + start ONE tenant from a
+                                           spec-JSON body (the serve CLI's
+                                           tenant-spec keys, serve/cli.py)
+POST       ``/tenants/<name>/drain``       graceful stop: open round completes /
+                                           buffered deltas flush
+POST       ``/tenants/<name>/stop``        hard stop
+POST       ``/tenants/<name>/reload``      hot-reload RELOADABLE keys from the
+                                           JSON body (``slo_*``,
+                                           ``restart_budget``) — co-tenants are
+                                           never touched
+=========  ==============================  =====================================
+
+Status codes: 201 tenant started, 202 drain/stop accepted, 200 reload
+applied; 400 malformed body/spec or non-reloadable key, 401 missing/bad
+bearer token, 404 unknown tenant, 405 wrong method (the exporter answers
+it before any handler runs — a GET scrape can NEVER mutate), 409
+admission refused (body carries the priced reason) or duplicate name.
+
+**Auth**: every admin call requires ``Authorization: Bearer
+<admin_token>`` (serve CLI ``--admin_token`` /
+``FederationServer(admin_token=...)``). No token configured → the write
+routes are never installed and the service is read-only, exactly the
+PR-12 surface. Token comparison is constant-time. The exporter binds
+loopback by default; the token is defense in depth for shared hosts,
+not a substitute for network policy (docs/SERVING.md)."""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+from typing import Tuple
+
+# tenant-spec keys applied live by /tenants/<name>/reload — everything
+# else in a spec shapes programs/data/fleets and needs a restart
+RELOADABLE_DOC = (
+    "slo_round_s, slo_p95_round_s, slo_min_rounds_per_s, "
+    "slo_max_recompiles, slo_straggler_frac, restart_budget"
+)
+
+
+class AdminApi:
+    """The write-route table over one :class:`FederationServer`."""
+
+    def __init__(self, server, token: str):
+        if not token:
+            raise ValueError(
+                "AdminApi requires a non-empty bearer token — without one "
+                "the service must stay read-only (do not install the API)"
+            )
+        self.server = server
+        self._token = str(token)
+
+    def install(self, exporter) -> "AdminApi":
+        exporter.add_route("/tenants", self._r_add, method="POST")
+        exporter.add_route("/tenants/", self._r_action, method="POST")
+        return self
+
+    # -- auth --------------------------------------------------------------
+
+    def _authorized(self, headers) -> bool:
+        got = str(headers.get("Authorization") or "")
+        want = f"Bearer {self._token}"
+        return hmac.compare_digest(got.encode(), want.encode())
+
+    @staticmethod
+    def _unauthorized() -> Tuple[int, dict]:
+        return 401, {
+            "error": "admin routes require 'Authorization: Bearer "
+                     "<admin_token>'"
+        }
+
+    # -- POST /tenants: live add ------------------------------------------
+
+    def _r_add(self, path: str, body: bytes, headers) -> Tuple[int, object]:
+        if not self._authorized(headers):
+            return self._unauthorized()
+        try:
+            spec = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            return 400, {"error": f"body must be one tenant-spec JSON "
+                                  f"object: {e}"}
+        if not isinstance(spec, dict) or not spec.get("name"):
+            return 400, {"error": "tenant spec needs a unique 'name' "
+                                  "(serve CLI spec keys, docs/SERVING.md)"}
+        name = str(spec["name"])
+        import click
+
+        from fedml_tpu.serve.admission import AdmissionRefused
+        from fedml_tpu.serve.cli import build_tenant
+
+        try:
+            config, data, model, session_kw = build_tenant(dict(spec))
+        except (click.UsageError, ValueError, KeyError) as e:
+            return 400, {"error": f"tenant {name!r}: invalid spec — {e}"}
+        restart = session_kw.pop("restart", None)
+        try:
+            session = self.server.create_session(
+                name, config, data, model, restart=restart, **session_kw
+            )
+        except AdmissionRefused as e:
+            logging.warning("admin: tenant %s refused: %s", name, e)
+            return 409, {
+                "error": f"admission refused: {e}",
+                "decision": e.decision.to_dict(),
+            }
+        except ValueError as e:
+            # duplicate name / session build rejection
+            dup = "already registered" in str(e)
+            return (409 if dup else 400), {"error": repr(e)}
+        try:
+            self.server.start(names=[name])
+        except BaseException as e:  # noqa: BLE001 — admin boundary
+            # the session BUILD rejected the spec at start (config-guard
+            # ValueError the constructor cannot see, e.g. participation
+            # faults without deadline_s): unregister so the corrected
+            # name is immediately reusable and the placement/metrics
+            # bookkeeping is released — never a 500 with a stuck tenant
+            try:
+                self.server.forget_session(name)
+            except Exception:  # noqa: BLE001 — cleanup must not mask e
+                logging.exception("admin: could not forget tenant %s", name)
+            logging.warning("admin: tenant %s failed to start: %r", name, e)
+            return 400, {
+                "error": f"tenant {name!r}: session build rejected the "
+                         f"spec at start — {e!r}"
+            }
+        out = {"tenant": name, "state": session.state}
+        sl = getattr(session, "device_slice", None)
+        if sl is not None:
+            out["device"] = sl.label
+        if self.server.admission is not None:
+            snap = self.server.admission.snapshot()
+            for d in reversed(snap["decisions"]):
+                if d["tenant"] == name:
+                    out["admission"] = d
+                    break
+        logging.info("admin: tenant %s admitted + started", name)
+        return 201, out
+
+    # -- POST /tenants/<name>/<action> ------------------------------------
+
+    def _r_action(self, path: str, body: bytes, headers) -> Tuple[int, object]:
+        if not self._authorized(headers):
+            return self._unauthorized()
+        from urllib.parse import unquote
+
+        rest = path[len("/tenants/"):]
+        if "/" not in rest:
+            # POST /tenants/<name> has no meaning; adds go to /tenants
+            return 404, {"error": f"no such admin action {path!r} — POST "
+                                  f"/tenants/<name>/(drain|stop|reload)"}
+        name, action = rest.rsplit("/", 1)
+        name = unquote(name)
+        try:
+            session = self.server.session(name)
+        except KeyError:
+            return 404, {"error": f"unknown tenant {name!r}"}
+        if action == "drain":
+            self.server.drain(name)
+            logging.info("admin: tenant %s draining", name)
+            return 202, {"tenant": name, "action": "drain",
+                         "state": session.state}
+        if action == "stop":
+            self.server.stop(name)
+            logging.info("admin: tenant %s stopping", name)
+            return 202, {"tenant": name, "action": "stop",
+                         "state": session.state}
+        if action == "reload":
+            return self._reload(name, body)
+        return 404, {"error": f"unknown admin action {action!r} "
+                              "(drain|stop|reload)"}
+
+    def _reload(self, name: str, body: bytes) -> Tuple[int, object]:
+        try:
+            updates = json.loads(body.decode("utf-8")) if body else {}
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            return 400, {"error": f"reload body must be a JSON object of "
+                                  f"reloadable keys: {e}"}
+        if not isinstance(updates, dict) or not updates:
+            return 400, {"error": "reload body must be a non-empty JSON "
+                                  f"object; reloadable keys: {RELOADABLE_DOC}"}
+        try:
+            applied = self.server.reload_tenant(name, updates)
+        except KeyError:
+            return 404, {"error": f"unknown tenant {name!r}"}
+        except (TypeError, ValueError) as e:
+            return 400, {"error": str(e)}
+        logging.info("admin: tenant %s hot-reloaded %s", name,
+                     sorted(applied))
+        return 200, {"tenant": name, "applied": applied}

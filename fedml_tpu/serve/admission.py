@@ -1,0 +1,269 @@
+"""Admission control — price a candidate tenant from MEASURED signals
+before the service accepts it.
+
+PR 9's ``FederationServer`` admits every ``create_session`` blindly; the
+only backpressure in the system is per-tenant worker-count refusal. This
+module is ROADMAP item 2's admission door: before a tenant is built, an
+:class:`AdmissionController` prices it from signals the process has
+actually measured —
+
+- **compile cost** via the content-addressed digest store: the
+  candidate's shared local-train program digest (recomputed through the
+  same ``local_train_key_fields`` the factory uses) is probed against
+  the process-wide ProgramCache. A warm digest means a same-family
+  co-tenant already compiled/adopted the program — admission costs ~0
+  compile seconds and the program's measured XLA cost analysis
+  (flops/bytes from warmup's ``compile/*`` summary pipeline,
+  ``CachedProgram.measured_cost``) prices its steady-state dispatch. A
+  cold digest is priced by the persistent executable store's MEASURED
+  hit rate (``hits/(hits+misses)`` so far this process) — the
+  probability a fresh program deserializes instead of compiling.
+- **memory headroom**: current process RSS (/proc/self/status) against
+  the controller's ``max_rss_mb`` cap, and host MemAvailable
+  (/proc/meminfo) against the headroom the candidate's
+  ``AdminConfig.admit_min_headroom_mb`` declares it needs.
+- **tenant count** against ``max_tenants`` (0 = uncapped).
+
+Every decision — admit or refuse — lands in a bounded log with its
+priced inputs (``/status``'s ``admission`` section, the operator's "why
+was my tenant refused" answer) and increments
+``fedml_admission_total{decision=...}`` in the process-global registry
+(admission is a service-level fact, never tenant-labeled). A refusal
+raises :class:`AdmissionRefused` out of ``create_session`` — the admin
+HTTP surface maps it to 409 with the priced reason in the body
+(serve/admin.py), the serve CLI to the misconfigured-spec exit class."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class AdmissionRefused(RuntimeError):
+    """A candidate tenant was refused at the admission door. ``decision``
+    carries the priced inputs; ``str(exc)`` is the operator-facing
+    reason."""
+
+    def __init__(self, decision: "AdmissionDecision"):
+        super().__init__(decision.reason)
+        self.decision = decision
+
+
+class AdmissionDecision:
+    """One priced admit/refuse call (JSON-ready via ``to_dict``)."""
+
+    def __init__(self, tenant: str, admit: bool, reason: str, priced: dict):
+        self.tenant = str(tenant)
+        self.admit = bool(admit)
+        self.reason = str(reason)
+        self.priced = dict(priced)
+        self.at = time.time()
+
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "decision": "admit" if self.admit else "refuse",
+            "reason": self.reason,
+            "priced": self.priced,
+            "at": round(self.at, 3),
+        }
+
+
+def _rss_mb() -> Optional[float]:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return None
+
+
+def _mem_available_mb() -> Optional[float]:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return None
+
+
+class AdmissionController:
+    """Price-and-decide for candidate tenants (thread-safe).
+
+    ``max_rss_mb`` refuses once the PROCESS is already over budget (0 =
+    off); ``max_tenants`` caps live tenants (0 = uncapped). Per-CANDIDATE
+    requirements ride the candidate's own config
+    (``AdminConfig.admit_min_headroom_mb`` — the headroom this tenant
+    declares it needs; ``admit_cost_cap_gflops`` — refuse when the
+    priced per-round compute exceeds the cap). ``log_size`` bounds the
+    decision log (a month-long service must stay O(K))."""
+
+    def __init__(
+        self,
+        max_rss_mb: float = 0.0,
+        max_tenants: int = 0,
+        log_size: int = 64,
+        registry=None,
+    ):
+        self.max_rss_mb = float(max_rss_mb)
+        self.max_tenants = int(max_tenants)
+        self._lock = threading.Lock()
+        self._log: deque = deque(maxlen=int(log_size))
+        self.admitted = 0
+        self.refused = 0
+        if registry is None:
+            from fedml_tpu.telemetry import get_global_registry
+
+            registry = get_global_registry()
+        self._c_total = registry.counter(
+            "fedml_admission_total",
+            "Tenant admission decisions at the service door",
+            ("decision",),
+        )
+
+    # -- pricing -----------------------------------------------------------
+
+    def price(self, config, model, task: str = "classification") -> dict:
+        """The measured-signal price card for one candidate (see module
+        docstring). Never raises — unmeasurable signals price as None
+        and only the measurable rules below act on them."""
+        priced: dict = {
+            "rss_mb": _rss_mb(),
+            "headroom_mb": _mem_available_mb(),
+        }
+        try:
+            from fedml_tpu.algorithms.fedavg_transport import (
+                local_train_key_fields,
+            )
+            from fedml_tpu.compile import get_program_cache, program_digest
+
+            digest = program_digest(
+                local_train_key_fields(model, config, task)
+            )
+            priced["local_train_digest"] = digest[:16]
+            prog = get_program_cache().lookup(digest)
+            priced["warm_in_process"] = prog is not None
+            if prog is not None:
+                # a same-family co-tenant already owns this program:
+                # admission compiles nothing, and its measured cost
+                # analysis prices the steady-state dispatch
+                priced["cache_hit_p"] = 1.0
+                cost = prog.measured_cost()
+                if cost is not None and cost.get("flops"):
+                    per_round = (
+                        cost["flops"] * config.fed.client_num_per_round
+                    )
+                    priced["flops_per_dispatch"] = cost["flops"]
+                    priced["flops_per_round"] = per_round
+                    priced["gflops_per_round"] = per_round / 1e9
+                if cost is not None and cost.get("bytes"):
+                    priced["bytes_per_dispatch"] = cost["bytes"]
+            else:
+                # cold program: the persistent executable store's
+                # MEASURED hit rate so far is the probability this
+                # digest deserializes instead of compiling
+                from fedml_tpu.compile import installed_executable_cache
+
+                store = installed_executable_cache()
+                if store is not None:
+                    st = store.stats()
+                    seen = st["hits"] + st["misses"]
+                    priced["cache_hit_p"] = (
+                        round(st["hits"] / seen, 3) if seen else None
+                    )
+                else:
+                    priced["cache_hit_p"] = 0.0
+        except Exception:  # noqa: BLE001 — pricing must never block the door
+            import logging
+
+            logging.exception("admission pricing failed")
+        return priced
+
+    # -- the decision ------------------------------------------------------
+
+    def decide(
+        self,
+        name: str,
+        config,
+        model,
+        task: str = "classification",
+        live_tenants: int = 0,
+    ) -> AdmissionDecision:
+        """Price ``name`` and decide. Records the decision (log +
+        counter) either way; raising on refusal is the CALLER's job
+        (``FederationServer.create_session`` raises
+        :class:`AdmissionRefused`)."""
+        priced = self.price(config, model, task=task)
+        admin = getattr(config, "admin", None)
+        reason = "admitted"
+        admit = True
+        if self.max_tenants and live_tenants >= self.max_tenants:
+            admit = False
+            reason = (
+                f"tenant cap: {live_tenants} live tenants >= "
+                f"max_tenants={self.max_tenants}"
+            )
+        elif (
+            self.max_rss_mb
+            and priced.get("rss_mb") is not None
+            and priced["rss_mb"] > self.max_rss_mb
+        ):
+            admit = False
+            reason = (
+                f"memory: process RSS {priced['rss_mb']:.0f} MB already "
+                f"over max_rss_mb={self.max_rss_mb:.0f}"
+            )
+        elif (
+            admin is not None
+            and admin.admit_min_headroom_mb
+            and priced.get("headroom_mb") is not None
+            and priced["headroom_mb"] < admin.admit_min_headroom_mb
+        ):
+            admit = False
+            reason = (
+                f"headroom: host has {priced['headroom_mb']:.0f} MB "
+                f"available, tenant requires "
+                f"admit_min_headroom_mb={admin.admit_min_headroom_mb:.0f}"
+            )
+        elif (
+            admin is not None
+            and admin.admit_cost_cap_gflops
+            and priced.get("gflops_per_round") is not None
+            and priced["gflops_per_round"] > admin.admit_cost_cap_gflops
+        ):
+            admit = False
+            reason = (
+                f"compute: priced {priced['gflops_per_round']:.3f} "
+                f"GFLOP/round over admit_cost_cap_gflops="
+                f"{admin.admit_cost_cap_gflops}"
+            )
+        elif priced.get("warm_in_process"):
+            reason = (
+                "admitted: local-train program warm in process "
+                "(cache_hit_p=1.0, compile cost ~0)"
+            )
+        decision = AdmissionDecision(name, admit, reason, priced)
+        with self._lock:
+            self._log.append(decision)
+            if admit:
+                self.admitted += 1
+            else:
+                self.refused += 1
+        self._c_total.inc(1, decision="admit" if admit else "refuse")
+        return decision
+
+    def snapshot(self) -> dict:
+        """JSON-ready /status section: totals + the bounded recent-
+        decision log, most recent last."""
+        with self._lock:
+            return {
+                "admitted": self.admitted,
+                "refused": self.refused,
+                "decisions": [d.to_dict() for d in self._log],
+            }

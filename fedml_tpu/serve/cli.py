@@ -240,7 +240,31 @@ def build_tenant(spec: dict):
                    " — the CI hook; without it breaches only degrade the "
                    "tenant and land in slo/* summary keys + "
                    "fedml_slo_breaches_total")
-def serve_main(spec, log_dir, prom_port, duration_s, stagger_s, slo_strict):
+@click.option("--admin_token", default=None,
+              help="Enable the HTTP WRITE api (POST /tenants, "
+                   "/tenants/<name>/drain|stop|reload on the metrics "
+                   "port, serve/admin.py) behind this bearer token. "
+                   "Without it the service is read-only — a scrape can "
+                   "never mutate state. Requires --prom_port")
+@click.option("--device_slices", type=int, default=0,
+              help="Partition the visible devices into this many slices "
+                   "and bin-pack tenants onto them (serve/placement.py; "
+                   "a tenant spec pins one with device_slice). 0 = no "
+                   "placement, every tenant shares the default device. "
+                   "CPU hosts: XLA_FLAGS=--xla_force_host_platform_"
+                   "device_count=N provides the devices")
+@click.option("--devices_per_slice", type=int, default=0,
+              help="Devices per slice (0 = split evenly)")
+@click.option("--admit_max_rss_mb", type=float, default=0.0,
+              help="Admission control: refuse new tenants once process "
+                   "RSS exceeds this many MB (serve/admission.py). 0 = "
+                   "off")
+@click.option("--admit_max_tenants", type=int, default=0,
+              help="Admission control: refuse new tenants past this many "
+                   "live tenants. 0 = uncapped")
+def serve_main(spec, log_dir, prom_port, duration_s, stagger_s, slo_strict,
+               admin_token, device_slices, devices_per_slice,
+               admit_max_rss_mb, admit_max_tenants):
     """Run N federation tenants concurrently in one process."""
     import time
 
@@ -249,8 +273,32 @@ def serve_main(spec, log_dir, prom_port, duration_s, stagger_s, slo_strict):
 
     _apply_platform_env()
     tenants = load_spec(spec)
+    if admin_token and prom_port is None:
+        raise click.UsageError(
+            "--admin_token needs --prom_port: the admin api rides the "
+            "metrics/introspection port"
+        )
+    placer = None
+    if device_slices:
+        from fedml_tpu.serve.placement import Placer, build_slices
+
+        try:
+            placer = Placer(build_slices(device_slices, devices_per_slice))
+        except ValueError as e:
+            raise click.UsageError(str(e))
+    admission = None
+    if admit_max_rss_mb or admit_max_tenants or admin_token:
+        # any admission knob — or a live admin surface, whose adds must
+        # go through the door — installs the controller (thresholds off
+        # by default: it prices and logs every decision either way)
+        from fedml_tpu.serve.admission import AdmissionController
+
+        admission = AdmissionController(
+            max_rss_mb=admit_max_rss_mb, max_tenants=admit_max_tenants
+        )
     server = FederationServer(
-        log_dir=str(log_dir) if log_dir else None, prom_port=prom_port
+        log_dir=str(log_dir) if log_dir else None, prom_port=prom_port,
+        placer=placer, admission=admission, admin_token=admin_token,
     )
     # config-rejected tenants (spec passed parsing but the session build
     # refused it — e.g. participation faults without deadline_s): isolated
@@ -269,6 +317,15 @@ def serve_main(spec, log_dir, prom_port, duration_s, stagger_s, slo_strict):
         try:
             server.create_session(name, config, data, model, **session_kw)
         except ValueError as e:
+            config_failed[name] = repr(e)
+        except Exception as e:
+            from fedml_tpu.serve.admission import AdmissionRefused
+
+            if not isinstance(e, AdmissionRefused):
+                raise
+            # a spec tenant refused at the door is an operator problem
+            # exactly like a bad spec: surface it in the misconfigured
+            # exit class with the priced reason
             config_failed[name] = repr(e)
     try:
         for i, t in enumerate(tenants):

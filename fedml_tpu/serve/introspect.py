@@ -78,6 +78,8 @@ class Introspector:
             brief["restart_budget_remaining"] = int(budget) - int(
                 st.get("supervisor/restarts", 0)
             )
+        if st.get("supervisor/replacements"):
+            brief["replacements"] = st["supervisor/replacements"]
         if st.get("slo_breaches"):
             brief["slo_breaches"] = st["slo_breaches"]
         if flight is not None:
@@ -94,12 +96,23 @@ class Introspector:
 
     def _r_status(self, path: str) -> Tuple[int, dict]:
         sessions = self.server.sessions()
-        return 200, {
+        out = {
             "service": "fedml_tpu.serve",
             "uptime_s": round(time.time() - self.started_at, 3),
             "tenant_count": len(sessions),
             "tenants": {s.name: self._brief(s) for s in sessions},
         }
+        admission = getattr(self.server, "admission", None)
+        if admission is not None:
+            # the control plane's decision log: every admit/refuse with
+            # its priced inputs — the "why was my tenant refused" answer
+            out["admission"] = admission.snapshot()
+        placer = getattr(self.server, "placer", None)
+        if placer is not None:
+            out["placement"] = placer.snapshot()
+        if getattr(self.server, "_admin", None) is not None:
+            out["admin_api"] = "enabled"
+        return 200, out
 
     def _r_tenant(self, path: str) -> Tuple[int, object]:
         from urllib.parse import unquote
@@ -234,6 +247,28 @@ def render_status(doc: dict) -> str:
     lines.append("  ".join(hdr.ljust(widths[key]) for hdr, key in _COLS))
     for r in rows:
         lines.append("  ".join(r[key].ljust(widths[key]) for _, key in _COLS))
+    placement = doc.get("placement")
+    if placement:
+        lines.append("")
+        lines.append("placement:")
+        for label, sl in sorted(placement.items()):
+            tenants = ", ".join(sl.get("tenants", [])) or "-"
+            lines.append(
+                f"  {label}  [{sl.get('devices', 1)} device(s), "
+                f"cost {sl.get('cost', 0)}]  {tenants}"
+            )
+    admission = doc.get("admission")
+    if admission:
+        lines.append("")
+        lines.append(
+            f"admission: {admission.get('admitted', 0)} admitted, "
+            f"{admission.get('refused', 0)} refused"
+        )
+        for d in admission.get("decisions", [])[-8:]:
+            lines.append(
+                f"  [{d.get('decision', '?'):>6}] {d.get('tenant', '?')}: "
+                f"{d.get('reason', '')}"
+            )
     return "\n".join(lines)
 
 

@@ -1,0 +1,230 @@
+"""Tenant placement — bin-pack tenants onto device/mesh slices.
+
+PR 9/10/12 run every tenant on the process-global default backend:
+"everyone shares the chip". This module is the multi-device half of
+ROADMAP item 2's control plane: the service partitions its visible
+devices into :class:`DeviceSlice` handles and a :class:`Placer`
+bin-packs tenants onto them, so N tenants spread across N slices of one
+host (8 forced-host CPU devices in CI, the chips of a TPU pod slice in
+production) instead of contending for device 0.
+
+A slice is the **device handle a FedSession carries** instead of the
+process-global backend (the enabling refactor ROADMAP item 2 names):
+the session enters ``slice.activate()`` — a thread-local
+``jax.default_device`` pin — around its build and every thread it
+spawns, so all of that tenant's dispatches land on the slice. Pins are
+thread-local and compose with the TelemetryScope activation; co-tenants
+on other slices are untouched. ``slice.mesh()`` builds a
+``jax.sharding.Mesh`` over the slice's devices through the existing
+``parallel/`` mesh runtime for multi-device-per-slice workloads.
+
+Placement interacts with compile sharing honestly: XLA executables are
+compiled PER DEVICE, so two same-model-family tenants share compiles
+only when they share a slice (the PR-9 ``co-tenant recompiles == 0``
+gate holds within a slice; crossing slices costs one compile per
+program, attributed to the crossing tenant). The bin-packer therefore
+supports explicit pins (``AdminConfig.device_slice`` / the
+``device_slice`` spec key) so an operator can co-locate a model family
+deliberately; unpinned tenants go to the least-loaded slice by priced
+admission cost (serve/admission.py), tenant count breaking ties.
+
+The supervisor escalates a crash-looping tenant from restart-in-place
+to RE-PLACEMENT: when the breaker would trip and a placer knows an
+untried slice, the tenant restarts there instead of quarantining
+(serve/supervisor.py)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class DeviceSlice:
+    """An ordered, disjoint subset of the process's devices — the
+    device/mesh handle a tenant session dispatches through.
+
+    ``activate()`` returns the thread-local default-device pin (enter it
+    around anything that should dispatch on this slice); ``mesh()``
+    builds a named mesh over the slice's devices via
+    ``parallel/mesh.make_mesh`` for sharded workloads."""
+
+    def __init__(self, name: str, devices: Sequence):
+        if not devices:
+            raise ValueError(f"slice {name!r} needs at least one device")
+        self.name = str(name)
+        self.devices = tuple(devices)
+
+    @property
+    def primary(self):
+        """The device single-program dispatches pin to."""
+        return self.devices[0]
+
+    @property
+    def label(self) -> str:
+        """Stable ops-surface identifier, e.g. ``cpu:2`` (one device) or
+        ``cpu:0-3`` (a multi-device slice) — the per-tenant ``device=``
+        label value on /metrics and the DEVICE column of ``status``."""
+        ids = sorted(int(getattr(d, "id", 0)) for d in self.devices)
+        platform = getattr(self.primary, "platform", "device")
+        if len(ids) == 1:
+            return f"{platform}:{ids[0]}"
+        return f"{platform}:{ids[0]}-{ids[-1]}"
+
+    def activate(self):
+        """Thread-local ``jax.default_device`` pin on the slice's primary
+        device (a context manager; composes with activate_scope)."""
+        import jax
+
+        return jax.default_device(self.primary)
+
+    def mesh(self, axis_name: str = "clients"):
+        """A 1-D mesh over ALL of the slice's devices (the ``parallel/``
+        runtime's handle, for multi-device-per-slice tenants)."""
+        from fedml_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(axis_name=axis_name, devices=self.devices)
+
+    def __repr__(self) -> str:
+        return f"DeviceSlice({self.name!r}, {self.label})"
+
+
+def build_slices(
+    num_slices: int,
+    devices_per_slice: int = 0,
+    devices: Optional[Sequence] = None,
+) -> List[DeviceSlice]:
+    """Partition the visible devices into ``num_slices`` disjoint slices
+    (``devices_per_slice=0`` splits evenly, dropping any remainder).
+    Raises when the host cannot yield that many slices — a placement
+    spec must fail loudly, not silently co-schedule."""
+    import jax
+
+    devs = list(devices if devices is not None else jax.devices())
+    n = int(num_slices)
+    if n < 1:
+        raise ValueError(f"num_slices must be >= 1, got {n}")
+    per = int(devices_per_slice) if devices_per_slice else len(devs) // n
+    if per < 1 or n * per > len(devs):
+        raise ValueError(
+            f"cannot carve {n} slice(s) x {devices_per_slice or 'auto'} "
+            f"device(s) out of {len(devs)} visible device(s) "
+            "(forced-host-device CPU runs: set XLA_FLAGS="
+            "--xla_force_host_platform_device_count)"
+        )
+    return [
+        DeviceSlice(f"slice{i}", devs[i * per:(i + 1) * per])
+        for i in range(n)
+    ]
+
+
+class Placer:
+    """Bin-pack tenants onto slices (thread-safe).
+
+    Unpinned tenants land on the slice with the least accumulated
+    admission-priced cost (ties: fewer tenants, then lowest index); a
+    ``pin`` (slice index) overrides. ``replace`` re-places a tenant on a
+    slice it has NOT yet tried — the supervisor's crash-loop escalation
+    — and returns None once every slice has been tried (quarantine is
+    then the right answer)."""
+
+    def __init__(self, slices: Sequence[DeviceSlice]):
+        if not slices:
+            raise ValueError("Placer needs at least one DeviceSlice")
+        labels = [s.label for s in slices]
+        if len(set(labels)) != len(labels):
+            raise ValueError(f"slices overlap/duplicate: {labels}")
+        self.slices = list(slices)
+        self._lock = threading.Lock()
+        self._assigned: Dict[str, DeviceSlice] = {}  # tenant -> slice
+        self._cost: Dict[str, float] = {s.label: 0.0 for s in slices}
+        self._tenant_cost: Dict[str, float] = {}
+        # slices a tenant has ever occupied — the replace() exclusion set
+        self._history: Dict[str, set] = {}
+
+    def _occupancy(self, s: DeviceSlice) -> Tuple[float, int, int]:
+        n = sum(1 for sl in self._assigned.values() if sl is s)
+        return (self._cost[s.label], n, self.slices.index(s))
+
+    def place(
+        self,
+        tenant: str,
+        cost: float = 0.0,
+        pin: Optional[int] = None,
+    ) -> DeviceSlice:
+        """Assign ``tenant`` to a slice and return it. ``cost`` is the
+        admission-priced load estimate (flops-derived when priced, 0.0
+        when not — tenant count then breaks the tie). ``pin`` forces a
+        slice index (the ``device_slice`` spec key)."""
+        with self._lock:
+            if tenant in self._assigned:
+                raise ValueError(f"tenant {tenant!r} already placed")
+            if pin is not None:
+                if not 0 <= int(pin) < len(self.slices):
+                    raise ValueError(
+                        f"tenant {tenant!r} pins device_slice={pin} but "
+                        f"only slices 0..{len(self.slices) - 1} exist"
+                    )
+                chosen = self.slices[int(pin)]
+            else:
+                chosen = min(self.slices, key=self._occupancy)
+            self._assign(tenant, chosen, float(cost))
+            return chosen
+
+    def _assign(self, tenant: str, s: DeviceSlice, cost: float) -> None:
+        self._assigned[tenant] = s
+        self._tenant_cost[tenant] = cost
+        self._cost[s.label] += cost
+        self._history.setdefault(tenant, set()).add(s.label)
+
+    def release(self, tenant: str) -> None:
+        with self._lock:
+            s = self._assigned.pop(tenant, None)
+            if s is not None:
+                self._cost[s.label] -= self._tenant_cost.pop(tenant, 0.0)
+
+    def slice_of(self, tenant: str) -> Optional[DeviceSlice]:
+        with self._lock:
+            return self._assigned.get(tenant)
+
+    def replace(
+        self, tenant: str, exclude: Optional[str] = None
+    ) -> Optional[DeviceSlice]:
+        """Move ``tenant`` to the least-loaded slice it has never
+        occupied (supervisor crash-loop escalation). ``exclude`` names a
+        slice label to also rule out — the slice the caller observes the
+        tenant crashing on, which matters when the tenant was placed
+        EXPLICITLY (a caller-passed ``device_slice`` never went through
+        ``place()``, so the history alone would happily hand back the
+        sick slice). None when every slice has been tried — the caller
+        should quarantine."""
+        with self._lock:
+            tried = set(self._history.get(tenant, set()))
+            if exclude is not None:
+                tried.add(str(exclude))
+            current = self._assigned.get(tenant)
+            candidates = [s for s in self.slices if s.label not in tried]
+            if not candidates:
+                return None
+            chosen = min(candidates, key=self._occupancy)
+            cost = self._tenant_cost.get(tenant, 0.0)
+            if current is not None:
+                self._cost[current.label] -= cost
+                del self._assigned[tenant]
+                self._tenant_cost.pop(tenant, None)
+            self._assign(tenant, chosen, cost)
+            return chosen
+
+    def snapshot(self) -> dict:
+        """JSON-ready placement picture for /status: per-slice tenant
+        lists + accumulated priced cost."""
+        with self._lock:
+            out = {}
+            for s in self.slices:
+                out[s.label] = {
+                    "devices": len(s.devices),
+                    "tenants": sorted(
+                        t for t, sl in self._assigned.items() if sl is s
+                    ),
+                    "cost": round(self._cost[s.label], 3),
+                }
+            return out

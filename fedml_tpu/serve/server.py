@@ -41,14 +41,32 @@ class FederationServer:
         self,
         log_dir: Optional[str] = None,
         prom_port: Optional[int] = None,
+        placer=None,
+        admission=None,
+        admin_token: Optional[str] = None,
     ):
         self.view = TenantedRegistryView(base=get_global_registry())
         self._sessions: Dict[str, FedSession] = {}
         self._order: List[str] = []
         self._lock = threading.Lock()
+        # serializes create_session end-to-end: the admin API runs on a
+        # THREADING http server, and the admission cap / duplicate-name
+        # checks are check-then-act — two concurrent POST /tenants must
+        # not both read "3 live tenants" and overshoot max_tenants=4
+        self._admit_lock = threading.Lock()
         self._exporter = None
         self._introspector = None
+        self._admin = None
         self._prom_port = prom_port
+        # the control plane (ROADMAP item 2): a Placer bin-packs tenants
+        # onto device slices (serve/placement.py), an AdmissionController
+        # prices candidates before create_session builds anything
+        # (serve/admission.py), and a non-empty admin_token enables the
+        # HTTP write surface on the metrics port (serve/admin.py) —
+        # without a token the service is read-only, exactly as before.
+        self.placer = placer
+        self.admission = admission
+        self._admin_token = admin_token
         self.logger = None
         if log_dir:
             from fedml_tpu.utils import MetricsLogger
@@ -64,24 +82,69 @@ class FederationServer:
         :class:`~fedml_tpu.serve.supervisor.RestartPolicy`, or an int
         restart budget) makes the tenant SUPERVISED: a crash restarts it
         from its latest rolling checkpoint under backoff instead of
-        failing the tenant (fedml_tpu/serve/supervisor.py)."""
+        failing the tenant (fedml_tpu/serve/supervisor.py).
+
+        With an :class:`~fedml_tpu.serve.admission.AdmissionController`
+        installed the candidate is priced FIRST — a refusal raises
+        :class:`~fedml_tpu.serve.admission.AdmissionRefused` before any
+        data/model state is touched. With a
+        :class:`~fedml_tpu.serve.placement.Placer` installed the tenant
+        gets a device slice (``AdminConfig.device_slice`` pins one;
+        otherwise least-loaded by priced cost) unless the caller passed
+        ``device_slice`` explicitly."""
+        with self._admit_lock:
+            return self._create_session(
+                name, config, data, model, restart=restart, **kw
+            )
+
+    def _create_session(self, name, config, data, model, restart=None, **kw):
         with self._lock:
             if name in self._sessions:
                 raise ValueError(f"tenant {name!r} already registered")
-        kw.setdefault("scope", TelemetryScope(tenant=name))
-        if restart is not None:
-            from fedml_tpu.serve.supervisor import (
-                RestartPolicy,
-                SupervisedSession,
-            )
+        decision = None
+        if self.admission is not None:
+            from fedml_tpu.serve.admission import AdmissionRefused
 
-            if isinstance(restart, int):
-                restart = RestartPolicy(budget=restart)
-            session = SupervisedSession(
-                config, data, model, name=name, restart=restart, **kw
+            decision = self.admission.decide(
+                name, config, model, task=kw.get("task", "classification"),
+                live_tenants=len(self._sessions),
             )
-        else:
-            session = FedSession(config, data, model, name=name, **kw)
+            if not decision.admit:
+                raise AdmissionRefused(decision)
+        if self.placer is not None and kw.get("device_slice") is None:
+            admin_cfg = getattr(config, "admin", None)
+            pin = getattr(admin_cfg, "device_slice", -1)
+            cost = (decision.priced.get("gflops_per_round") or 0.0) if (
+                decision is not None
+            ) else 0.0
+            kw["device_slice"] = self.placer.place(
+                name, cost=cost, pin=pin if pin is not None and pin >= 0
+                else None,
+            )
+        kw.setdefault("scope", TelemetryScope(tenant=name))
+        try:
+            if restart is not None:
+                from fedml_tpu.serve.supervisor import (
+                    RestartPolicy,
+                    SupervisedSession,
+                )
+
+                if isinstance(restart, int):
+                    restart = RestartPolicy(budget=restart)
+                session = SupervisedSession(
+                    config, data, model, name=name, restart=restart,
+                    placer=self.placer,
+                    on_replacement=self._relabel_device, **kw
+                )
+            else:
+                session = FedSession(config, data, model, name=name, **kw)
+        except BaseException:
+            # a rejected build must release its placement — in a
+            # long-lived service every misconfigured spec would
+            # otherwise permanently inflate a slice's load
+            if self.placer is not None:
+                self.placer.release(name)
+            raise
         return self.add_session(session)
 
     def add_session(self, session: FedSession) -> FedSession:
@@ -94,16 +157,51 @@ class FederationServer:
             self._sessions[session.name] = session
             self._order.append(session.name)
         if session.scope is not None:
-            # device label groundwork (ROADMAP item 2): tenant-scoped
-            # samples carry the backend their session dispatches to,
-            # so a multi-slice placement can tell tenants' devices apart
-            # on one /metrics
+            # per-tenant device label (ROADMAP item 2): tenant-scoped
+            # samples carry the SLICE the session dispatches on (the
+            # placement handle's label), falling back to the process
+            # backend kind for unplaced tenants — one /metrics tells
+            # tenants' devices apart
             self.view.add_tenant(
                 session.name,
                 session.scope.registry,
-                extra={"device": _device_kind()},
+                extra={"device": self._device_label(session)},
             )
         return session
+
+    @staticmethod
+    def _device_label(session) -> str:
+        sl = getattr(session, "device_slice", None)
+        return sl.label if sl is not None else _device_kind()
+
+    def _relabel_device(self, name: str, new_slice) -> None:
+        """Supervisor re-placement callback: the tenant's ``device=``
+        label on /metrics must follow it to the new slice."""
+        s = self._sessions.get(name)
+        if s is not None and s.scope is not None:
+            self.view.add_tenant(
+                name, s.scope.registry, extra={"device": new_slice.label}
+            )
+
+    def forget_session(self, name: str) -> None:
+        """Unregister a tenant whose session failed before it ever ran
+        (the admin add path's cleanup when ``start()`` rejects the
+        build): the name becomes immediately reusable and the
+        placement/metrics bookkeeping is released. Refuses to forget a
+        running tenant — drain/stop it first."""
+        with self._lock:
+            s = self._sessions.get(name)
+            if s is None:
+                return
+            if s.state == "running":
+                raise ValueError(
+                    f"tenant {name!r} is running — drain/stop it instead"
+                )
+            del self._sessions[name]
+            self._order.remove(name)
+        self.view.remove_tenant(name)
+        if self.placer is not None:
+            self.placer.release(name)
 
     def session(self, name: str) -> FedSession:
         return self._sessions[name]
@@ -134,11 +232,22 @@ class FederationServer:
             from fedml_tpu.serve.introspect import Introspector
 
             self._introspector = Introspector(self).install(self._exporter)
+            if self._admin_token:
+                # the WRITE path (serve/admin.py): POST /tenants (+ per-
+                # tenant drain/stop/reload) behind the bearer token. No
+                # token, no write surface — a scrape can never mutate.
+                from fedml_tpu.serve.admin import AdminApi
+
+                self._admin = AdminApi(
+                    self, token=self._admin_token
+                ).install(self._exporter)
             self._exporter.start()
             logging.info(
                 "serve: prometheus metrics on http://127.0.0.1:%d/metrics "
-                "(introspection: /status /tenants/<name> /compile /healthz)",
+                "(introspection: /status /tenants/<name> /compile /healthz"
+                "%s)",
                 self._exporter.port,
+                "; admin WRITE api enabled" if self._admin else "",
             )
         for s in self.sessions():
             if names is not None and s.name not in names:
@@ -209,6 +318,118 @@ class FederationServer:
 
     def status(self) -> dict:
         return {s.name: s.status() for s in self.sessions()}
+
+    # -- hot reload (the admin surface's /tenants/<name>/reload) -----------
+
+    RELOADABLE_KEYS = (
+        "slo_round_s", "slo_p95_round_s", "slo_min_rounds_per_s",
+        "slo_max_recompiles", "slo_straggler_frac", "restart_budget",
+    )
+
+    def reload_tenant(self, name: str, updates: dict) -> dict:
+        """Apply RELOADABLE spec keys to ONE live tenant without touching
+        co-tenants: the ``slo_*`` keys swap the tenant's watchdog policy
+        atomically (a null value clears that objective), and
+        ``restart_budget`` replaces a supervised tenant's budget (the
+        supervision loop re-reads it at the next crash, the remaining-
+        budget gauge immediately). Raises KeyError for an unknown tenant,
+        ValueError for non-reloadable keys — nothing is applied then."""
+        import dataclasses
+
+        from fedml_tpu.serve.slo import SLO_SPEC_KEYS
+
+        session = self._sessions.get(name)
+        if session is None:
+            raise KeyError(name)
+        unknown = set(updates) - set(self.RELOADABLE_KEYS)
+        if unknown:
+            raise ValueError(
+                f"non-reloadable keys {sorted(unknown)} — reloadable keys "
+                f"are {sorted(self.RELOADABLE_KEYS)}"
+            )
+        budget = None
+        if "restart_budget" in updates:
+            if not hasattr(session, "restart"):
+                raise ValueError(
+                    f"tenant {name!r} is not supervised: restart_budget "
+                    "only applies to tenants created with a restart policy"
+                )
+            # validate BEFORE the SLO half runs: a malformed budget in a
+            # mixed body must apply NOTHING (the all-or-nothing contract
+            # above), not leave the new SLOs live behind a 400
+            try:
+                budget = int(updates["restart_budget"])
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"restart_budget must be an int, got "
+                    f"{updates['restart_budget']!r}"
+                )
+        applied = {}
+        slo_updates = {k: v for k, v in updates.items() if k in SLO_SPEC_KEYS}
+        if slo_updates:
+            applied.update(self._reload_slo(session, slo_updates))
+        if budget is not None:
+            session.restart = dataclasses.replace(
+                session.restart, budget=budget
+            )
+            session._g_budget.set(max(0, budget - session.restarts))
+            applied["restart_budget"] = budget
+        return applied
+
+    def _reload_slo(self, session, slo_updates: dict) -> dict:
+        import dataclasses
+
+        from fedml_tpu.serve.slo import SLO_SPEC_KEYS, SloPolicy, SloWatchdog
+
+        # the supervised wrapper delegates SLO state to its current
+        # attempt; the watchdog itself is scope-resident either way
+        inner = getattr(session, "session", None) or session
+        scope = session.scope
+        wd = getattr(scope, "slo_watchdog", None) if scope is not None else None
+        if wd is None:
+            wd = getattr(inner, "_slo_watchdog", None)
+        changes = {}
+        for spec_key, field in SLO_SPEC_KEYS.items():
+            if spec_key in slo_updates:
+                v = slo_updates[spec_key]
+                if v is None:
+                    changes[field] = None
+                else:
+                    changes[field] = (
+                        int(v) if field == "max_recompiles" else float(v)
+                    )
+        base = (
+            wd.policy if wd is not None
+            else (getattr(inner, "slo", None) or SloPolicy())
+        )
+        new_policy = dataclasses.replace(base, **changes)
+        if wd is not None:
+            # atomic swap: the next flight fold evaluates the new
+            # objectives; breach history stays monotonic
+            wd.policy = new_policy
+        else:
+            flight = getattr(inner, "flight", None) or (
+                getattr(scope, "flight", None) if scope is not None else None
+            )
+            if flight is None:
+                raise ValueError(
+                    f"tenant {session.name!r} has no flight recorder yet "
+                    "(not started): declare SLOs in the spec instead"
+                )
+            wd = SloWatchdog(
+                new_policy, flight=flight,
+                registry=scope.registry if scope is not None else None,
+                tenant=session.name,
+            )
+            if scope is not None:
+                scope.slo_watchdog = wd
+            inner._slo_watchdog = wd
+        # future supervised restart attempts must inherit the reloaded
+        # policy, not the spec's original
+        inner.slo = new_policy
+        if hasattr(session, "_session_kw"):
+            session._session_kw["slo"] = new_policy
+        return dict(slo_updates)
 
     def render_metrics(self) -> str:
         """The exact text the /metrics endpoint serves (tests/ops)."""

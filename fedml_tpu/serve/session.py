@@ -94,6 +94,7 @@ class FedSession:
         max_workers: Optional[int] = None,
         scope: Optional[TelemetryScope] = None,
         slo=None,
+        device_slice=None,
     ):
         if algorithm not in SESSION_ALGORITHMS:
             raise ValueError(
@@ -134,6 +135,13 @@ class FedSession:
         self.resume = bool(resume)
         self.max_workers = max_workers
         self.scope = scope
+        # the tenant's device/mesh handle (serve/placement.py): every
+        # thread this session spawns — and its build — runs under the
+        # slice's thread-local default-device pin, so the tenant
+        # dispatches on ITS slice instead of the process-global backend
+        # (ROADMAP item 2's enabling refactor). None = legacy behavior,
+        # byte-identical to every pre-placement run.
+        self.device_slice = device_slice
         # SLO policy (serve/slo.py) — evaluated against the flight
         # recorder each round; breaches degrade, they never crash
         if slo is not None:
@@ -175,6 +183,20 @@ class FedSession:
         # misconfigured-spec exit class) vs "run" (the federation itself
         # crashed); None while healthy
         self.failure_phase: Optional[str] = None
+
+    def _activation(self, scope):
+        """One context for everything a session thread needs active: the
+        telemetry scope AND (when placed) the device-slice pin. Every
+        thread the session spawns enters this — the slice pin is
+        thread-local exactly like the scope, so co-tenants on other
+        slices are untouched."""
+        import contextlib
+
+        stack = contextlib.ExitStack()
+        stack.enter_context(activate_scope(scope))
+        if self.device_slice is not None:
+            stack.enter_context(self.device_slice.activate())
+        return stack
 
     # -- comm factories (namespaced per session) ---------------------------
 
@@ -468,7 +490,10 @@ class FedSession:
             attached_recorder,
         )
 
-        self.device = _device_kind()
+        self.device = (
+            self.device_slice.label if self.device_slice is not None
+            else _device_kind()
+        )
         scope = self.scope
         rec = getattr(scope, "flight", None) if scope is not None else None
         if rec is None and scope is None:
@@ -519,7 +544,7 @@ class FedSession:
             self._slo_watchdog = wd
 
     def _start_built(self) -> "FedSession":
-        with activate_scope(self.scope):
+        with self._activation(self.scope):
             self._init_flight()
             if self.comm_factory is None:
                 self.comm_factory = self._default_comm_factory()
@@ -560,7 +585,7 @@ class FedSession:
             # A dead client would stall the server (sync barrier) or
             # starve the buffer (async); surface the failure by stopping
             # the server loop.
-            with activate_scope(prop):
+            with self._activation(prop):
                 try:
                     c.run()
                 except BaseException as e:  # noqa: BLE001
@@ -577,11 +602,11 @@ class FedSession:
         ]
         for t in self.threads:
             t.start()
-        with activate_scope(self.scope):
+        with self._activation(self.scope):
             self.server.send_init_msg()
 
         def server_main():
-            with activate_scope(prop):
+            with self._activation(prop):
                 try:
                     self.server.run()
                 except BaseException as e:  # noqa: BLE001
@@ -780,7 +805,7 @@ class FedSession:
         with self._lock:
             rank = self._next_rank
             self._next_rank += 1
-        with activate_scope(self.scope):
+        with self._activation(self.scope):
             client = FedBuffClientManager(
                 self.config,
                 self.comm_factory(rank),

@@ -114,6 +114,8 @@ class SupervisedSession:
         name: Optional[str] = None,
         restart: Optional[RestartPolicy] = None,
         scope: Optional[TelemetryScope] = None,
+        placer=None,
+        on_replacement=None,
         **session_kw,
     ):
         import uuid
@@ -124,6 +126,15 @@ class SupervisedSession:
         self.name = name or f"supervised-{uuid.uuid4().hex[:8]}"
         self.scope = scope
         self.restart = restart or RestartPolicy()
+        # crash-loop ESCALATION (serve/placement.py): when the breaker
+        # would trip and the placer knows a slice this tenant has not
+        # yet tried, restart THERE instead of quarantining — a
+        # deterministic crash tied to one slice (a sick chip, a
+        # co-tenant interaction) is fixed by moving, not by retrying in
+        # place. None = classic restart-in-place only.
+        self._placer = placer
+        self._on_replacement = on_replacement
+        self.replacements = 0
         self._session_kw = dict(session_kw)
         self.checkpoint_path = self._session_kw.get("checkpoint_path")
         if not self.checkpoint_path or not self._session_kw.get(
@@ -168,6 +179,11 @@ class SupervisedSession:
         self._g_quarantined = r.gauge(
             "fedml_session_quarantined",
             "1 when the supervisor gave up (budget exhausted or crash loop)",
+        )
+        self._c_replacements = r.counter(
+            "fedml_session_replacements_total",
+            "Crash-loop escalations: tenant re-placed on a different "
+            "device slice instead of quarantined",
         )
         self._g_budget.set(self.restart.budget)
         self._g_quarantined.set(0)
@@ -220,7 +236,6 @@ class SupervisedSession:
         return self
 
     def _supervise(self) -> None:
-        policy = self.restart
         attempt = 0
         last_progress: Optional[int] = None
         streak = 0  # consecutive crashes with no forward progress
@@ -268,9 +283,18 @@ class SupervisedSession:
                 if self._stop_requested:
                     self._terminal(e, phase="run")
                     return
+                # re-read each crash: restart_budget is hot-reloadable
+                # through the admin surface (serve/admin.py) — a frozen
+                # local would silently ignore an operator's budget bump
+                policy = self.restart
                 if policy.breaker_window and streak >= policy.breaker_window:
-                    self._quarantine(e, attempt, reason="crash_loop")
-                    return
+                    if self._try_replacement(e):
+                        # escalated: fresh slice, fresh streak — the
+                        # restart below still burns budget (the hard cap)
+                        streak = 0
+                    else:
+                        self._quarantine(e, attempt, reason="crash_loop")
+                        return
                 if attempt >= policy.budget:
                     self._quarantine(e, attempt, reason="budget")
                     return
@@ -301,6 +325,38 @@ class SupervisedSession:
                     self.name, self.restarts,
                 )
             return
+
+    def _try_replacement(self, err: BaseException) -> bool:
+        """Crash-loop escalation: move the tenant to a device slice it
+        has never tried (serve/placement.py). False when there is no
+        placer or every slice has been tried — the caller quarantines."""
+        if self._placer is None:
+            return False
+        old = self._session_kw.get("device_slice")
+        new_slice = self._placer.replace(
+            self.name, exclude=getattr(old, "label", None)
+        )
+        if new_slice is None:
+            return False
+        self._session_kw["device_slice"] = new_slice
+        self.replacements += 1
+        self._c_replacements.inc()
+        if self._on_replacement is not None:
+            try:
+                # the serve layer re-labels the tenant's /metrics
+                # device= to the new slice
+                self._on_replacement(self.name, new_slice)
+            except Exception:  # noqa: BLE001 — labeling must not block
+                logging.exception(
+                    "re-placement callback for %s failed", self.name
+                )
+        logging.warning(
+            "supervisor: tenant %s crash-looping on %s (%r) — escalating "
+            "from restart-in-place to re-placement on %s",
+            self.name, getattr(old, "label", "<default device>"), err,
+            new_slice.label,
+        )
+        return True
 
     def _mode(self) -> str:
         return getattr(self.session, "mode", None) or (
@@ -426,6 +482,7 @@ class SupervisedSession:
         return {
             "supervisor/restarts": self.restarts,
             "supervisor/restart_budget": self.restart.budget,
+            "supervisor/replacements": self.replacements,
             "supervisor/recovered": int(self.recovered),
             "supervisor/quarantined": int(
                 isinstance(self._terminal_error, RestartBudgetExhausted)
@@ -464,6 +521,12 @@ class SupervisedSession:
         if self.scope is not None and getattr(self.scope, "flight", None):
             return self.scope.flight
         return self.session.flight if self.session is not None else None
+
+    @property
+    def device_slice(self):
+        """The tenant's CURRENT slice handle (re-placement updates it
+        between attempts)."""
+        return self._session_kw.get("device_slice")
 
     @property
     def device(self):

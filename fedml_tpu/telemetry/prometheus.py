@@ -1,9 +1,11 @@
 """Stdlib-only Prometheus exporter — a ``/metrics`` text-exposition
 endpoint over ``http.server``, off by default (CLI flag ``--prom_port``),
-plus a small read-only route table for JSON introspection endpoints
-(fedml_tpu/serve/introspect.py registers ``/status``, ``/tenants/<name>``,
-``/compile`` and a tenant-aware ``/healthz`` on the SAME server — one
-port, one ops surface).
+plus a small METHOD-AWARE route table for JSON endpoints: read-only
+introspection (fedml_tpu/serve/introspect.py registers ``/status``,
+``/tenants/<name>``, ``/compile`` and a tenant-aware ``/healthz``) and
+the serve layer's write-path admin surface (fedml_tpu/serve/admin.py
+registers POST ``/tenants`` + POST ``/tenants/<name>/<action>`` on the
+SAME server — one port, one ops surface).
 
 No prometheus_client dependency: the registry (telemetry/metrics.py)
 renders the text format itself. The server runs on a daemon thread and
@@ -16,10 +18,14 @@ Routing contract: ``/metrics`` (and the legacy ``/`` alias) serve the
 exposition; registered routes answer their exact path — a route key
 ending in ``/`` matches as a prefix (``/tenants/`` serves
 ``/tenants/<name>``); EVERYTHING else is 404 (never a silent metrics
-answer — the server hosts multiple endpoints now). Route callables take
-the request path and return ``(status, payload)`` where a dict/list
-payload is JSON-encoded; a raising route answers 500 without taking the
-server down."""
+answer — the server hosts multiple endpoints now). Routes are registered
+PER METHOD: a path whose entry lacks the request's method answers 405
+with an ``Allow`` header, so a GET scrape hitting a mutating admin route
+can never execute it (and a POST to a read-only route cannot either).
+GET route callables take the request path and return ``(status,
+payload)``; POST callables take ``(path, body_bytes, headers)`` and
+return the same shape. A dict/list payload is JSON-encoded; a raising
+route answers 500 without taking the server down."""
 
 from __future__ import annotations
 
@@ -33,24 +39,31 @@ from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
-Route = Callable[[str], Tuple[int, object]]
+Route = Callable[..., Tuple[int, object]]
+
+# request-body cap for POST routes: admin payloads are tenant specs
+# (KBs); anything larger is hostile or a mistake
+_MAX_BODY = 1 << 20
 
 
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry  # injected per-server subclass
-    routes: Dict[str, Route]  # injected per-server subclass (shared dict)
+    # injected per-server subclass (shared LIVE dict): path -> {method: fn}
+    routes: Dict[str, Dict[str, Route]]
 
-    def _send(self, status: int, ctype: str, body: bytes) -> None:
+    def _send(self, status: int, ctype: str, body: bytes, extra=None) -> None:
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _route_for(self, path: str) -> Optional[Route]:
-        fn = self.routes.get(path)
-        if fn is not None:
-            return fn
+    def _entry_for(self, path: str) -> Optional[Dict[str, Route]]:
+        entry = self.routes.get(path)
+        if entry is not None:
+            return entry
         # snapshot: add_route may mutate the live dict from another
         # thread mid-scrape (it is documented to work after start())
         for prefix, cand in list(self.routes.items()):
@@ -62,24 +75,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return cand
         return None
 
-    def do_GET(self):  # noqa: N802 — http.server API
-        path = self.path.split("?", 1)[0]
-        if path in ("/metrics", "/"):
-            body = self.registry.render().encode("utf-8")
-            return self._send(200, CONTENT_TYPE, body)
-        fn = self._route_for(path)
-        if fn is None:
-            if path == "/healthz":
-                # liveness default when no introspection routes are
-                # installed (the single-run exporter) — the serve layer
-                # overrides this with the tenant-aware probe
-                return self._send(200, "text/plain", b"ok\n")
-            return self.send_error(404)
-        try:
-            status, payload = fn(path)
-        except Exception:  # noqa: BLE001 — a route must not kill the server
-            logging.exception("introspection route %s failed", path)
-            return self.send_error(500)
+    def _answer(self, status: int, payload) -> None:
         if isinstance(payload, (dict, list)):
             body = json.dumps(payload, default=str).encode("utf-8")
             ctype = "application/json"
@@ -89,6 +85,59 @@ class _Handler(BaseHTTPRequestHandler):
             body = str(payload).encode("utf-8")
             ctype = "text/plain; charset=utf-8"
         self._send(int(status), ctype, body)
+
+    def _dispatch(self, method: str, *extra_args) -> None:
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            if method != "GET":
+                # the exposition is read-only by definition
+                return self._method_not_allowed(("GET",))
+            body = self.registry.render().encode("utf-8")
+            return self._send(200, CONTENT_TYPE, body)
+        entry = self._entry_for(path)
+        if entry is None:
+            if path == "/healthz" and method == "GET":
+                # liveness default when no introspection routes are
+                # installed (the single-run exporter) — the serve layer
+                # overrides this with the tenant-aware probe
+                return self._send(200, "text/plain", b"ok\n")
+            return self.send_error(404)
+        fn = entry.get(method)
+        if fn is None:
+            # the path exists but not under this method: a scrape (GET)
+            # of a mutating admin route must NEVER execute it — 405, not
+            # 404, so the operator sees "wrong verb", not "no such thing"
+            return self._method_not_allowed(sorted(entry))
+        try:
+            status, payload = fn(path, *extra_args)
+        except Exception:  # noqa: BLE001 — a route must not kill the server
+            logging.exception("route %s %s failed", method, path)
+            return self.send_error(500)
+        self._answer(status, payload)
+
+    def _method_not_allowed(self, allowed) -> None:
+        body = json.dumps(
+            {"error": "method not allowed", "allow": list(allowed)}
+        ).encode("utf-8")
+        self._send(
+            405, "application/json", body, extra={"Allow": ", ".join(allowed)}
+        )
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        # clamp negatives: read(-1) would block until client EOF — a
+        # held-open socket pinning a handler thread before auth runs
+        length = max(0, length)
+        if length > _MAX_BODY:
+            return self.send_error(413)
+        body = self.rfile.read(length) if length else b""
+        self._dispatch("POST", body, self.headers)
 
     def log_message(self, fmt, *args):  # silence per-scrape stderr lines
         pass
@@ -108,15 +157,22 @@ class PrometheusExporter:
         self._requested_port = int(port)
         self.registry = registry or get_registry()
         # live dict shared with the handler class: add_route works before
-        # AND after start()
-        self.routes: Dict[str, Route] = dict(routes or {})
+        # AND after start(). Values are per-method tables.
+        self.routes: Dict[str, Dict[str, Route]] = {}
+        for path, fn in (routes or {}).items():
+            self.add_route(path, fn)
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
-    def add_route(self, path: str, fn: Route) -> "PrometheusExporter":
-        """Register ``fn(path) -> (status, payload)`` at ``path`` (a
-        trailing ``/`` makes it a prefix route)."""
-        self.routes[str(path)] = fn
+    def add_route(
+        self, path: str, fn: Route, method: str = "GET"
+    ) -> "PrometheusExporter":
+        """Register ``fn`` at ``path`` under ``method`` (a trailing ``/``
+        makes it a prefix route). GET callables are ``fn(path) ->
+        (status, payload)``; POST callables ``fn(path, body, headers)``.
+        Registering a second method on an existing path extends its
+        entry — requests arriving with any other method answer 405."""
+        self.routes.setdefault(str(path), {})[str(method).upper()] = fn
         return self
 
     @property
