@@ -215,7 +215,8 @@ def _throughput_row(api, warmup: int, timed: int, label: str,
     }
 
 
-def _north_star_api(compute_dtype="float32", comm_round=1, fused_rounds=1):
+def _north_star_api(compute_dtype="float32", comm_round=1, fused_rounds=1,
+                    fused_plan="static"):
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
     from fedml_tpu.data.femnist_synth import femnist_synthetic
@@ -229,6 +230,7 @@ def _north_star_api(compute_dtype="float32", comm_round=1, fused_rounds=1):
             comm_round=comm_round,
             epochs=1,
             fused_rounds=fused_rounds,
+            fused_plan=fused_plan,
             frequency_of_the_test=10_000,
         ),
         train=TrainConfig(
@@ -286,6 +288,143 @@ def _trainloop_rows(compute_dtype, total=64, chunk=16, repeats=3):
         row("north_star_eager_trainloop", "eager", 1),
         row("north_star_fused", "fused", chunk),
     )
+
+
+def _fused_vs_eager(total=32, chunk=8, repeats=2):
+    """ISSUE 14 gate row: BOTH schedules on the north-star config through
+    the production train() loop (interleaved best-of, like
+    _trainloop_rows), PLUS a measured-plan run whose planner must commit
+    to the winner from flight-recorder probes — the decision is recorded
+    here, and agreement with the interleaved measurement is reported
+    (the ci.sh CPU-proxy gate asserts it; on TPU this row is the record
+    for the next r0x pass)."""
+    apis = {
+        "eager": _north_star_api("float32", comm_round=total, fused_rounds=1),
+        "fused": _north_star_api(
+            "float32", comm_round=total, fused_rounds=chunk
+        ),
+    }
+    if apis["fused"]._store is None:
+        return {"skipped": "no device store — fused path unavailable"}
+    best = {}
+    for name, api in apis.items():  # warm: compiles every shape in horizon
+        api.train()
+        best[name] = float("inf")
+    for _ in range(repeats):
+        for name, api in apis.items():
+            _reset(api)
+            t0 = time.perf_counter()
+            api.train()
+            best[name] = min(best[name], (time.perf_counter() - t0) / total)
+    eager_rps = round(1.0 / best["eager"], 4)
+    fused_rps = round(1.0 / best["fused"], 4)
+    # measured planner arm: fresh API, fused_plan="measured" — the
+    # planner probes both schedules off the flight recorder and commits
+    planner_api = _north_star_api(
+        "float32", comm_round=total, fused_rounds=chunk,
+        fused_plan="measured",
+    )
+    planner_api.train()
+    psum = (
+        planner_api.planner.summary_row()
+        if planner_api.planner is not None
+        else {}
+    )
+    decision = psum.get("flight/planner_schedule")
+    measured_winner = "fused" if fused_rps >= eager_rps else "eager"
+    return {
+        "label": "fused_vs_eager",
+        "compute_dtype": "float32",
+        "fused_rounds": chunk,
+        "eager_rounds_per_sec": eager_rps,
+        "fused_rounds_per_sec": fused_rps,
+        # the winner's rate IS the row's r/s — what --compare tracks
+        "rounds_per_sec": max(eager_rps, fused_rps),
+        "fused_over_eager": round(fused_rps / eager_rps, 3),
+        "planner_decision": decision,
+        "planner_probe": {
+            k: v for k, v in psum.items() if k.startswith("flight/probe_")
+        },
+        "planner_agrees_with_interleaved": (
+            decision == measured_winner if decision else None
+        ),
+        "timed_via": (
+            f"production train() loop, interleaved best of {repeats}; "
+            "planner decision from a separate fused_plan=measured run"
+        ),
+    }
+
+
+def _uplink_bytes_rows(comm_round=12):
+    """Quantized-uplink byte accounting read off the COMM METER (the
+    codec byte cut is measured on real uploads, never asserted from
+    codec math): one tiny loopback federation per codec arm, identical
+    config, with bytes/round and the cut vs the fp32 arm from
+    ``comm/uplink_*``. Accuracy parity at this scale lives in
+    tests/test_compression.py (reach@target pinned there); this section
+    is the BYTES record."""
+    from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+    from fedml_tpu.config import (
+        CommConfig, DataConfig, FedConfig, RunConfig, TrainConfig,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.telemetry import get_comm_meter
+
+    data = synthetic_classification(
+        num_clients=4, num_classes=3, feat_shape=(32,),
+        samples_per_client=24, partition_method="homo", seed=9,
+    )
+    arms = {
+        "none": CommConfig(),
+        "int8": CommConfig(compression="int8"),
+        "int4": CommConfig(compression="int4", error_feedback=True),
+        "topk8": CommConfig(
+            compression="topk8", topk_frac=0.05, error_feedback=True
+        ),
+    }
+    out = {"label": "uplink_bytes", "comm_round": comm_round}
+    for name, comm in arms.items():
+        cfg = RunConfig(
+            data=DataConfig(batch_size=-1),
+            fed=FedConfig(
+                client_num_in_total=4, client_num_per_round=4,
+                comm_round=comm_round, epochs=1,
+                frequency_of_the_test=comm_round,
+            ),
+            train=TrainConfig(client_optimizer="sgd", lr=0.5),
+            comm=comm,
+            seed=0,
+        )
+        model = ModelDef(
+            module=LogisticRegression(num_classes=3), input_shape=(32,),
+            num_classes=3, name="lr",
+        )
+        base = get_comm_meter().snapshot()
+        server = run_loopback_federation(cfg, data, model)
+        snap = get_comm_meter().snapshot()
+        payload = snap["uplink_payload_bytes"] - base.get(
+            "uplink_payload_bytes", 0
+        )
+        raw = snap["uplink_raw_bytes"] - base.get("uplink_raw_bytes", 0)
+        row = {
+            "uplink_bytes_per_round": round(payload / comm_round, 1),
+            "uplink_raw_bytes_per_round": round(raw / comm_round, 1),
+            "final_test_loss": round(
+                float(server.history[-1].get("Test/Loss", float("nan"))), 4
+            ),
+        }
+        if name != "none" and raw:
+            # each arm's OWN metered fp32-equivalent bytes is the
+            # denominator-free cut: no cross-arm coupling to the none
+            # arm's totals (which would skew if an arm's upload count
+            # ever differed)
+            row["cut_vs_fp32_x"] = round(raw / max(payload, 1), 2)
+        out[name] = row
+    if "cut_vs_fp32_x" in out.get("int4", {}):
+        out["cut_x"] = out["int4"]["cut_vs_fp32_x"]
+    return out
 
 
 def _bf16_cross_silo(quick: bool = False):
@@ -1356,6 +1495,7 @@ class _Emitter:
         "bf16_cross_silo_resnet56", "flash_attention_s8192",
         "mxu_validation", "scale_100k_clients", "scale_100k_stateful",
         "scale_1m", "fedbuff_async", "process_cold_start",
+        "fused_vs_eager", "uplink_bytes",
     )
 
     def __init__(self, t0: float, detail_path: str,
@@ -1483,6 +1623,13 @@ def _sec_digest(key: str, v) -> str:
         return "?" if v is None else str(v)[:38]
     if "skipped" in v:
         return ("skip:" + str(v["skipped"]))[:38]
+    if "fused_over_eager" in v:
+        return (
+            f"{v['fused_over_eager']}x fused/eager "
+            f"({v.get('planner_decision') or 'no-commit'})"
+        )
+    if "cut_x" in v:
+        return f"{v['cut_x']}x uplink cut (int4)"
     if "rounds_per_sec" in v and "accuracy_gate" in v:  # flagship
         g = v["accuracy_gate"]
         return (
@@ -1629,6 +1776,27 @@ def compare_records(record: dict, baseline: dict, tol_pct: float) -> dict:
             float(hv) if isinstance(hv, (int, float)) else None,
             float(hb) if isinstance(hb, (int, float)) else None,
         )
+    # uplink byte cut (ISSUE 14): higher-is-better like r/s — a shrinking
+    # cut factor past tolerance is a regression too (rows without r/s are
+    # otherwise invisible to this oracle)
+    def _cut(rec_):
+        v = rec_.get("uplink_bytes")
+        if isinstance(v, dict) and isinstance(v.get("cut_x"), (int, float)):
+            return float(v["cut_x"])
+        return None
+
+    nc, oc = _cut(record), _cut(baseline)
+    if nc is not None or oc is not None:
+        r = {"cut_x": nc, "baseline_cut_x": oc}
+        if nc is not None and oc:
+            r["delta_pct"] = round((nc - oc) / oc * 100.0, 1)
+            if r["delta_pct"] < -float(tol_pct):
+                r["regressed"] = True
+                regressions.append(
+                    f"uplink_cut: {nc}x vs baseline {oc}x "
+                    f"({r['delta_pct']:+.1f}% < -{tol_pct}% tol)"
+                )
+        sections["uplink_cut"] = r
     return {
         "regress_tol_pct": float(tol_pct),
         "sections": sections,
@@ -1958,6 +2126,12 @@ def main():
     def s_cold_start():
         emitter.update({"process_cold_start": _process_cold_start()})
 
+    def s_fused_vs_eager():
+        emitter.update({"fused_vs_eager": _fused_vs_eager()})
+
+    def s_uplink():
+        emitter.update({"uplink_bytes": _uplink_bytes_rows()})
+
     if tiny:
         # CI mode (tests/test_bench_resilience.py): a fast real section,
         # then a sleeper the kill-test murders mid-flight. Proves the
@@ -2016,6 +2190,8 @@ def main():
             ("synthetic11", s_synthetic11, 70, 300),
             ("femnist_lda", s_femnist_lda, 170, 500),
             ("trainloop", s_trainloop, 125, 300),
+            ("fused_vs_eager", s_fused_vs_eager, 150, 420),
+            ("uplink_bytes", s_uplink, 40, 240),
             ("fedbuff_async", s_fedbuff, 60, 240),
             ("process_cold_start", s_cold_start, 80, 420),
             ("flash_attention", s_flash, 80, 240),

@@ -181,6 +181,7 @@ def make_fedavg_round(
     aggregate_fn: Optional[Callable] = None,
     client_mode: Optional[str] = None,
     client_metrics: bool = False,
+    robust=None,
 ):
     """Build the jitted FedAvg round function (vmap over clients, one chip).
 
@@ -200,6 +201,16 @@ def make_fedavg_round(
     Off by default: callers that combine metric trees across cohorts of
     different sizes (the hierarchical group loop) must not see
     ragged-shaped leaves.
+
+    ``robust`` (a :class:`fedml_tpu.robustness.RobustConfig`) is the
+    DESCRIBABLE form of the defense hook triple: the hooks are derived
+    inside the builder from the config alone
+    (``make_defense_hooks(robust)`` is a pure function of it), so the
+    robust round — including the Byzantine aggregators
+    median/trimmed-mean/Krum — dedupes through the ProgramCache with
+    ``robust`` in the digest instead of bypassing via ``wrap_uncached``
+    the way opaque hook closures must. Mutually exclusive with passing
+    the hook closures directly.
 
     The returned callable takes an optional keyword ``may_pad`` — the
     host's static knowledge of whether this cohort has any all-padding
@@ -221,9 +232,22 @@ def make_fedavg_round(
         model_fingerprint,
     )
 
-    cacheable = hooks_cacheable(
-        local_train_fn, post_train, post_aggregate, aggregate_fn
-    )
+    if robust is not None:
+        if not hooks_cacheable(post_train, post_aggregate, aggregate_fn):
+            raise ValueError(
+                "pass either robust= (describable defense config) or "
+                "explicit hook closures, not both"
+            )
+        from fedml_tpu.algorithms.fedavg_robust import make_defense_hooks
+
+        post_train, post_aggregate, aggregate_fn = make_defense_hooks(robust)
+        # the hooks are pure functions of the (digested) RobustConfig —
+        # only a caller-supplied local train keeps the program opaque
+        cacheable = hooks_cacheable(local_train_fn)
+    else:
+        cacheable = hooks_cacheable(
+            local_train_fn, post_train, post_aggregate, aggregate_fn
+        )
 
     def build(skip: bool):
         def builder():
@@ -276,6 +300,13 @@ def make_fedavg_round(
                 "skip": skip,
                 "donate": donate,
                 "client_metrics": client_metrics,
+                # the whole RobustConfig dataclass (or None) enters the
+                # digest — every leaf (defense_type, norm_bound, stddev,
+                # num_byzantine/trim_k, multi_krum_m) shapes the traced
+                # defense, and the digest audit's drop-field fuzz pins
+                # that removing this key fails on exactly those leaves
+                # (the scaffold eta_g hazard class)
+                "robust": robust,
             },
             builder,
         )
@@ -323,9 +354,18 @@ def make_fedavg_multiround(
     FedAVGAggregator.py:80-88 is preserved because sampling stays host-side)
     and the device runs the whole chunk:
 
-        fn(global_vars, flat_x, flat_y, idx [T,C,cap], mask [T,C,cap],
-           num_samples [T,C], round_ids [T], base_rng)
+        fn(global_vars, flat_x, flat_y, idx_next [T,C,cap],
+           mask_next [T,C,cap], num_samples [T,C], round_ids [T], base_rng)
             -> (global_vars', stacked per-round metrics)
+
+    ``idx_next``/``mask_next`` arrive PRE-ROTATED by one round (host-side
+    ``roll(-1)`` in ``_fused_plan``): iteration t's xs row is round t+1's
+    gather — the double-buffer prefetch — and the last row wraps to round
+    0's indices, which the prologue reads back (``idx_next[-1]``) for the
+    first batch. Rotating on the host removes the two whole-chunk
+    ``jnp.roll`` copies the traced program used to execute per dispatch
+    (re-profile finding, ISSUE 14): the bytes shipped are identical, the
+    device-side copies are gone.
 
     Per-round math is identical to :func:`make_fedavg_round` at the same
     (steps, bs): the round body, the fold_in/split PRNG stream, and the
@@ -342,10 +382,10 @@ def make_fedavg_multiround(
     )
     lifted = client_axis_map(local_train, mode)
 
-    def multi_fn(global_vars, flat_x, flat_y, idx, mask, num_samples, round_ids, base_rng):
+    def multi_fn(global_vars, flat_x, flat_y, idx_next, mask_next, num_samples, round_ids, base_rng):
         feat = flat_x.shape[1:]
         lab = flat_y.shape[1:]
-        C = idx.shape[1]
+        C = idx_next.shape[1]
 
         def gathered(idx_r, mask_r):
             # shared gather-and-zero-padding contract with the eager path
@@ -375,11 +415,11 @@ def make_fedavg_multiround(
                 jnp.sum, metrics
             )
 
-        first = gathered(idx[0], mask[0])
-        # iteration t consumes batch t (carry) and prefetches batch t+1;
-        # the last iteration's prefetch wraps to batch 0 (discarded)
-        idx_next = jnp.roll(idx, -1, axis=0)
-        mask_next = jnp.roll(mask, -1, axis=0)
+        # the host pre-rotated the index arrays (see docstring): row t is
+        # round t+1's gather, row T-1 wraps to round 0's — the prologue
+        # batch reads it back here, and the scan's xs rows are already
+        # the prefetch stream (no device-side roll copies)
+        first = gathered(idx_next[-1], mask_next[-1])
         (gv, _), mets = jax.lax.scan(
             body,
             (global_vars, first),
@@ -511,6 +551,27 @@ class FedAvgAPI:
         # deterministic in (round, config.seed) and self.rng is never
         # reassigned after __init__.
         self._warm_fused: dict = {}
+        # Measured fused-vs-eager planner (FedConfig.fused_plan =
+        # "measured", algorithms/round_planner.py): probes both schedules
+        # over the first rounds — costs read from flight-recorder folds,
+        # device-synced during the probe — and commits to the winner per
+        # (algorithm, shape-class, cohort). None = legacy static plan.
+        self.planner = None
+        if (
+            config.fed.fused_plan == "measured"
+            and self._supports_fused
+            and config.fed.fused_rounds > 1
+        ):
+            from fedml_tpu.algorithms.round_planner import SchedulePlanner
+
+            self.planner = SchedulePlanner(log_fn=self.log_fn).attach(
+                self._tracer, config=config
+            )
+        elif config.fed.fused_plan not in ("static", "measured"):
+            raise ValueError(
+                "fused_plan must be 'static' or 'measured'; got "
+                f"{config.fed.fused_plan!r}"
+            )
         self._store = None
         if self._use_device_store and config.data.device_cache:
             from fedml_tpu.data.device_store import DeviceDataStore, fits_on_device
@@ -565,9 +626,10 @@ class FedAvgAPI:
         chunk program when the planner would fuse), EVERY other
         (steps, bs) shape class the partition can produce (derived via
         ``bucket_steps`` over all client sizes — EAGER rounds 1..R never
-        hit a lazy shape-bucket compile; fused chunk programs beyond
-        ``start_round``'s, and classes past the 32-class warmup cap,
-        still compile lazily — compile/warmup.py), the eval program, and the
+        hit a lazy shape-bucket compile), the horizon's fused chunk
+        programs (every distinct program × [T, C, cap] signature the
+        structural chunk walk reaches, capped — classes/chunks past the
+        warmup caps still compile lazily, compile/warmup.py), the eval program, and the
         server-optimizer step when present. When a persistent executable
         cache is installed, warmed programs load from / export to disk,
         so a fresh process warms with zero backend compiles. Emits
@@ -877,7 +939,7 @@ class FedAvgAPI:
         sampled, steps, bs = self._round_plan(round_idx)
         return steps, bs
 
-    def _fused_chunk_len(self, round_idx: int) -> int:
+    def _fused_chunk_len(self, round_idx: int, structural: bool = False) -> int:
         """Rounds [round_idx, round_idx+L) that can run as one fused chunk:
         bounded by fused_rounds, the horizon, the next eval round (eval
         fires after rounds where r % frequency == 0), and — under vmap —
@@ -888,7 +950,13 @@ class FedAvgAPI:
         schedule a chunk may span classes: padding steps are cond-skipped
         (train_rounds_fused compiles the cond in whenever the chunk has
         any), so spanned rounds pay only the ~3% cond tax, not padded
-        compute."""
+        compute.
+
+        ``structural=True`` returns the structural answer WITHOUT
+        consulting the measured planner — the warmup chunk walk
+        enumerates every fusable program regardless of which schedule
+        the probe later commits (planning a probe segment for a round
+        warmup merely inspects would corrupt the probe)."""
         cfg = self.config
         if (
             cfg.fed.fused_rounds <= 1
@@ -926,6 +994,7 @@ class FedAvgAPI:
         # instead.
         pad_free = self._client_mode == "scan"
         klass = self._round_steps_class(round_idx)
+        struct = None
         for off in range(L):
             r = round_idx + off
             if (
@@ -938,11 +1007,33 @@ class FedAvgAPI:
             if r % cfg.fed.frequency_of_the_test == 0:
                 # an eval round must be the LAST round of its chunk (eval
                 # reads global_vars right after that round)
-                return off + 1
-        # round down to a power of two: chunk length is part of the jit
-        # shape key, and run lengths are arbitrary — the cap bounds
-        # compiles to log2(fused_rounds) lengths per (steps, bs) class
-        return 1 << (L.bit_length() - 1)
+                struct = off + 1
+                break
+        if struct is None:
+            # round down to a power of two: chunk length is part of the
+            # jit shape key, and run lengths are arbitrary — the cap
+            # bounds compiles to log2(fused_rounds) lengths per
+            # (steps, bs) class
+            struct = 1 << (L.bit_length() - 1)
+        if struct <= 1 or self.planner is None or structural:
+            return struct
+        # measured planning: the structural length says fusion is
+        # POSSIBLE here; whether it runs fused is the planner's measured
+        # decision (probe → commit; idempotent per round, so warmup and
+        # the train loop see one answer)
+        from fedml_tpu.algorithms.round_planner import PlanKey
+
+        steps, bs = self._round_steps_class(round_idx)
+        return self.planner.plan(
+            PlanKey(
+                algo=type(self).__name__,
+                steps=int(steps),
+                bs=int(bs),
+                cohort=len(self._round_plan(round_idx)[0]),
+            ),
+            round_idx,
+            struct,
+        )
 
     def train_rounds_fused(self, start_round: int, n_rounds: int):
         """Run rounds [start_round, start_round+n_rounds) as one on-device
@@ -1018,11 +1109,17 @@ class FedAvgAPI:
                 may_pad=chunk_may_pad,
             )
             self._fused_fns[key] = fn
+        # rotate by one round on the HOST (row t = round t+1's indices,
+        # last row wraps to round 0's): the scan consumes the rotated
+        # stack directly as its prefetch stream and the prologue reads
+        # round 0's gather back from the last row — this replaced two
+        # whole-chunk device-side jnp.roll copies per dispatch (ISSUE 14
+        # re-profile). Same bytes over the wire, zero device copies.
         return fn, (
             store.flat_x,
             store.flat_y,
-            jnp.asarray(np.stack(idxs)),
-            jnp.asarray(np.stack(masks)),
+            jnp.asarray(np.stack(idxs[1:] + idxs[:1])),
+            jnp.asarray(np.stack(masks[1:] + masks[:1])),
             jnp.asarray(np.asarray(ns, np.float32)),
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32),
             self.rng,
@@ -1112,11 +1209,21 @@ class FedAvgAPI:
         while round_idx < cfg.fed.comm_round:
             L = self._fused_chunk_len(round_idx)
             t0 = time.perf_counter()
+            # measured-probe segments sync on the device INSIDE the round
+            # span: async dispatch makes an unsynced span measure host
+            # dispatch only, and the planner's fused-vs-eager commitment
+            # must compare true schedule costs (round_planner.py). Zero
+            # rounds pay this after the probe commits.
+            probe = self.planner is not None and self.planner.wants_sync(
+                round_idx
+            )
             if L > 1:
                 with self._tracer.span(
                     "round", round=round_idx, fused_rounds=L
                 ):
                     metrics = self.train_rounds_fused(round_idx, L)
+                    if probe:
+                        jax.block_until_ready(self.global_vars)
                 dt = (time.perf_counter() - t0) / L
                 pending.append((round_idx, self._pack_metrics(metrics), dt))
                 first_round, last_round = round_idx, round_idx + L - 1
@@ -1124,6 +1231,8 @@ class FedAvgAPI:
             else:
                 with self._tracer.span("round", round=round_idx):
                     _, metrics = self.train_round(round_idx)
+                    if probe:
+                        jax.block_until_ready(self.global_vars)
                 dt = time.perf_counter() - t0
                 pending.append(
                     (round_idx, self._pack_metrics(metrics), dt)

@@ -56,21 +56,22 @@ def make_robust_fedavg_round(
     local_train_fn=None,
     donate: bool = True,
 ):
-    """The FedAvg round skeleton with the defense inserted via its
-    post_train/post_aggregate hooks (the skeleton itself lives once, in
-    make_fedavg_round)."""
+    """The FedAvg round skeleton with the defense inserted via the
+    DESCRIBABLE ``robust=`` path (the skeleton itself lives once, in
+    make_fedavg_round): the round — including the Byzantine aggregators
+    — dedupes through the ProgramCache with the RobustConfig in its
+    digest, AOT-warms, and persists through the executable store like
+    every other first-class program (it used to bypass via
+    ``wrap_uncached`` because the hook closures were opaque)."""
     from fedml_tpu.algorithms.fedavg import make_fedavg_round
 
-    post_train, post_aggregate, aggregate_fn = make_defense_hooks(robust)
     return make_fedavg_round(
         model,
         config,
         task=task,
         local_train_fn=local_train_fn,
         donate=donate,
-        post_train=post_train,
-        post_aggregate=post_aggregate,
-        aggregate_fn=aggregate_fn,
+        robust=robust,
     )
 
 

@@ -857,9 +857,9 @@ class FedAvgClientManager(ClientManager):
         # rank-stable assignment, which the CLI enforces (full
         # participation).
         if ef is None:
-            from fedml_tpu.core.compression import TopKErrorFeedback
+            from fedml_tpu.core.compression import ErrorFeedback
 
-            ef = TopKErrorFeedback.maybe_from_config(config.comm)
+            ef = ErrorFeedback.maybe_from_config(config.comm)
         self._ef = ef
         # secure-agg per-round state: the ClientParty holding THIS client's
         # secret key (never serialized, never sent)
@@ -953,12 +953,22 @@ class FedAvgClientManager(ClientManager):
             adv.add_params(MT.ARG_PUBKEY, self._secagg_party.pk)
             self.send_message(adv)
             return
+        from fedml_tpu.core import compression as CZ
+        from fedml_tpu.telemetry import get_comm_meter
+
         out = Message(MT.C2S_SEND_MODEL, self.rank, 0)
+        # fp32-equivalent cost of this update — the denominator of the
+        # uplink byte-cut ratio (comm/uplink_* in summary.json); metered
+        # for uncompressed uploads too so a baseline run carries the
+        # same keys a quantized run is compared against. Counted
+        # arithmetically (4 B × element count) — never by materializing
+        # a cast copy of the tree on the hot upload path.
+        raw_bytes = 4 * sum(
+            int(np.size(a)) for a in jax.tree_util.tree_leaves(weights)
+        )
         if comp != "none":
             # uplink compression (core/compression.py): send the encoded
             # round delta; the server reconstructs against the same w_round
-            from fedml_tpu.core import compression as CZ
-
             if self._ef is not None:
                 payload = self._ef.encode(
                     self.trainer.client_index, weights, w_round
@@ -967,9 +977,16 @@ class FedAvgClientManager(ClientManager):
                 payload = CZ.encode_update(
                     weights, w_round, comp, self.config.comm.topk_frac
                 )
+            get_comm_meter().on_uplink(CZ.payload_bytes(payload), raw_bytes)
             out.add_params(MT.ARG_MODEL_DELTA, payload)
             out.add_params(MT.ARG_COMPRESSION, comp)
         else:
+            # as-shipped payload = the leaves' actual buffer bytes (equal
+            # to raw_bytes for fp32 weights, smaller for e.g. bf16)
+            shipped = sum(
+                int(a.nbytes) for a in jax.tree_util.tree_leaves(weights)
+            )
+            get_comm_meter().on_uplink(shipped, raw_bytes)
             out.add_params(MT.ARG_MODEL_PARAMS, weights)
         out.add_params(MT.ARG_NUM_SAMPLES, n)
         # round tag: lets the server discard a straggler's upload for an

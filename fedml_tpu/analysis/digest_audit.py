@@ -70,7 +70,11 @@ NCLS = 3
 class Perturbation:
     """One single-field config change. ``field`` is a dotted RunConfig
     path ('train.lr', 'fed.epochs'); a leading '@' targets a factory
-    kwarg instead ('@lam', '@q')."""
+    kwarg instead ('@lam', '@q'), and '@kwarg.field' replaces one FIELD
+    of a dataclass-valued kwarg ('@robust.num_byzantine' →
+    dataclasses.replace on the RobustConfig) — the fan-out form that
+    proves per-leaf digest coverage for config objects passed to
+    factories outside the RunConfig tree."""
 
     field: str
     value: Any
@@ -232,6 +236,7 @@ _CHOICE_VALUES: Dict[str, Any] = {
     "train.compute_dtype": "bfloat16",
     "train.augment": "crop_flip",
     "fed.client_parallelism": "scan",
+    "fed.fused_plan": "measured",
     "fed.selection": "weighted",
     "fed.state_store": "mmap",
     "server.server_optimizer": "adam",
@@ -265,7 +270,12 @@ KNOWN_BENIGN = frozenset({
     "fed.frequency_of_the_test", "fed.ci", "fed.group_num",
     "fed.group_comm_round", "fed.selection", "fed.overprovision_factor",
     "fed.fault_plan", "fed.deadline_s", "fed.min_clients",
-    "fed.fused_rounds", "fed.eval_on_clients", "fed.async_buffer_k",
+    # fused_plan steers WHICH schedule (fused chunk vs eager rounds) the
+    # host dispatches — both programs exist either way and their digests
+    # are unchanged; the planner (algorithms/round_planner.py) is pure
+    # host-side measurement
+    "fed.fused_rounds", "fed.fused_plan",
+    "fed.eval_on_clients", "fed.async_buffer_k",
     "fed.async_staleness_exp", "fed.async_server_lr", "fed.state_store",
     "fed.state_budget_bytes", "fed.state_dir",
     "comm.compression", "comm.topk_frac", "comm.error_feedback",
@@ -368,6 +378,12 @@ _SERVER_PERTURBS = [p for p in _AUTO_FANOUT if p.field.startswith("server.")]
 # that they merge identically (the audit tolerates benign digest merges
 # instead of demanding splits)
 _BENIGN_PERTURBS = list(_AUTO_BENIGN)
+
+
+def _robust_config(**kw):
+    from fedml_tpu.robustness import RobustConfig
+
+    return RobustConfig(**kw)
 
 
 def default_specs() -> List[FactorySpec]:
@@ -518,6 +534,23 @@ def default_specs() -> List[FactorySpec]:
             _sds((2,), np.uint32),
         )
 
+    def robust_build(cfg, ctx, kw):
+        from fedml_tpu.algorithms.fedavg_robust import make_robust_fedavg_round
+
+        return make_robust_fedavg_round(
+            _model(ctx), cfg, kw["robust"]
+        ).variant_for(None)
+
+    def robust_args(cfg, ctx, kw):
+        import numpy as np
+
+        # the defense hooks take one extra arg: the weak-DP noise rng
+        return (
+            (_gv_shapes(_model(ctx)),)
+            + _cohort(cfg, C)
+            + (_sds((2,), np.uint32),)
+        )
+
     def sharded_fedavg_build(cfg, ctx, kw):
         from fedml_tpu.parallel.fedavg_sharded import make_sharded_fedavg_round
 
@@ -588,6 +621,39 @@ def default_specs() -> List[FactorySpec]:
         FactorySpec(
             "fedopt_server_step", server_step_build, server_step_args,
             _AUTO_FANOUT,
+        ),
+        # The Byzantine-robust round (ISSUE 14): cached with the whole
+        # RobustConfig in its digest instead of the historical
+        # wrap_uncached bypass. Two bases so every RobustConfig leaf
+        # reaches a trace somewhere: the order-statistics base exercises
+        # defense_type/num_byzantine (trim_k)/multi_krum_m, the weak_dp
+        # base exercises norm_bound (clip) and stddev (noise). Dropping
+        # the 'robust' digest field must fail on exactly these leaves —
+        # the scaffold eta_g pin's analog, tests/test_robust_compile.py.
+        FactorySpec(
+            "robust_fedavg_round", robust_build, robust_args,
+            _AUTO_FANOUT + [
+                Perturbation("@robust.defense_type", "median"),
+                Perturbation("@robust.defense_type", "multi_krum"),
+                Perturbation("@robust.num_byzantine", 0),
+                Perturbation("@robust.multi_krum_m", 2),
+                Perturbation("@robust.norm_bound", 1.5),
+                Perturbation("@robust.stddev", 0.5),
+            ],
+            kwargs={
+                "robust": _robust_config(
+                    defense_type="trimmed_mean", num_byzantine=1
+                )
+            },
+        ),
+        FactorySpec(
+            "robust_clip_round", robust_build, robust_args,
+            [
+                Perturbation("@robust.defense_type", "norm_diff_clipping"),
+                Perturbation("@robust.norm_bound", 1.5),
+                Perturbation("@robust.stddev", 0.5),
+            ],
+            kwargs={"robust": _robust_config(defense_type="weak_dp")},
         ),
         FactorySpec("eval", eval_build, eval_args, _AUTO_FANOUT),
         FactorySpec(
@@ -662,7 +728,16 @@ def audit_factory(
     for pert in spec.perturbations:
         kw = dict(spec.kwargs)
         if pert.field.startswith("@"):
-            kw[pert.field[1:]] = pert.value
+            name = pert.field[1:]
+            if "." in name:
+                # '@kwarg.field': one-field dataclasses.replace on a
+                # dataclass-valued kwarg (e.g. '@robust.num_byzantine')
+                obj_name, attr = name.split(".", 1)
+                kw[obj_name] = dataclasses.replace(
+                    kw[obj_name], **{attr: pert.value}
+                )
+            else:
+                kw[name] = pert.value
             cfg2 = cfg
         else:
             cfg2 = config_replace(cfg, pert.field, pert.value)

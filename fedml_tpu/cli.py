@@ -153,6 +153,12 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
 @click.option("--fused_rounds", type=int, default=1,
               help="Run up to N rounds as one on-device lax.scan chunk "
                    "(fedavg/fedprox + vmap runtime; needs the device cache)")
+@click.option("--fused_plan", type=click.Choice(("static", "measured")),
+              default="static",
+              help="fused_rounds > 1: 'static' always fuses where possible "
+                   "(legacy); 'measured' probes BOTH schedules over the "
+                   "first rounds (flight-recorder phase costs) and commits "
+                   "to the measured winner (algorithms/round_planner.py)")
 @click.option("--client_parallelism", type=click.Choice(("auto", "vmap", "scan")),
               default="auto",
               help="How one chip runs the sampled clients: vmap (batched) "
@@ -256,15 +262,19 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
                    "of waiting forever (0 = ref-parity wait-for-all)")
 @click.option("--min_clients", type=int, default=1,
               help="Minimum uploads required to close a deadline round")
-@click.option("--compression", type=click.Choice(("none", "int8", "topk")), default="none",
+@click.option("--compression", type=click.Choice(("none", "int8", "int4", "topk", "topk8")),
+              default="none",
               help="Transport runtimes: compress the client uplink update "
-                   "(core/compression.py) — int8 quantization or top-k "
-                   "sparsification of the round delta")
+                   "(core/compression.py) — int8/int4 (nibble-packed) "
+                   "quantization, top-k sparsification, or topk8 (top-k "
+                   "with int8 values) of the round delta")
 @click.option("--topk_frac", type=float, default=0.01,
-              help="compression=topk: fraction of entries kept per tensor")
+              help="compression=topk/topk8: fraction of entries kept per tensor")
 @click.option("--error_feedback", is_flag=True, default=False,
-              help="compression=topk: per-client residual memory (EF-SGD) "
-                   "so dropped coordinates ship in later rounds")
+              help="Lossy codecs (topk/topk8/int4/int8): per-client residual "
+                   "memory (EF-SGD) so dropped coordinates and quantization "
+                   "error ship in later rounds; practically mandatory for "
+                   "the 4-bit grid")
 @click.option("--secure_agg", is_flag=True, default=False,
               help="Transport runtimes: pairwise-masked uploads — the "
                    "server only ever sums masked field vectors (ref "
@@ -564,6 +574,7 @@ def build_config(opt) -> RunConfig:
             group_num=opt["group_num"],
             group_comm_round=opt["group_comm_round"],
             fused_rounds=opt.get("fused_rounds", 1),
+            fused_plan=opt.get("fused_plan", "static"),
             eval_on_clients=opt.get("eval_on_clients", False),
             deadline_s=opt.get("deadline_s", 0.0),
             min_clients=opt.get("min_clients", 1),
@@ -867,10 +878,12 @@ def run(**opt):
                     "masked field vectors cannot be sparsified/quantized"
                 )
         if config.comm.error_feedback:
-            if config.comm.compression != "topk":
+            from fedml_tpu.core.compression import EF_METHODS
+
+            if config.comm.compression not in EF_METHODS:
                 raise click.UsageError(
-                    "--error_feedback is a top-k residual memory; it requires "
-                    "--compression topk"
+                    "--error_feedback is a residual memory for lossy codecs; "
+                    f"it requires --compression in {EF_METHODS}"
                 )
             if config.fed.deadline_s:
                 raise click.UsageError(
@@ -1051,6 +1064,11 @@ def run(**opt):
                 # vmap/mesh fault accounting into summary.json (the transport
                 # runners log their shared injector themselves)
                 log_fn(api.faults.summary_row())
+            if getattr(api, "planner", None) is not None:
+                # measured fused-vs-eager planner: committed schedule +
+                # both arms' probed per-round costs (flight/planner_*) —
+                # the ci.sh fused-vs-eager gate reads the winner here
+                log_fn(api.planner.summary_row())
             if poison_spec is not None:
                 from fedml_tpu.data.edge_cases import attack_success_rate
 

@@ -84,6 +84,16 @@ class FedConfig:
     # the chunk. 1 = eager per-round dispatch. Chunks never span an eval
     # round, so observed metrics are identical to the eager loop.
     fused_rounds: int = 1
+    # How the round planner decides fused-vs-eager when fused_rounds > 1
+    # (algorithms/round_planner.py). "static": legacy — always fuse where
+    # structurally possible. "measured": probe BOTH schedules over the
+    # first rounds (costs read from the flight recorder's folded phase
+    # records, device-synced during the probe) and commit to the measured
+    # winner per (algorithm, shape-class, cohort) — no config heuristic
+    # decides the schedule, a measurement does. Numerics are identical
+    # either way (fused == eager is a test contract); only wall clock
+    # differs.
+    fused_plan: str = "static"
     # Eval rounds evaluate on every client's local train/test shards
     # (ref _local_test_on_all_clients, fedavg_api.py:117-180) instead of the
     # central test set.
@@ -171,13 +181,16 @@ class CommConfig:
     Downlink (broadcast) stays exact, so the compression error enters only
     through the weighted average — the standard FL-compression setup."""
 
-    # "none" | "int8" (per-tensor linear quantization) | "topk" (magnitude
-    # sparsification at topk_frac density).
+    # "none" | "int8" (per-tensor linear quantization) | "int4" (packed
+    # low-bit: 4-bit levels, two per byte — ~8x; pair with
+    # error_feedback) | "topk" (magnitude sparsification at topk_frac
+    # density) | "topk8" (top-k with int8-quantized values).
     compression: str = "none"
     topk_frac: float = 0.01
-    # topk only: per-client residual memory (error feedback) — dropped
-    # coordinates accumulate and ship in later rounds instead of being
-    # lost. Off by default (stateless-client parity with the reference).
+    # Lossy codecs (topk/topk8/int4/int8): per-client residual memory
+    # (error feedback) — dropped coordinates AND quantization error
+    # accumulate and ship in later rounds instead of being lost. Off by
+    # default (stateless-client parity with the reference).
     error_feedback: bool = False
     # Transport send retry (core/retry.py, applied once in the
     # BaseCommManager send template): a failed send is retried up to this

@@ -17,14 +17,24 @@ binary Message envelope unchanged (core/message.py to_wire_parts).
 - ``int8``: per-tensor symmetric linear quantization — payload int8 +
   one fp32 scale per leaf; ≈4× uplink reduction on fp32 models with
   max error scale/2 = max|delta|/254.
+- ``int4``: the packed low-bit composition — symmetric quantization to
+  4-bit levels [-7, 7], two values per byte (high/low nibble), one fp32
+  scale per leaf; ≈8× uplink reduction. The coarser grid makes error
+  feedback practically mandatory (the quantization residual accumulates
+  instead of being lost); the CLI recommends it, tests pin convergence.
 - ``topk``: keep the top ``frac`` fraction of entries by magnitude per
   leaf — payload (int32 indices, fp32 values); ≈1/(2·frac)× reduction.
+- ``topk8``: top-k composed WITH int8 value quantization — payload
+  (int32 indices, int8 values, fp32 scale); the value half of the
+  payload shrinks 4× on top of the sparsification.
 
 Encoding is one-shot by default (each round's delta re-encoded fresh, no
 client state — parity with the reference's stateless trainer contract).
-Opt-in cross-round error feedback for top-k lives in
-:class:`TopKErrorFeedback` (CommConfig.error_feedback): dropped
-coordinates accumulate in a per-client residual and ship later.
+Opt-in cross-round error feedback lives in :class:`ErrorFeedback`
+(CommConfig.error_feedback): whatever the codec drops this round —
+sparsified coordinates AND quantization error — accumulates in a
+per-client residual and ships later. ``TopKErrorFeedback`` remains as
+the historical alias for the top-k instantiation.
 """
 
 from __future__ import annotations
@@ -74,6 +84,93 @@ def encode_int8(tree) -> Dict[str, np.ndarray]:
     return payload
 
 
+def encode_int4(tree) -> Dict[str, np.ndarray]:
+    """Per-leaf symmetric quantization to 4-bit [-7, 7], nibble-packed —
+    two quantized values per uint8 byte (even index → low nibble). Odd
+    sizes pad the last byte's high nibble with 0; the decoder reads the
+    true element count from the template, so the pad never leaks."""
+    leaves, _ = _leaves(tree)
+    payload: Dict[str, np.ndarray] = {"n": np.int32(len(leaves))}
+    for i, a in enumerate(leaves):
+        flat = a.astype(np.float32).reshape(-1)
+        scale = float(np.max(np.abs(flat))) / 7.0 if flat.size else 0.0
+        if scale == 0.0:
+            q = np.zeros(flat.size, np.int8)
+        else:
+            q = np.clip(np.round(flat / scale), -7, 7).astype(np.int8)
+        if q.size % 2:
+            q = np.concatenate([q, np.zeros(1, np.int8)])
+        # biased to [0, 14] so both nibbles pack into one unsigned byte
+        u = (q + 7).astype(np.uint8)
+        payload[f"q{i}"] = (u[0::2] | (u[1::2] << 4)).astype(np.uint8)
+        payload[f"s{i}"] = np.float32(scale)
+    return payload
+
+
+def decode_int4(payload: Dict[str, np.ndarray], template) -> object:
+    leaves, treedef = _leaves(template)
+    _check_leaf_count(payload, leaves)
+    out = []
+    for i, a in enumerate(leaves):
+        packed = np.asarray(payload[f"q{i}"])
+        s = float(payload[f"s{i}"])
+        u = np.empty(packed.size * 2, np.uint8)
+        u[0::2] = packed & 0x0F
+        u[1::2] = packed >> 4
+        q = u[: a.size].astype(np.float32) - 7.0
+        out.append((q * s).reshape(a.shape))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _topk_select(flat: np.ndarray, frac: float):
+    """Shared index selection for the top-k family: the ceil(frac·n)
+    largest-magnitude positions of a flat fp32 leaf, sorted, with the
+    keep-everything fallback for tiny leaves. ONE definition so the
+    plain and int8-valued encoders can never diverge on tie-breaking or
+    k rounding (decoder compatibility rests on identical index sets)."""
+    k = max(1, int(np.ceil(frac * flat.size))) if flat.size else 0
+    if k and k < flat.size:
+        return np.sort(np.argpartition(np.abs(flat), -k)[-k:].astype(np.int32))
+    return np.arange(flat.size, dtype=np.int32)
+
+
+def encode_topk_int8(tree, frac: float) -> Dict[str, np.ndarray]:
+    """Top-k sparsification with int8-quantized values: the kept entries'
+    magnitudes are already the leaf's largest, so one per-leaf scale over
+    the KEPT values loses little — the value half of the payload shrinks
+    4× on top of the sparsification."""
+    leaves, _ = _leaves(tree)
+    payload: Dict[str, np.ndarray] = {"n": np.int32(len(leaves))}
+    for i, a in enumerate(leaves):
+        flat = a.astype(np.float32).reshape(-1)
+        idx = _topk_select(flat, frac)
+        vals = flat[idx]
+        scale = float(np.max(np.abs(vals))) / 127.0 if vals.size else 0.0
+        q = (
+            np.zeros(vals.shape, np.int8)
+            if scale == 0.0
+            else np.clip(np.round(vals / scale), -127, 127).astype(np.int8)
+        )
+        payload[f"i{i}"] = idx
+        payload[f"v{i}"] = q
+        payload[f"s{i}"] = np.float32(scale)
+    return payload
+
+
+def decode_topk_int8(payload: Dict[str, np.ndarray], template) -> object:
+    leaves, treedef = _leaves(template)
+    _check_leaf_count(payload, leaves)
+    out = []
+    for i, a in enumerate(leaves):
+        flat = np.zeros(a.size, np.float32)
+        s = float(payload[f"s{i}"])
+        flat[np.asarray(payload[f"i{i}"])] = (
+            np.asarray(payload[f"v{i}"]).astype(np.float32) * s
+        )
+        out.append(flat.reshape(a.shape))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def _check_leaf_count(payload, leaves):
     n = int(payload["n"])
     if n != len(leaves):
@@ -100,11 +197,7 @@ def encode_topk(tree, frac: float) -> Dict[str, np.ndarray]:
     payload: Dict[str, np.ndarray] = {"n": np.int32(len(leaves))}
     for i, a in enumerate(leaves):
         flat = a.astype(np.float32).reshape(-1)
-        k = max(1, int(np.ceil(frac * flat.size))) if flat.size else 0
-        if k and k < flat.size:
-            idx = np.sort(np.argpartition(np.abs(flat), -k)[-k:].astype(np.int32))
-        else:
-            idx = np.arange(flat.size, dtype=np.int32)
+        idx = _topk_select(flat, frac)
         payload[f"i{i}"] = idx
         payload[f"v{i}"] = flat[idx]
     return payload
@@ -121,25 +214,40 @@ def decode_topk(payload: Dict[str, np.ndarray], template) -> object:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+# codec registry: method name -> (encode(delta, frac), decode(payload,
+# template)). ONE definition shared by encode_update/decode_update and
+# the error-feedback store, so a new codec cannot be wired into one side
+# and silently dropped from the other.
+CODECS: Dict[str, tuple] = {
+    "int8": (lambda d, frac: encode_int8(d), decode_int8),
+    "int4": (lambda d, frac: encode_int4(d), decode_int4),
+    "topk": (encode_topk, decode_topk),
+    "topk8": (encode_topk_int8, decode_topk_int8),
+}
+
+
+def encode_delta(delta, method: str, topk_frac: float = 0.01):
+    """Compress an already-computed delta tree with ``method``."""
+    if method not in CODECS:
+        raise ValueError(f"unknown compression {method!r}")
+    return CODECS[method][0](delta, topk_frac)
+
+
+def decode_delta(payload, template, method: str):
+    """Reconstruct a delta tree from its compressed payload."""
+    if method not in CODECS:
+        raise ValueError(f"unknown compression {method!r}")
+    return CODECS[method][1](payload, template)
+
+
 def encode_update(w_local, w_round, method: str, topk_frac: float = 0.01):
     """Client side: compress this round's update. Returns the payload tree."""
-    d = delta_tree(w_local, w_round)
-    if method == "int8":
-        return encode_int8(d)
-    if method == "topk":
-        return encode_topk(d, topk_frac)
-    raise ValueError(f"unknown compression {method!r}")
+    return encode_delta(delta_tree(w_local, w_round), method, topk_frac)
 
 
 def decode_update(payload, w_round, method: str):
     """Server side: reconstruct the client's model from the payload."""
-    if method == "int8":
-        d = decode_int8(payload, w_round)
-    elif method == "topk":
-        d = decode_topk(payload, w_round)
-    else:
-        raise ValueError(f"unknown compression {method!r}")
-    return add_tree(w_round, d)
+    return add_tree(w_round, decode_delta(payload, w_round, method))
 
 
 def payload_bytes(tree) -> int:
@@ -148,12 +256,22 @@ def payload_bytes(tree) -> int:
     return int(sum(a.nbytes for a in leaves))
 
 
-class TopKErrorFeedback:
-    """Per-client residual memory for top-k uploads (error-feedback /
-    EF-SGD, Stich et al. 2018): what sparsification drops this round is
-    remembered and added to the next round's delta, so every coordinate's
-    contribution eventually reaches the server instead of being lost —
-    the standard fix for high-sparsity top-k stalling.
+# Lossy codecs whose per-round error is worth remembering. int8's grid
+# is fine enough that one-shot encoding converges on its own, but the
+# residual loop is still valid math for it — the table is the ONE list
+# the CLI guard and the activation rule both consult.
+EF_METHODS = ("topk", "topk8", "int4", "int8")
+
+
+class ErrorFeedback:
+    """Per-client residual memory for lossy uplink codecs (error-feedback
+    / EF-SGD, Stich et al. 2018): whatever the codec drops this round —
+    sparsified coordinates (top-k) or quantization error (int4/int8) —
+    is remembered and added to the next round's delta, so every
+    coordinate's contribution eventually reaches the server instead of
+    being lost. For high-sparsity top-k this fixes stalling; for the
+    4-bit grid it recovers fp32-equivalent convergence (tests pin
+    reach@target parity).
 
     Memory is keyed by CLIENT id (the data owner), not transport rank: the
     server re-points ranks at different sampled clients each round
@@ -161,17 +279,24 @@ class TopKErrorFeedback:
     client. Opt-in via CommConfig.error_feedback — the default one-shot
     encoding keeps the reference's stateless-client contract."""
 
-    def __init__(self, frac: float):
+    def __init__(self, frac: float, method: str = "topk"):
+        if method not in EF_METHODS:
+            raise ValueError(
+                f"error feedback supports {EF_METHODS}; got {method!r}"
+            )
         self.frac = frac
+        self.method = method
         self._residual: Dict[int, object] = {}
 
     @classmethod
-    def maybe_from_config(cls, comm) -> "TopKErrorFeedback | None":
+    def maybe_from_config(cls, comm) -> "ErrorFeedback | None":
         """The ONE activation rule (CommConfig → instance or None), shared
         by the in-process shared-store path and the per-process (grpc)
-        path so they can never diverge in when EF engages."""
-        if comm.error_feedback and comm.compression == "topk":
-            return cls(comm.topk_frac)
+        path so they can never diverge in when EF engages. Constructs the
+        base class explicitly so the rule behaves identically through the
+        ``TopKErrorFeedback`` legacy alias (whose __init__ pins topk)."""
+        if comm.error_feedback and comm.compression in EF_METHODS:
+            return ErrorFeedback(comm.topk_frac, method=comm.compression)
         return None
 
     def encode(self, client_id: int, w_local, w_round) -> Dict[str, np.ndarray]:
@@ -179,9 +304,17 @@ class TopKErrorFeedback:
         r = self._residual.get(int(client_id))
         if r is not None:
             d = jax.tree_util.tree_map(lambda a, b: a + b, d, r)
-        payload = encode_topk(d, self.frac)
-        sent = decode_topk(payload, d)
+        payload = encode_delta(d, self.method, self.frac)
+        sent = decode_delta(payload, d, self.method)
         self._residual[int(client_id)] = jax.tree_util.tree_map(
             lambda a, b: a - b, d, sent
         )
         return payload
+
+
+class TopKErrorFeedback(ErrorFeedback):
+    """Historical alias — the top-k instantiation of :class:`ErrorFeedback`
+    (kept so every existing import keeps the exact legacy semantics)."""
+
+    def __init__(self, frac: float):
+        super().__init__(frac, method="topk")

@@ -54,6 +54,7 @@ def make_sharded_fedavg_round(
     post_aggregate: Optional[Callable] = None,
     aggregate_fn: Optional[Callable] = None,
     n_extra: int = 0,
+    robust=None,
 ):
     """Build the jitted sharded round function.
 
@@ -74,6 +75,19 @@ def make_sharded_fedavg_round(
     ICI and hands the aggregate_fn the same stacked view the vmap runtime
     gives it — equality by construction."""
     axis = mesh.axis_names[0]
+    if robust is not None:
+        # describable defense config instead of opaque hook closures —
+        # same contract as make_fedavg_round(robust=): the hooks derive
+        # from the digested RobustConfig, so the robust SHARDED round is
+        # a first-class cached program too
+        if any(h is not None for h in (post_train, post_aggregate, aggregate_fn)):
+            raise ValueError(
+                "pass either robust= (describable defense config) or "
+                "explicit hook closures, not both"
+            )
+        from fedml_tpu.algorithms.fedavg_robust import make_defense_hooks
+
+        post_train, post_aggregate, aggregate_fn = make_defense_hooks(robust)
     # The client schedule matters on the mesh too: each shard runs its
     # C/n_shards clients, and under vmap their per-client weights turn the
     # convs into grouped convs (the single-chip 1.8x ResNet finding,
@@ -147,9 +161,14 @@ def make_sharded_fedavg_round(
     )
 
     cache = get_program_cache()
-    if not hooks_cacheable(
-        local_train_fn, post_train, post_aggregate, aggregate_fn
-    ):
+    cacheable = (
+        hooks_cacheable(local_train_fn)
+        if robust is not None
+        else hooks_cacheable(
+            local_train_fn, post_train, post_aggregate, aggregate_fn
+        )
+    )
+    if not cacheable:
         return cache.wrap_uncached("sharded_fedavg_round", builder())
     return cache.get_or_build(
         "sharded_fedavg_round",
@@ -163,6 +182,8 @@ def make_sharded_fedavg_round(
             "mesh": mesh_fingerprint(mesh),
             "n_extra": n_extra,
             "donate": donate,
+            # RobustConfig (or None) — see make_fedavg_round's digest note
+            "robust": robust,
         },
         builder,
     )
@@ -281,9 +302,6 @@ class RobustDistributedFedAvgAPI(DistributedFedAvgAPI):
             )
 
     def _build_round_fn(self, local_train_fn):
-        from fedml_tpu.algorithms.fedavg_robust import make_defense_hooks
-
-        post_train, post_aggregate, aggregate_fn = make_defense_hooks(self.robust)
         return make_sharded_fedavg_round(
             self.model,
             self.config,
@@ -291,9 +309,7 @@ class RobustDistributedFedAvgAPI(DistributedFedAvgAPI):
             task=self.task,
             local_train_fn=local_train_fn,
             donate=self._donate,
-            post_train=post_train,
-            post_aggregate=post_aggregate,
-            aggregate_fn=aggregate_fn,
+            robust=self.robust,
             n_extra=1,  # the replicated noise rng
         )
 

@@ -85,24 +85,52 @@ def norm_diff_clip_tree(local_tree, global_tree, norm_bound: float):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def coordinate_median(stacked_tree, num_samples=None):
+def _kernel_auto() -> bool:
+    """Whether the rank-selection Pallas kernel (ops/robust_stats.py)
+    replaces XLA's sort lowering for the per-coordinate order statistics:
+    TPU only — everywhere else the historical jnp path runs, byte-
+    identical to every prior release. Tests drive the kernel explicitly
+    through its own module (interpret mode)."""
+    return jax.default_backend() == "tpu"
+
+
+def coordinate_median(stacked_tree, num_samples=None, use_kernel=None):
     """Coordinate-wise median over the leading client axis. Sample weights
     are ignored by construction (median is order-based). BN stats (non
     clippable leaves) keep the weighted mean — averaging running statistics
     is the meaningful reduction for them."""
-    return _byzantine_reduce(
-        stacked_tree, num_samples, lambda v: jnp.median(v, axis=0)
-    )
+    if use_kernel is None:
+        use_kernel = _kernel_auto()
+
+    def reduce(v):
+        if use_kernel and v.ndim >= 1 and v.shape[0] > 1:
+            from fedml_tpu.ops.robust_stats import median_1d
+
+            C = v.shape[0]
+            return median_1d(
+                v.reshape(C, -1), use_kernel=True
+            ).reshape(v.shape[1:])
+        return jnp.median(v, axis=0)
+
+    return _byzantine_reduce(stacked_tree, num_samples, reduce)
 
 
-def trimmed_mean(stacked_tree, num_samples=None, trim_k: int = 1):
+def trimmed_mean(stacked_tree, num_samples=None, trim_k: int = 1, use_kernel=None):
     """Per-coordinate: sort the C client values, drop the ``trim_k``
     largest and smallest, average the rest (Yin et al. 2018)."""
+    if use_kernel is None:
+        use_kernel = _kernel_auto()
 
     def reduce(v):
         C = v.shape[0]
         if trim_k < 0 or 2 * trim_k >= C:
             raise ValueError(f"need 0 <= trim_k < C/2; got trim_k={trim_k}, C={C}")
+        if use_kernel:
+            from fedml_tpu.ops.robust_stats import trimmed_mean_1d
+
+            return trimmed_mean_1d(
+                v.reshape(C, -1), trim_k, use_kernel=True
+            ).reshape(v.shape[1:])
         s = jnp.sort(v, axis=0)
         return jnp.mean(s[trim_k : C - trim_k], axis=0)
 

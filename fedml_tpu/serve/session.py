@@ -289,9 +289,9 @@ class FedSession:
         )
         # one shared error-feedback store: residuals are keyed by client id
         # and the sampler re-assigns clients to ranks each round
-        from fedml_tpu.core.compression import TopKErrorFeedback
+        from fedml_tpu.core.compression import ErrorFeedback
 
-        shared_ef = TopKErrorFeedback.maybe_from_config(config.comm)
+        shared_ef = ErrorFeedback.maybe_from_config(config.comm)
         if shared_ef is not None and config.fed.deadline_s:
             raise ValueError(
                 "error_feedback cannot be combined with deadline_s quorum "

@@ -113,4 +113,17 @@ def telemetry_summary(baseline: dict = None) -> dict:
         if baseline:
             total -= sum(baseline.get(key, {}).values())
         row[out] = total
+    # uplink payload accounting (core/compression.py): as-shipped vs
+    # fp32-equivalent bytes of the client model updates — the quantized-
+    # uplink byte cut is read off these keys in summary.json (the ci.sh
+    # gate divides raw by payload), never asserted from codec math
+    for key, out in (
+        ("uplink_payload_bytes", "comm/uplink_bytes"),
+        ("uplink_raw_bytes", "comm/uplink_raw_bytes"),
+        ("uplink_updates", "comm/uplink_updates"),
+    ):
+        total = int(snap.get(key, 0))
+        if baseline:
+            total -= int(baseline.get(key, 0))
+        row[out] = total
     return row

@@ -44,6 +44,14 @@ class CommMeter:
         self._bytes_received: Dict[str, int] = {}
         self._send_retries: Dict[str, int] = {}
         self._send_gave_up: Dict[str, int] = {}
+        # uplink payload accounting (core/compression.py): the bytes the
+        # model-update payload actually occupies on the wire vs what the
+        # same update would cost uncompressed (fp32 leaves) — the codec
+        # byte cut is READ off these counters, never asserted from codec
+        # math (docs/OBSERVABILITY.md)
+        self._uplink_payload_bytes = 0
+        self._uplink_raw_bytes = 0
+        self._uplink_updates = 0
         r = self.registry
         self._c_sent = r.counter(
             "fedml_comm_messages_sent_total",
@@ -85,6 +93,14 @@ class CommMeter:
             "Sends abandoned after exhausting the retry attempt/deadline caps",
             ("msg_type",),
         )
+        self._c_uplink_payload = r.counter(
+            "fedml_comm_uplink_payload_bytes_total",
+            "Model-update payload bytes as shipped (post-codec)",
+        )
+        self._c_uplink_raw = r.counter(
+            "fedml_comm_uplink_raw_bytes_total",
+            "fp32-equivalent bytes of the same model updates (pre-codec)",
+        )
 
     # -- hot path (called from BaseCommManager) --
     def on_sent(self, msg_type: str, nbytes: Optional[int], seconds: float) -> None:
@@ -125,6 +141,19 @@ class CommMeter:
             )
         self._c_gave_up.inc(1, msg_type=msg_type)
 
+    def on_uplink(self, payload_bytes: int, raw_bytes: int) -> None:
+        """One client model-update upload: its as-shipped payload bytes
+        and the fp32-equivalent bytes the same update would have cost
+        uncompressed (equal when no codec is configured). Called at
+        encode time on the client path, so the ratio is exact per upload
+        regardless of transport framing."""
+        with self._lock:
+            self._uplink_payload_bytes += int(payload_bytes)
+            self._uplink_raw_bytes += int(raw_bytes)
+            self._uplink_updates += 1
+        self._c_uplink_payload.inc(int(payload_bytes))
+        self._c_uplink_raw.inc(int(raw_bytes))
+
     # -- queries --
     def snapshot(self) -> dict:
         """Plain-dict totals: {metric: {msg_type: value}} — what the
@@ -137,6 +166,9 @@ class CommMeter:
                 "bytes_received": dict(self._bytes_received),
                 "send_retries": dict(self._send_retries),
                 "send_gave_up": dict(self._send_gave_up),
+                "uplink_payload_bytes": self._uplink_payload_bytes,
+                "uplink_raw_bytes": self._uplink_raw_bytes,
+                "uplink_updates": self._uplink_updates,
             }
 
     def reset(self) -> None:
@@ -149,6 +181,9 @@ class CommMeter:
             self._bytes_received.clear()
             self._send_retries.clear()
             self._send_gave_up.clear()
+            self._uplink_payload_bytes = 0
+            self._uplink_raw_bytes = 0
+            self._uplink_updates = 0
 
 
 _GLOBAL: Optional[CommMeter] = None

@@ -227,3 +227,179 @@ def test_compressed_loopback_federation(method):
         # sparsified updates drift more; the model must still beat chance
         acc = server.history[-1]["Test/Acc"]
         assert acc > 0.5, f"topk-compressed run degenerated: acc={acc}"
+
+
+def test_int4_roundtrip_error_bound_and_packing():
+    """Packed 4-bit: q = round(x/s) with s = max|x|/7, two values per
+    byte — max error s/2, exact zeros stay exact, odd sizes pack the pad
+    nibble without leaking it."""
+    t = _tree()
+    payload = CZ.encode_int4(t)
+    back = CZ.decode_int4(payload, t)
+    for k in t:
+        scale = float(np.max(np.abs(t[k]))) / 7.0
+        assert np.max(np.abs(back[k] - t[k])) <= scale / 2 + 1e-9, k
+        assert back[k].shape == t[k].shape
+    # odd leaf size: the pad nibble packs but never leaks
+    odd = {"v": np.random.default_rng(3).normal(0, 0.1, size=(7,)).astype(
+        np.float32
+    )}
+    p_odd = CZ.encode_int4(odd)
+    assert p_odd["q0"].nbytes == (odd["v"].size + 1) // 2
+    assert CZ.decode_int4(p_odd, odd)["v"].shape == (7,)
+    z = {"w": np.zeros((4, 4), np.float32)}
+    assert np.all(CZ.decode_int4(CZ.encode_int4(z), z)["w"] == 0)
+
+
+def test_int4_payload_is_8x_smaller():
+    t = _tree()
+    raw = CZ.payload_bytes(t)
+    comp = CZ.payload_bytes(CZ.encode_int4(t))
+    assert comp < raw / 7.0  # nibble-packed + fp32 scales
+
+
+def test_topk8_composes_topk_indices_with_int8_values():
+    """topk8 keeps EXACTLY topk's index set; values are int8-quantized
+    over the kept entries (error <= scale/2)."""
+    t = {"w": np.arange(-50, 50, dtype=np.float32).reshape(10, 10)}
+    p = CZ.encode_topk(t, frac=0.1)
+    p8 = CZ.encode_topk_int8(t, frac=0.1)
+    np.testing.assert_array_equal(p["i0"], p8["i0"])
+    back = CZ.decode_topk_int8(p8, t)["w"].reshape(-1)
+    ref = CZ.decode_topk(p, t)["w"].reshape(-1)
+    kept = np.nonzero(ref)[0]
+    scale = float(np.max(np.abs(ref[kept]))) / 127.0
+    assert np.max(np.abs(back[kept] - ref[kept])) <= scale / 2 + 1e-9
+    # the value half of the payload shrank 4x (int8 vs fp32)
+    assert p8["v0"].nbytes * 4 == p["v0"].nbytes
+
+
+def test_error_feedback_generalizes_to_quantizers():
+    """ErrorFeedback with method=int4: the residual is exactly the
+    quantization error, and it ships next round (dropped mass arrives)."""
+    t = _tree(0)
+    ref = jax.tree_util.tree_map(np.zeros_like, t)
+    ef = CZ.ErrorFeedback(0.1, method="int4")
+    p = ef.encode(0, t, ref)
+    sent = CZ.decode_delta(p, t, "int4")
+    for k in t:
+        np.testing.assert_allclose(
+            ef._residual[0][k], t[k] - sent[k], atol=1e-6
+        )
+    # the activation rule follows CommConfig.compression
+    class _Comm:
+        error_feedback = True
+        compression = "int4"
+        topk_frac = 0.01
+
+    assert CZ.ErrorFeedback.maybe_from_config(_Comm).method == "int4"
+    _Comm.compression = "none"
+    assert CZ.ErrorFeedback.maybe_from_config(_Comm) is None
+    with pytest.raises(ValueError, match="error feedback"):
+        CZ.ErrorFeedback(0.1, method="nope")
+
+
+def test_int4_reach_target_matches_fp32_uplink():
+    """The ISSUE-14 acceptance form: the packed 4-bit uplink WITH error
+    feedback reaches the fp32 run's loss target in the same number of
+    rounds (the byte cut is free at this operating point — deterministic
+    seeds, a reproducible comparison)."""
+    from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+    from fedml_tpu.config import (
+        CommConfig,
+        DataConfig,
+        FedConfig,
+        RunConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_classification(
+        num_clients=4, num_classes=3, feat_shape=(8,), samples_per_client=24,
+        partition_method="homo", seed=9,
+    )
+    model_def = lambda: ModelDef(
+        module=LogisticRegression(num_classes=3), input_shape=(8,),
+        num_classes=3, name="lr",
+    )
+    R, target = 20, 0.32
+
+    def reach(comm):
+        cfg = RunConfig(
+            data=DataConfig(batch_size=-1),
+            fed=FedConfig(
+                client_num_in_total=4, client_num_per_round=4, comm_round=R,
+                epochs=1, frequency_of_the_test=1,
+            ),
+            train=TrainConfig(client_optimizer="sgd", lr=0.5),
+            comm=comm,
+            seed=0,
+        )
+        server = run_loopback_federation(cfg, data, model_def())
+        for row in server.history:
+            if row.get("Test/Loss") is not None and row["Test/Loss"] <= target:
+                return row["round"]
+        return None
+
+    r_fp32 = reach(CommConfig())
+    r_int4 = reach(CommConfig(compression="int4", error_feedback=True))
+    assert r_fp32 is not None, "fp32 arm never reached target"
+    assert r_int4 == r_fp32, (r_int4, r_fp32)
+
+
+def test_sim_transport_cohort_and_numerics_parity_under_int4():
+    """Partial participation under the 4-bit codec: the transport server
+    must select byte-identical cohorts to the vmap simulator (codec
+    cannot perturb scheduling), and the model must track the simulator
+    within the quantizer's error envelope."""
+    from fedml_tpu.algorithms import FedAvgAPI
+    from fedml_tpu.algorithms.fedavg_transport import run_loopback_federation
+    from fedml_tpu.config import (
+        CommConfig,
+        DataConfig,
+        FedConfig,
+        RunConfig,
+        TrainConfig,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models import ModelDef
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_classification(
+        num_clients=6, num_classes=3, feat_shape=(5,), samples_per_client=24,
+        partition_method="homo", seed=9,
+    )
+    model_def = lambda: ModelDef(
+        module=LogisticRegression(num_classes=3), input_shape=(5,),
+        num_classes=3, name="lr",
+    )
+    R = 6
+    cfg = RunConfig(
+        data=DataConfig(batch_size=-1),
+        fed=FedConfig(
+            client_num_in_total=6, client_num_per_round=3, comm_round=R,
+            epochs=1, frequency_of_the_test=R,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.5),
+        comm=CommConfig(compression="int4", error_feedback=True),
+        seed=0,
+    )
+    sim = FedAvgAPI(cfg.replace(comm=CommConfig()), data, model_def())
+    sim.train()
+    server = run_loopback_federation(cfg, data, model_def())
+    assert server.round_idx == R
+    # cohort parity: the scheduler draw is identical per round
+    for r in range(R):
+        np.testing.assert_array_equal(
+            sim._round_plan(r)[0], server.scheduler.select(r, k=3)
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sim.global_vars),
+        jax.tree_util.tree_leaves(server.global_vars),
+    ):
+        # 4-bit grid: per-round error scale/2 = max|delta|/14 — an order
+        # coarser than int8's, but error feedback keeps the trajectory
+        # tracking (measured drift ~7e-3 at round 6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-2)

@@ -133,3 +133,34 @@ def test_fused_vmap_mode_cuts_chunks_at_class_changes():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
         )
+
+
+@pytest.mark.recompile_budget(60)
+def test_warmup_pre_enumerates_chunk_programs_beyond_round0(recompile_sentinel):
+    """ISSUE-14 satellite (PR-8 leftover): warmup walks the horizon's
+    chunk schedule and AOT-compiles every distinct fused program — not
+    just round 0's — so later chunks (lengths cut by eval boundaries)
+    dispatch warmed executables. Numerics stay byte-identical to the
+    unwarmed run."""
+    data, model = _data(False), _model()
+    # freq=7 cuts chunks at rounds 7/14: lengths beyond round 0's appear
+    cfg = _cfg(4, comm_round=20, freq=7)
+    warm = FedAvgAPI(cfg, data, _model())
+    rows = warm.warmup()
+    chunk_rows = [
+        k for k in rows
+        if k.startswith("compile/round_fused_r") and k.endswith("_compile_s")
+    ]
+    assert len(chunk_rows) >= 2, rows  # beyond round 0's single chunk
+    assert rows.get("compile/warm_chunk_programs", 0) >= 2, rows
+    warm.train()
+
+    cold = FedAvgAPI(cfg, data, _model())
+    cold.train()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(warm.global_vars),
+        jax.tree_util.tree_leaves(cold.global_vars),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for rw, rc in zip(warm.history, cold.history):
+        assert rw["Train/Loss"] == rc["Train/Loss"]

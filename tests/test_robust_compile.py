@@ -1,0 +1,205 @@
+"""Byzantine-robust aggregators as first-class cached programs (ISSUE 14):
+the robust round dedupes through the ProgramCache with the RobustConfig in
+its digest (no more wrap_uncached bypass), AOT-warms, and is byte-identical
+to the opaque-hook reference and across warm/cold. The digest audit's
+drop-field fuzz must fail on exactly the RobustConfig leaves (the scaffold
+eta_g pin's analog)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import make_fedavg_round
+from fedml_tpu.algorithms.fedavg_robust import (
+    RobustFedAvgAPI,
+    make_defense_hooks,
+    make_robust_fedavg_round,
+)
+from fedml_tpu.compile import ProgramCache, use_program_cache
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import ModelDef
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.robustness import RobustConfig
+
+
+def _cfg(comm_round=3):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=8, client_num_per_round=6,
+            comm_round=comm_round, epochs=1, frequency_of_the_test=100,
+            client_parallelism="vmap",
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=5,
+    )
+
+
+def _data():
+    return synthetic_classification(
+        num_clients=8, num_classes=3, feat_shape=(6,),
+        samples_per_client=24, partition_method="homo", seed=7,
+    )
+
+
+def _model():
+    return ModelDef(
+        module=LogisticRegression(num_classes=3), input_shape=(6,),
+        num_classes=3, name="lr",
+    )
+
+
+DEFENSES = [
+    RobustConfig(defense_type="median"),
+    RobustConfig(defense_type="trimmed_mean", num_byzantine=1),
+    RobustConfig(defense_type="krum", num_byzantine=1),
+    RobustConfig(defense_type="multi_krum", num_byzantine=1, multi_krum_m=2),
+    RobustConfig(defense_type="weak_dp"),
+]
+
+
+@pytest.mark.parametrize(
+    "robust", DEFENSES, ids=[d.defense_type for d in DEFENSES]
+)
+def test_robust_round_is_cached_not_bypassed(robust):
+    """The describable robust= path lands in the ProgramCache with a
+    digest (the historical hook-closure path had to wrap_uncached);
+    a second identical factory call is a dedup HIT on the same object."""
+    with use_program_cache(ProgramCache()) as cache:
+        p1 = make_robust_fedavg_round(
+            _model(), _cfg(), robust
+        ).variant_for(None)
+        assert p1.digest is not None, "robust round was bypassed"
+        assert p1.key_fields["robust"] is robust
+        p2 = make_robust_fedavg_round(
+            _model(), _cfg(), robust
+        ).variant_for(None)
+        assert p2 is p1
+        assert cache.stats()["bypassed"] == 0
+
+
+def test_robust_digest_splits_on_every_config_leaf():
+    """Each RobustConfig leaf that can shape the traced defense gets its
+    own digest — trim_k/num_byzantine included (the eta_g hazard class)."""
+    base = RobustConfig(defense_type="trimmed_mean", num_byzantine=1)
+    variants = [
+        dataclasses.replace(base, num_byzantine=2),
+        dataclasses.replace(base, defense_type="median"),
+        dataclasses.replace(base, defense_type="multi_krum"),
+        dataclasses.replace(
+            base, defense_type="multi_krum", multi_krum_m=2
+        ),
+        dataclasses.replace(base, defense_type="weak_dp", stddev=0.5),
+        dataclasses.replace(base, defense_type="weak_dp", norm_bound=1.0),
+    ]
+    with use_program_cache(ProgramCache()):
+        digests = [
+            make_robust_fedavg_round(_model(), _cfg(), r)
+            .variant_for(None).digest
+            for r in [base] + variants
+        ]
+    assert len(set(digests)) == len(digests), digests
+
+
+def test_explicit_hooks_and_robust_kwarg_are_exclusive():
+    hooks = make_defense_hooks(RobustConfig(defense_type="median"))
+    with pytest.raises(ValueError, match="not both"):
+        make_fedavg_round(
+            _model(), _cfg(), aggregate_fn=hooks[2],
+            robust=RobustConfig(defense_type="median"),
+        )
+
+
+@pytest.mark.parametrize(
+    "robust", DEFENSES, ids=[d.defense_type for d in DEFENSES]
+)
+def test_cached_robust_round_matches_opaque_hook_reference(robust):
+    """Byte-identical numerics to the eager reference: the cached
+    (robust=) program and the historical opaque-hook (wrap_uncached)
+    program are the same math — one dispatch each, exact equality."""
+    model, cfg = _model(), _cfg()
+    gv = model.init(jax.random.PRNGKey(0))
+    C = 6
+    rng = np.random.default_rng(0)
+    stacked = jax.tree_util.tree_map(
+        lambda p: jax.numpy.asarray(
+            np.repeat(np.asarray(p, np.float32)[None], C, axis=0)
+            + rng.normal(0, 0.05, (C,) + np.asarray(p).shape).astype(
+                np.float32
+            )
+        ),
+        gv,
+    )
+    x = jax.numpy.asarray(rng.normal(size=(C, 2, 8, 6)).astype(np.float32))
+    y = jax.numpy.asarray(rng.integers(0, 3, size=(C, 2, 8)).astype(np.int32))
+    mask = jax.numpy.ones((C, 2, 8), np.float32)
+    ns = jax.numpy.asarray(np.full((C,), 24, np.float32))
+    keys = jax.random.split(jax.random.PRNGKey(1), C)
+    noise_rng = jax.random.PRNGKey(2)
+
+    with use_program_cache(ProgramCache()):
+        cached = make_fedavg_round(model, cfg, donate=False, robust=robust)
+        out_cached, met_cached = cached(
+            gv, x, y, mask, ns, keys, noise_rng
+        )
+    with use_program_cache(ProgramCache()) as cache:
+        post_train, post_aggregate, aggregate_fn = make_defense_hooks(robust)
+        opaque = make_fedavg_round(
+            model, cfg, donate=False, post_train=post_train,
+            post_aggregate=post_aggregate, aggregate_fn=aggregate_fn,
+        )
+        out_ref, met_ref = opaque(gv, x, y, mask, ns, keys, noise_rng)
+        # the historical path really did bypass the cache (the wrap is
+        # counted when the variant builds at first dispatch)
+        assert cache.stats()["bypassed"] >= 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out_cached),
+        jax.tree_util.tree_leaves(out_ref),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(met_cached["loss_sum"]) == float(met_ref["loss_sum"])
+
+
+@pytest.mark.parametrize("defense", ["median", "trimmed_mean"])
+@pytest.mark.recompile_budget(40)
+def test_robust_warm_vs_cold_byte_parity(defense, recompile_sentinel):
+    """AOT warmup of the robust round (now reachable — it used to bypass
+    the compile layer entirely) changes nothing numerically: warmed and
+    cold runs are byte-identical."""
+    robust = RobustConfig(defense_type=defense, num_byzantine=1)
+    data, model = _data(), _model()
+    cold = RobustFedAvgAPI(_cfg(), data, model, robust=robust)
+    cold.train()
+    warm = RobustFedAvgAPI(_cfg(), data, model, robust=robust)
+    rows = warm.warmup()
+    assert any(k.startswith("compile/round") for k in rows), rows
+    warm.train()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(cold.global_vars),
+        jax.tree_util.tree_leaves(warm.global_vars),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for rc, rw in zip(cold.history, warm.history):
+        assert rc["Train/Loss"] == rw["Train/Loss"]
+
+
+def test_digest_audit_drop_robust_fails_on_its_leaves():
+    """The fuzzer really detects the hazard class this PR closes: with
+    'robust' dropped from the digest, the audit must fail on exactly the
+    RobustConfig perturbations (num_byzantine — the trim_k window — and
+    defense_type), like the scaffold eta_g pin."""
+    from fedml_tpu.analysis.digest_audit import audit_factory, default_specs
+
+    spec = [
+        s for s in default_specs() if s.name == "robust_fedavg_round"
+    ][0]
+    audit = audit_factory(spec, drop_digest_fields=frozenset({"robust"}))
+    bad = {v.field for v in audit.violations}
+    assert "@robust.num_byzantine" in bad, bad
+    assert "@robust.defense_type" in bad, bad
+    # with the field kept, the same spec audits clean
+    clean = audit_factory(spec)
+    assert not clean.violations, clean.render()

@@ -1,0 +1,97 @@
+"""Rank-selection Pallas kernel for the robust aggregators
+(ops/robust_stats.py): the unrolled stable-rank compare-accumulate must
+select exactly the multiset a stable sort's trim window keeps — pinned
+against the jnp sort reference across cohort sizes, trim windows, ties,
+and the median's odd/even middle semantics. Kernel runs in interpret mode
+here (CPU); on TPU the same code compiles via Mosaic."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from fedml_tpu.ops.robust_stats import (  # noqa: E402
+    median_1d,
+    median_trim_k,
+    trimmed_mean_1d,
+)
+
+
+def _ref_trimmed(x, k):
+    s = np.sort(x, axis=0)
+    return np.mean(s[k : x.shape[0] - k], axis=0)
+
+
+@pytest.mark.parametrize("C", [3, 4, 5, 8, 10, 16])
+def test_kernel_matches_sort_reference(C):
+    x = np.random.default_rng(C).normal(size=(C, 700)).astype(np.float32)
+    for k in range((C - 1) // 2 + 1):
+        if 2 * k >= C:
+            continue
+        got = np.asarray(
+            trimmed_mean_1d(jnp.asarray(x), k, use_kernel=True, interpret=True)
+        )
+        np.testing.assert_allclose(
+            got, _ref_trimmed(x, k), atol=1e-6, rtol=1e-6
+        )
+
+
+@pytest.mark.parametrize("C", [3, 4, 7, 8])
+def test_median_matches_numpy_even_and_odd(C):
+    x = np.random.default_rng(C + 50).normal(size=(C, 300)).astype(np.float32)
+    got = np.asarray(median_1d(jnp.asarray(x), use_kernel=True, interpret=True))
+    np.testing.assert_allclose(got, np.median(x, axis=0), atol=1e-6, rtol=1e-6)
+
+
+def test_ties_select_the_stable_sort_multiset_exactly():
+    """Integer-valued floats: the kept multiset sums exactly, so the
+    kernel must be bit-equal to the sort reference even under heavy
+    ties (the stable index tie-break is load-bearing here)."""
+    x = (
+        np.random.default_rng(0)
+        .integers(-3, 4, size=(6, 500))
+        .astype(np.float32)
+    )
+    got = np.asarray(
+        trimmed_mean_1d(jnp.asarray(x), 1, use_kernel=True, interpret=True)
+    )
+    np.testing.assert_array_equal(got, _ref_trimmed(x, 1).astype(np.float32))
+
+
+def test_block_padding_boundary():
+    """D not a multiple of the 512 block (and tiny D): the zero-padded
+    lanes must never leak into real outputs."""
+    for D in (1, 5, 127, 513, 700):
+        x = np.random.default_rng(D).normal(size=(5, D)).astype(np.float32)
+        got = np.asarray(
+            trimmed_mean_1d(jnp.asarray(x), 1, use_kernel=True, interpret=True)
+        )
+        assert got.shape == (D,)
+        np.testing.assert_allclose(
+            got, _ref_trimmed(x, 1), atol=1e-6, rtol=1e-6
+        )
+
+
+def test_fallback_path_is_sort_based():
+    """use_kernel=False takes the historical XLA lowering — literally the
+    sort-and-mean formula (byte-identity off-TPU is the production
+    contract; robustness/robust_aggregation.py gates on backend)."""
+    x = np.random.default_rng(1).normal(size=(6, 64)).astype(np.float32)
+    got = np.asarray(trimmed_mean_1d(jnp.asarray(x), 1, use_kernel=False))
+    ref = np.asarray(jnp.mean(jnp.sort(jnp.asarray(x), axis=0)[1:5], axis=0))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_median_trim_k_semantics():
+    assert median_trim_k(3) == 1  # keep 1 (odd)
+    assert median_trim_k(5) == 2
+    assert median_trim_k(4) == 1  # keep 2 (even): mean of middle two
+    assert median_trim_k(6) == 2
+
+
+def test_bad_trim_window_rejected():
+    x = jnp.zeros((4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="trim_k"):
+        trimmed_mean_1d(x, 2, use_kernel=True, interpret=True)
+    with pytest.raises(ValueError, match="trim_k"):
+        trimmed_mean_1d(x, -1, use_kernel=False)

@@ -1,0 +1,251 @@
+"""Measured fused-vs-eager planner (algorithms/round_planner.py, ISSUE 14):
+probe both schedules off flight-recorder folds, commit the measured winner
+per (algorithm, shape-class, cohort). Contracts pinned here: decisions are
+a DETERMINISTIC function of the observed record stream (same flight
+history ⇒ same choice), schedule choice never touches numerics (measured
+run == static run bit-for-bit at matching seeds), and the planner detaches
+from the span stream once every key has committed."""
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.round_planner import (
+    PROBE_SAMPLES,
+    PlanKey,
+    SchedulePlanner,
+)
+from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import ModelDef
+from fedml_tpu.models.linear import LogisticRegression
+
+KEY = PlanKey(algo="FedAvgAPI", steps=3, bs=8, cohort=4)
+
+
+def _drive(history):
+    """Replay a (round -> per-round cost) probe history into a fresh
+    planner exactly the way a run would: plan, then fold. Returns the
+    planner. ``history`` rows: (round_idx, fusible_len, t_s, fused_rounds
+    or None)."""
+    p = SchedulePlanner()
+    for r, fusible, t_s, fused in history:
+        p.plan(KEY, r, fusible)
+        rec = {"round": r, "t_s": t_s}
+        if fused:
+            rec["fused_rounds"] = fused
+        p.observe(rec)
+    return p
+
+
+def _history(fused_chunk_s, eager_round_s, L=4):
+    """The canonical probe transcript: PROBE_SAMPLES fused chunks then
+    PROBE_SAMPLES eager rounds."""
+    rows = []
+    r = 0
+    for c in fused_chunk_s[:PROBE_SAMPLES]:
+        rows.append((r, L, c, L))
+        r += L
+    for e in eager_round_s[:PROBE_SAMPLES]:
+        rows.append((r, L, e, None))
+        r += 1
+    return rows
+
+
+def test_same_history_same_choice():
+    """Determinism: the decision is a pure function of the record
+    stream — replaying identical histories always commits identically."""
+    hist = _history([4.0, 3.6], [1.5, 1.2])  # fused 0.9/round vs eager 1.2
+    decisions = {_drive(hist).decision(KEY) for _ in range(5)}
+    assert decisions == {"fused"}
+    # reversed costs flip the decision, deterministically too
+    hist2 = _history([8.0, 7.2], [1.5, 1.2])  # fused 1.8/round vs eager 1.2
+    assert {_drive(hist2).decision(KEY) for _ in range(5)} == {"eager"}
+
+
+def test_min_statistic_ignores_compile_tainted_first_sample():
+    """A slow first sample (lazy compile, cold cache) must not decide:
+    min-of-K keeps the clean sample."""
+    hist = _history([40.0, 3.6], [1.5, 1.2])  # first chunk compile-tainted
+    assert _drive(hist).decision(KEY) == "fused"
+
+
+def test_tie_breaks_toward_fused():
+    hist = _history([4.8, 4.8], [1.2, 1.2])  # both 1.2 s/round exactly
+    assert _drive(hist).decision(KEY) == "fused"
+
+
+def test_probe_schedule_and_idempotence():
+    p = SchedulePlanner()
+    # fused arm fills first (PROBE_SAMPLES chunks), then eager
+    assert p.plan(KEY, 0, 4) == 4
+    assert p.plan(KEY, 0, 4) == 4  # idempotent per round (warmup re-asks)
+    assert p.wants_sync(0)
+    p.observe({"round": 0, "t_s": 4.0, "fused_rounds": 4})
+    assert not p.wants_sync(0)
+    assert p.plan(KEY, 4, 4) == 4
+    p.observe({"round": 4, "t_s": 4.0, "fused_rounds": 4})
+    assert p.plan(KEY, 8, 4) == 1  # eager arm
+    p.observe({"round": 8, "t_s": 0.9})
+    assert p.plan(KEY, 9, 4) == 1
+    p.observe({"round": 9, "t_s": 0.9})
+    # committed: eager (0.9 < 1.0) — and no more probe syncs anywhere
+    assert p.decision(KEY) == "eager"
+    assert p.plan(KEY, 10, 4) == 1
+    assert not p.wants_sync(10)
+    row = p.summary_row()
+    assert row["flight/planner_schedule"] == "eager"
+    assert row["flight/probe_fused_per_round_s"] == 1.0
+    assert row["flight/probe_eager_per_round_s"] == 0.9
+
+
+def test_walk_ahead_defaults_fused_without_probing():
+    """A caller planning ahead of execution (the warmup chunk walk asks
+    about many future rounds before any fold lands) gets the amortizing
+    default for rounds beyond the probe window — NOT extra probe
+    segments that would never fold."""
+    p = SchedulePlanner()
+    for r in (0, 4):
+        assert p.plan(KEY, r, 4) == 4  # fused probe arm
+    for r in (8, 9):
+        assert p.plan(KEY, r, 4) == 1  # eager probe arm
+    # beyond the window, undecided: fused default, no pending registered
+    assert p.plan(KEY, 10, 4) == 4
+    assert not p.wants_sync(10)
+
+
+def test_unrelated_records_ignored():
+    p = SchedulePlanner()
+    p.plan(KEY, 0, 4)
+    p.observe({"round": 99, "t_s": 123.0})  # not a probe segment
+    assert p.decision(KEY) is None
+    assert p.wants_sync(0)
+
+
+def _lr_setup(plan, fused_rounds=4, comm_round=16, seed=3):
+    data = synthetic_classification(
+        num_clients=16, num_classes=4, feat_shape=(6,),
+        samples_per_client=24, partition_method="homo", seed=11,
+    )
+    model = ModelDef(
+        module=LogisticRegression(num_classes=4), input_shape=(6,),
+        num_classes=4, name="lr",
+    )
+    cfg = RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=16, client_num_per_round=4,
+            comm_round=comm_round, epochs=1, frequency_of_the_test=10_000,
+            fused_rounds=fused_rounds, fused_plan=plan,
+        ),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1),
+        seed=seed,
+    )
+    return cfg, data, model
+
+
+@pytest.mark.recompile_budget(60)
+def test_measured_plan_numerics_match_static(recompile_sentinel):
+    """The schedule decision can change WALL time only: a measured-plan
+    run's history and final model are bit-identical to the static plan's
+    (fused == eager is already a test contract; the planner only picks
+    between them)."""
+    cfg_m, data, model = _lr_setup("measured")
+    api_m = FedAvgAPI(cfg_m, data, model)
+    assert api_m._store is not None, "device store required for this test"
+    api_m.train()
+    assert api_m.planner is not None
+    row = api_m.planner.summary_row()
+    assert row.get("flight/planner_schedule") in ("fused", "eager")
+    assert row.get("flight/probe_fused_per_round_s") is not None
+    assert row.get("flight/probe_eager_per_round_s") is not None
+
+    cfg_s, _, _ = _lr_setup("static")
+    api_s = FedAvgAPI(cfg_s, data, model)
+    api_s.train()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(api_m.global_vars),
+        jax.tree_util.tree_leaves(api_s.global_vars),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for rm, rs in zip(api_m.history, api_s.history):
+        assert rm["round"] == rs["round"]
+        assert rm["Train/Loss"] == rs["Train/Loss"]
+
+
+def test_planner_detaches_after_commit():
+    """Once every key committed, the planner stops listening (and a
+    privately-attached recorder leaves the tracer) — steady-state rounds
+    carry zero probe overhead and no listener leak across runs."""
+    from fedml_tpu.telemetry import get_tracer
+
+    baseline = len(get_tracer().listeners())
+    cfg, data, model = _lr_setup("measured", comm_round=24)
+    api = FedAvgAPI(cfg, data, model)
+    assert len(get_tracer().listeners()) > baseline  # probe listening
+    api.train()
+    assert api.planner.summary_row().get("flight/planner_schedule")
+    assert len(get_tracer().listeners()) == baseline
+
+
+def test_new_key_after_commit_reattaches_and_commits():
+    """A PlanKey first seen AFTER the probe closed (mid-run cohort or
+    steps-class change) re-subscribes the planner to the fold stream —
+    its probes are observed, it commits on its own measurements, and the
+    planner detaches again, with zero probe bookkeeping left behind."""
+    from fedml_tpu.telemetry import get_tracer
+
+    baseline = len(get_tracer().listeners())
+    p = SchedulePlanner().attach(get_tracer())
+    for r, fusible, t_s, fused in _history([4.0, 3.6], [1.5, 1.2]):
+        p.plan(KEY, r, fusible)
+        rec = {"round": r, "t_s": t_s}
+        if fused:
+            rec["fused_rounds"] = fused
+        p.observe(rec)
+    assert p.decision(KEY) == "fused"
+    assert len(get_tracer().listeners()) == baseline  # detached
+    # a NEW key appears: the planner must re-attach and probe it
+    key2 = PlanKey(algo="FedAvgAPI", steps=3, bs=8, cohort=2)
+    assert p.plan(key2, 100, 4) == 4
+    assert len(get_tracer().listeners()) > baseline  # listening again
+    hist2 = [(100, 4, 8.0, 4), (104, 4, 7.2, 4), (108, 4, 1.2, None),
+             (109, 4, 1.1, None)]
+    for r, fusible, t_s, fused in hist2:
+        p.plan(key2, r, fusible)
+        rec = {"round": r, "t_s": t_s}
+        if fused:
+            rec["fused_rounds"] = fused
+        p.observe(rec)
+    assert p.decision(key2) == "eager"  # measured on ITS OWN probes
+    assert p.decision(KEY) == "fused"  # first key untouched
+    assert len(get_tracer().listeners()) == baseline  # detached again
+    assert not p._planned and not p._pending  # steady state holds nothing
+
+
+def test_committed_plan_holds_no_per_round_state():
+    """Post-commit plan() answers are pure functions of the decision —
+    a 100k-round run must not grow one cache entry per round."""
+    hist = _history([4.0, 3.6], [1.5, 1.2])
+    p = _drive(hist)
+    assert p.decision(KEY) == "fused"
+    for r in range(200, 1200):
+        assert p.plan(KEY, r, 4) == 4
+    assert not p._planned
+
+
+def test_static_plan_has_no_planner():
+    cfg, data, model = _lr_setup("static")
+    assert FedAvgAPI(cfg, data, model).planner is None
+
+
+def test_invalid_plan_rejected():
+    cfg, data, model = _lr_setup("static")
+    import dataclasses
+
+    bad = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, fused_plan="vibes")
+    )
+    with pytest.raises(ValueError, match="fused_plan"):
+        FedAvgAPI(bad, data, model)

@@ -199,6 +199,8 @@ def _roundtrip_message():
 def _delta(before, after):
     out = {}
     for k in after:
+        if not isinstance(after[k], dict):  # scalar totals (uplink_*)
+            continue
         d = {
             t: after[k].get(t, 0) - before.get(k, {}).get(t, 0)
             for t in after[k]
