@@ -17,6 +17,14 @@ elif len(sys.argv) > 1 and sys.argv[1] == "status":
 
     del sys.argv[1]
     status_main()
+elif len(sys.argv) > 1 and sys.argv[1] == "trace":
+    # `python -m fedml_tpu trace merge <dirs>` — cross-process trace
+    # merge: align each rank's Chrome trace on send/recv wire timestamp
+    # pairs and emit one federation timeline (telemetry/wire.py)
+    from fedml_tpu.telemetry.wire import trace_main
+
+    del sys.argv[1]
+    trace_main()
 else:
     from fedml_tpu.cli import main
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from fedml_tpu.algorithms.fedavg import weighted_average
 from fedml_tpu.config import RunConfig
-from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
+from fedml_tpu.telemetry import ClientHealthRegistry, get_comm_meter, get_tracer
 from fedml_tpu.core.comm import BaseCommManager
 from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
 from fedml_tpu.core.managers import ClientManager, ServerManager
@@ -71,6 +71,16 @@ class FedAvgAggregator:
         self.sample_num_dict.clear()
         self._flags = [False] * self.worker_num
         return jax.device_get(avg)
+
+
+def _model_wire_cost(tree) -> tuple:
+    """(as-shipped, fp32-equivalent) bytes of one model broadcast — the
+    downlink mirror of the uplink's arithmetic accounting (no cast copy
+    materialized; 4 B x element count for the raw denominator)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    shipped = sum(int(np.asarray(a).nbytes) for a in leaves)
+    raw = 4 * sum(int(np.size(a)) for a in leaves)
+    return shipped, raw
 
 
 def local_train_key_fields(model: ModelDef, config: RunConfig, task: str):
@@ -327,6 +337,15 @@ class FedAvgServerManager(ServerManager):
             health=self.health,
             tracer=self._tracer,
         )
+        # Wire telemetry (telemetry/wire.py): client beacons piggybacked
+        # on uploads feed the CLI's flight recorder (when one listens on
+        # this tracer) and the process fleet aggregator. Dedupe is the
+        # worker's last consumed round — a flaky duplicate delivery
+        # restates the SAME beacon and must not double-count.
+        from fedml_tpu.telemetry.flight import attached_recorder
+
+        self._flight = attached_recorder(self._tracer)
+        self._beacon_seen: Dict[int, int] = {}
 
     def finish(self):
         # stop feeding the health registry from the global span stream —
@@ -395,13 +414,15 @@ class FedAvgServerManager(ServerManager):
         sampled = self.scheduler.select(r, k=self.worker_num)
         self._round_span = self._tracer.start_span("round", round=r)
         with self._tracer.span("broadcast", round=r):
+            shipped, raw = _model_wire_cost(self.global_vars)
             for worker, client_idx in enumerate(sampled, start=1):
                 msg = Message(MT.S2C_INIT_CONFIG, 0, worker)
                 msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
                 msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
                 msg.add_params(MT.ARG_ROUND_IDX, r)
                 self._assigned[worker] = (int(client_idx), time.monotonic())
-                self._broadcast(msg)
+                if self._broadcast(msg):
+                    get_comm_meter().on_downlink(shipped, raw)
         self._arm_deadline()
 
     def register_message_receive_handlers(self):
@@ -612,9 +633,17 @@ class FedAvgServerManager(ServerManager):
             # (no-op when the span stream already recorded the round)
             assigned = self._assigned.get(msg.get_sender_id())
             if assigned is not None:
-                self.health.observe_train(
-                    assigned[0], upload_round, time.monotonic() - assigned[1]
-                )
+                rtt_s = time.monotonic() - assigned[1]
+                # telemetry beacon first: its MEASURED train time is truer
+                # than the rtt fallback below, which the (client, round)
+                # dedupe then absorbs
+                beacon = msg.get(MT.ARG_TELEMETRY)
+                if beacon is not None:
+                    self._consume_beacon(
+                        msg.get_sender_id(), assigned[0], upload_round,
+                        beacon, rtt_s,
+                    )
+                self.health.observe_train(assigned[0], upload_round, rtt_s)
                 # power_of_choice bias signal: the client's local mean
                 # train loss rides the upload (ARG_TRAIN_LOSS)
                 loss = msg.get(MT.ARG_TRAIN_LOSS)
@@ -673,6 +702,39 @@ class FedAvgServerManager(ServerManager):
                 and self.aggregator.received_count() >= self._quorum()
             ):
                 self._complete_round()
+
+    def _consume_beacon(
+        self, worker: int, client_idx: int, round_idx: int,
+        beacon, rtt_s: float,
+    ) -> None:
+        """Fold one client telemetry beacon (telemetry/wire.py) into
+        health, flight, and fleet. Consumed at most once per (worker,
+        round): a flaky/retried upload restates the SAME beacon, and the
+        bytes were metered client-side at attach, so duplicates are
+        attribution no-ops here. Caller holds _round_lock."""
+        if not isinstance(beacon, dict):
+            return
+        if self._beacon_seen.get(worker) == round_idx:
+            return
+        self._beacon_seen[worker] = int(round_idx)
+        try:
+            train_s = max(0.0, float(beacon.get("train_s", 0.0)))
+            encode_s = max(0.0, float(beacon.get("encode_s", 0.0)))
+        except (TypeError, ValueError):
+            return
+        tier = beacon.get("tier")
+        self.health.observe_train(client_idx, round_idx, train_s, tier=tier)
+        from fedml_tpu.telemetry import get_fleet
+
+        get_fleet().observe_beacon(tier, beacon, rtt_s=rtt_s)
+        if self._flight is not None:
+            # the measured train-vs-wire-vs-queue split: whatever the
+            # round trip spent beyond training+encoding sat on the wire
+            # or in a queue
+            self._flight.observe_beacon(
+                round_idx, train_s, encode_s,
+                wire_s=max(0.0, rtt_s - train_s - encode_s),
+            )
 
     def _complete_round(self):
         """Aggregate whatever has arrived, eval, resample, broadcast.
@@ -818,13 +880,15 @@ class FedAvgServerManager(ServerManager):
         sampled = self.scheduler.select(self.round_idx, k=self.worker_num)
         self._round_span = self._tracer.start_span("round", round=self.round_idx)
         with self._tracer.span("broadcast", round=self.round_idx):
+            shipped, raw = _model_wire_cost(self.global_vars)
             for worker, client_idx in enumerate(sampled, start=1):
                 msg = Message(MT.S2C_SYNC_MODEL, 0, worker)
                 msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
                 msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
                 msg.add_params(MT.ARG_ROUND_IDX, self.round_idx)
                 self._assigned[worker] = (int(client_idx), time.monotonic())
-                self._broadcast(msg)
+                if self._broadcast(msg):
+                    get_comm_meter().on_downlink(shipped, raw)
         self._arm_deadline()
 
 
@@ -928,6 +992,7 @@ class FedAvgClientManager(ClientManager):
                 # quorum path aggregates the partial cohort
                 self._faults.record(cid, int(round_idx), "dropout")
                 return
+        t_train = time.perf_counter()
         weights, n = self.trainer.train(round_idx, w_round)
         if fd is not None and fd.slowdown_s:
             self._faults.record(
@@ -935,6 +1000,9 @@ class FedAvgClientManager(ClientManager):
                 detail=fd.slowdown_s,
             )
             time.sleep(fd.slowdown_s)
+        # beacon train time: compute INCLUDING any injected slowdown (a
+        # slow device trains slowly — that is what the tier digests bin)
+        train_s = time.perf_counter() - t_train
         comp = self.config.comm.compression
         if self.config.comm.secure_agg:
             # advertise a fresh per-round keypair; the masked upload waits
@@ -966,9 +1034,11 @@ class FedAvgClientManager(ClientManager):
         raw_bytes = 4 * sum(
             int(np.size(a)) for a in jax.tree_util.tree_leaves(weights)
         )
+        encode_s = 0.0
         if comp != "none":
             # uplink compression (core/compression.py): send the encoded
             # round delta; the server reconstructs against the same w_round
+            t_enc = time.perf_counter()
             if self._ef is not None:
                 payload = self._ef.encode(
                     self.trainer.client_index, weights, w_round
@@ -977,6 +1047,7 @@ class FedAvgClientManager(ClientManager):
                 payload = CZ.encode_update(
                     weights, w_round, comp, self.config.comm.topk_frac
                 )
+            encode_s = time.perf_counter() - t_enc
             get_comm_meter().on_uplink(CZ.payload_bytes(payload), raw_bytes)
             out.add_params(MT.ARG_MODEL_DELTA, payload)
             out.add_params(MT.ARG_COMPRESSION, comp)
@@ -994,6 +1065,30 @@ class FedAvgClientManager(ClientManager):
         out.add_params(MT.ARG_ROUND_IDX, round_idx)
         if self.trainer.last_loss is not None:
             out.add_params(MT.ARG_TRAIN_LOSS, float(self.trainer.last_loss))
+        if getattr(self.config.comm, "beacons", True):
+            # telemetry beacon (telemetry/wire.py): a bounded summary of
+            # this round's local measurements, piggybacked on the upload.
+            # Attached (and metered) ONCE — the flaky duplicate below
+            # restates the same dict, and the server dedupes consumption
+            # per (worker, round). Rides the envelope only: aggregation
+            # never reads it, so numerics are identical with beacons off.
+            from fedml_tpu.telemetry.wire import beacon_nbytes, build_beacon
+
+            snap = get_comm_meter().snapshot()
+            tier = None
+            if self._faults is not None:
+                plan = getattr(self._faults, "plan", None)
+                if plan is not None:
+                    tier = plan.tier_of(self.trainer.client_index)
+            beacon = build_beacon(
+                train_s=train_s,
+                encode_s=encode_s,
+                retries=sum(snap.get("send_retries", {}).values()),
+                codec=comp,
+                tier=tier,
+            )
+            out.add_params(MT.ARG_TELEMETRY, beacon)
+            get_comm_meter().on_beacon(beacon_nbytes(beacon))
         self.send_message(out)
         if fd is not None and fd.flaky:
             # flaky upload = at-least-once double delivery; the sync

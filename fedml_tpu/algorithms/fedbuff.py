@@ -55,8 +55,8 @@ from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message, MessageType as MT
 from fedml_tpu.data.base import FederatedDataset
 from fedml_tpu.models import ModelDef
-from fedml_tpu.algorithms.fedavg_transport import LocalTrainer
-from fedml_tpu.telemetry import ClientHealthRegistry, get_tracer
+from fedml_tpu.algorithms.fedavg_transport import LocalTrainer, _model_wire_cost
+from fedml_tpu.telemetry import ClientHealthRegistry, get_comm_meter, get_tracer
 from fedml_tpu.train.evaluate import evaluate, make_eval_fn
 
 
@@ -230,6 +230,10 @@ class FedBuffServerManager(ServerManager):
         self._dispatch_times[worker] = (client_index, tag, time.monotonic())
         try:
             self.send_message(msg)
+            # downlink accounting at dispatch encode time — the async
+            # mirror of the sync server's broadcast metering
+            shipped, raw = _model_wire_cost(self.global_vars)
+            get_comm_meter().on_downlink(shipped, raw)
         except Exception as e:  # noqa: BLE001 — transport errors vary by backend
             self._dead_workers.add(worker)
             logging.warning("async dispatch to worker %d failed (%s)", worker, e)

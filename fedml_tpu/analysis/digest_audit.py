@@ -281,7 +281,7 @@ KNOWN_BENIGN = frozenset({
     "comm.compression", "comm.topk_frac", "comm.error_feedback",
     "comm.secure_agg", "comm.send_retries", "comm.send_backoff_s",
     "comm.send_backoff_max_s", "comm.send_retry_deadline_s",
-    "comm.send_timeout_s", "comm.send_fault_p",
+    "comm.send_timeout_s", "comm.send_fault_p", "comm.beacons",
     "mesh.client_shards", "mesh.axis_name",
     "compile.warmup", "compile.cache_dir", "compile.min_compile_time_s",
     "compile.executable_cache", "compile.recompile_budget",

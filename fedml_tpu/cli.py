@@ -279,6 +279,16 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
               help="Transport runtimes: pairwise-masked uploads — the "
                    "server only ever sums masked field vectors (ref "
                    "turboaggregate); quorum rounds recover dropout masks")
+@click.option("--beacons/--no_beacons", default=True,
+              help="Transport runtimes: piggyback a bounded (~200 B) client "
+                   "telemetry beacon on each model upload — measured "
+                   "train/encode seconds, retry count, codec, DeviceProfile "
+                   "tier (telemetry/wire.py). Feeds the server's health "
+                   "registry, flight recorder phase splits, and the "
+                   "per-tier fleet digests (/fleet, fedml_fleet_*). "
+                   "Observability only: numerics are byte-identical with "
+                   "beacons off; overhead is metered separately as "
+                   "comm/beacon_bytes and never counted as model payload")
 @click.option("--warmup", is_flag=True, default=False,
               help="AOT-compile the run's programs before round 0 "
                    "(fedml_tpu/compile/warmup.py): round/eval/server "
@@ -612,6 +622,7 @@ def build_config(opt) -> RunConfig:
             send_backoff_s=opt.get("send_backoff_s", 0.05),
             send_timeout_s=opt.get("send_timeout_s", 30.0),
             send_fault_p=opt.get("send_fault_p", 0.0) or 0.0,
+            beacons=opt.get("beacons", True),
         ),
         mesh=MeshConfig(client_shards=opt["client_shards"]),
         compile=CompileConfig(
@@ -652,6 +663,11 @@ def _telemetry_start(opt, config=None):
     # summary.json telemetry row describe THIS run, not whatever earlier
     # runs happened in the same process (CliRunner tests, notebook sweeps)
     get_tracer().reset()
+    # fleet digests (telemetry/wire.py): per-tier latency percentiles fed
+    # by client beacons — run-scoped like the tracer, for the same reason
+    from fedml_tpu.telemetry import get_fleet
+
+    get_fleet().reset()
     state = {"exporter": None, "comm_baseline": get_comm_meter().snapshot()}
     # flight recorder (telemetry/flight.py): fold the run's round spans
     # into the bounded last-K ring — flight/* summary block + flight.json
@@ -678,6 +694,11 @@ def _telemetry_start(opt, config=None):
         ensure_backend_listener()
 
         state["exporter"] = PrometheusExporter(port=opt["prom_port"]).start()
+        # /fleet: the live per-tier beacon digest snapshot, next to
+        # /metrics (serve runs get it via RoundIntrospection.install)
+        state["exporter"].add_route(
+            "/fleet", lambda _path: (200, get_fleet().snapshot())
+        )
         click.echo(
             f"telemetry: prometheus metrics on "
             f"http://127.0.0.1:{state['exporter'].port}/metrics",
@@ -696,9 +717,12 @@ def _telemetry_finish(state, opt, logger, health=None):
     if state is None or state.get("done"):
         return
     state["done"] = True
-    from fedml_tpu.telemetry import get_tracer, telemetry_summary
+    from fedml_tpu.telemetry import get_fleet, get_tracer, telemetry_summary
 
     logger.log(telemetry_summary(baseline=state.get("comm_baseline")))
+    fleet_row = get_fleet().summary_row()
+    if fleet_row.get("fleet/beacons"):
+        logger.log(fleet_row)  # the fleet/* summary block (beacon digests)
     flight = state.get("flight")
     if flight is not None:
         logger.log(flight.summary_row())  # the flight/* summary block
@@ -1690,6 +1714,18 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
             raise RuntimeError(
                 "server deadline path failed"
             ) from server.deadline_error
+        if opt.get("checkpoint_path"):
+            # rank 0 owns the converged params — persist them so gRPC runs
+            # can be compared/resumed like the in-process runtimes (the CI
+            # wire-fleet gate diffs these arrays across beacons on/off)
+            from fedml_tpu.utils import save_checkpoint
+
+            save_checkpoint(
+                str(opt["checkpoint_path"]),
+                server.global_vars,
+                round_idx=config.fed.comm_round,
+                server_opt_state=getattr(server, "server_opt_state", None),
+            )
         return (server.history[-1] if server.history else {}), server.health
     client = FedAvgClientManager(
         config, comm, rank,

@@ -225,6 +225,13 @@ class CommConfig:
     # secagg/secure_aggregation.py SECURITY NOTE); mutually exclusive with
     # compression.
     secure_agg: bool = False
+    # Client telemetry beacons (telemetry/wire.py): a bounded ~200 B
+    # summary of local measurements (train s, encode s, retries, codec,
+    # DeviceProfile tier, RSS) piggybacked as ARG_TELEMETRY on model
+    # uploads. Observability only — it rides the envelope, never the
+    # model path, so numerics are byte-identical on or off; bytes are
+    # metered apart from model bytes (comm/beacon_bytes).
+    beacons: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,9 +27,25 @@ import itertools
 import time
 from typing import List, Optional
 
-from fedml_tpu.core.message import Message
+from fedml_tpu.core.message import Message, MessageType
 from fedml_tpu.core.retry import InjectedSendFault, RetryPolicy
 from fedml_tpu.telemetry.comm import get_comm_meter
+from fedml_tpu.telemetry.spans import get_tracer
+from fedml_tpu.telemetry.wire import TraceContext
+
+
+def _wire_bytes(msg: Message) -> Optional[int]:
+    """The envelope's serialized size — stamped by to_wire_parts/from_bytes
+    when the message crossed a serialization boundary, computed lazily
+    otherwise (in-process delivery that skipped serialization must not
+    vanish from byte accounting; wire_size() stamps, so it runs once)."""
+    nbytes = getattr(msg, "_wire_nbytes", None)
+    if nbytes is None:
+        try:
+            nbytes = msg.wire_size()
+        except Exception:  # noqa: BLE001 — accounting must never raise
+            nbytes = None
+    return nbytes
 
 
 class Observer(abc.ABC):
@@ -49,6 +65,11 @@ class BaseCommManager(abc.ABC):
         # the whole retry schedule replays run over run.
         self.retry_policy: Optional[RetryPolicy] = None
         self._send_seq = itertools.count()
+        # federation trace id (telemetry/wire.py): minted by the first
+        # sender, adopted from the first _trace-carrying receive — the
+        # correlation key server and client spans share
+        self._trace_ctx = TraceContext()
+        self._trace_seq = itertools.count()
 
     def set_retry_policy(self, policy: Optional[RetryPolicy]) -> None:
         self.retry_policy = policy
@@ -60,6 +81,14 @@ class BaseCommManager(abc.ABC):
         self._observers.remove(observer)
 
     def notify(self, msg: Message) -> None:
+        trace = getattr(msg, "trace", None)
+        arrival_us = None
+        if isinstance(trace, dict):
+            # adopt the sender's federation trace id (first one wins) and
+            # timestamp arrival on OUR clock — the (send ts, recv ts) pair
+            # is what `trace merge` estimates per-process clock offsets from
+            self._trace_ctx.adopt(trace.get("id"))
+            arrival_us = get_tracer().now_us()
         t0 = time.perf_counter()
         try:
             for obs in list(self._observers):
@@ -68,11 +97,21 @@ class BaseCommManager(abc.ABC):
             # received accounting even when a handler raises — the bytes DID
             # arrive, and the latency of the failing handler is exactly the
             # kind of outlier the histogram exists to surface
-            self._meter.on_received(
-                msg.get_type(),
-                getattr(msg, "_wire_nbytes", None),
-                time.perf_counter() - t0,
-            )
+            dt = time.perf_counter() - t0
+            self._meter.on_received(msg.get_type(), _wire_bytes(msg), dt)
+            if arrival_us is not None:
+                attrs = {
+                    "src": trace.get("src"),
+                    "dst": msg.get_receiver_id(),
+                    "seq": trace.get("seq"),
+                    "send_ts_us": trace.get("ts"),
+                    "msg_type": msg.get_type(),
+                }
+                if "r" in trace:
+                    attrs["round"] = trace["r"]
+                get_tracer().record_event(
+                    "wire_recv", arrival_us, dt * 1e6, **attrs
+                )
 
     def send_message(self, msg: Message, **kwargs) -> None:
         """Template method: delegate to the backend ``_send``, then account
@@ -86,6 +125,7 @@ class BaseCommManager(abc.ABC):
         dedupes on (client, round). Retry/give-up counts land in the comm
         meter (``comm/retries`` / ``comm/gave_up`` in summary.json, the
         ``fedml_comm_send_retries_total`` family in Prometheus)."""
+        self._stamp_trace(msg)
         policy = self.retry_policy
         if policy is None:
             t0 = time.perf_counter()
@@ -126,11 +166,34 @@ class BaseCommManager(abc.ABC):
                         raise
                     self._meter.on_send_retry(mt)
                     time.sleep(delay)
-        self._meter.on_sent(
-            msg.get_type(),
-            getattr(msg, "_wire_nbytes", None),
-            wire_s,
-        )
+        self._meter.on_sent(msg.get_type(), _wire_bytes(msg), wire_s)
+
+    def _stamp_trace(self, msg: Message) -> None:
+        """Stamp the compact ``_trace`` context onto the envelope (carried
+        in the meta JSON by ``to_wire_parts`` — all four transports get it
+        from this one wiring point). Keys: ``id`` federation trace id,
+        ``src`` sender, ``seq`` per-manager send sequence, ``ts``
+        epoch-anchored send timestamp (us, sender's clock), plus ``r``
+        round and ``par`` enclosing span name when known. Retried sends
+        restate the SAME dict (stamped once per send_message call), so a
+        duplicate delivery is identifiable by (src, seq)."""
+        try:
+            tracer = get_tracer()
+            trace: dict = {
+                "id": self._trace_ctx.ensure(),
+                "src": int(msg.get_sender_id()),
+                "seq": next(self._trace_seq),
+                "ts": round(tracer.now_us(), 1),
+            }
+            rnd = msg.get(MessageType.ARG_ROUND_IDX)
+            if rnd is not None:
+                trace["r"] = int(rnd)
+            cur = tracer.current_span()
+            if cur is not None:
+                trace["par"] = cur.name
+            msg.trace = trace
+        except Exception:  # noqa: BLE001 — telemetry must never block a send
+            pass
 
     @abc.abstractmethod
     def _send(self, msg: Message, **kwargs) -> None:

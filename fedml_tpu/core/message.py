@@ -12,6 +12,8 @@ Wire layout::
     [4 bytes magic 'FTM1'][8 bytes meta_len][meta JSON][buf 0][buf 1]...
 
 meta = {msg_type, sender_id, receiver_id, params: {key: scalar|str|descriptor}}
+plus an optional ``_trace`` key (cross-process trace context, stamped by
+the comm template — see telemetry/wire.py; absent = legacy envelope).
 descriptor = {"__nd__": n, dtype, shape, nbytes} referring to the n-th buffer.
 Param pytrees (nested dicts/lists of arrays) are supported via flatten with
 string treedefs — see pack_pytree/unpack_pytree."""
@@ -83,6 +85,10 @@ class MessageType:
     ARG_PUBKEY_REGISTRY = "pubkey_registry"  # {party: pk}, public material
     ARG_DROPPED = "dropped_parties"
     ARG_RECOVERY_VEC = "recovery_vec"
+    # bounded client telemetry beacon (telemetry/wire.py build_beacon)
+    # piggybacked on model uploads — observability sidecar, never read by
+    # the aggregation path, so numerics are byte-identical with it on/off
+    ARG_TELEMETRY = "telemetry"
 
 
 class Message:
@@ -94,6 +100,11 @@ class Message:
         # serialized wire size, stamped by to_wire_parts/from_bytes — None
         # until the envelope has crossed a serialization boundary
         self._wire_nbytes: Optional[int] = None
+        # cross-process trace context (telemetry/wire.py), stamped by the
+        # BaseCommManager.send_message template and carried as an OPTIONAL
+        # "_trace" meta key — absent on legacy peers, so mixed-version
+        # fleets decode fine
+        self.trace: Optional[Dict[str, Any]] = None
 
     # -- envelope API (ref message.py:20-74) --
     def add_params(self, key: str, value: Any) -> "Message":
@@ -122,14 +133,15 @@ class Message:
         meta_params: Dict[str, Any] = {}
         for k, v in self.params.items():
             meta_params[k] = _encode_value(v, buffers)
-        meta = json.dumps(
-            {
-                "msg_type": self.msg_type,
-                "sender_id": self.sender_id,
-                "receiver_id": self.receiver_id,
-                "params": meta_params,
-            }
-        ).encode("utf-8")
+        meta_doc: Dict[str, Any] = {
+            "msg_type": self.msg_type,
+            "sender_id": self.sender_id,
+            "receiver_id": self.receiver_id,
+            "params": meta_params,
+        }
+        if self.trace is not None:
+            meta_doc["_trace"] = self.trace
+        meta = json.dumps(meta_doc).encode("utf-8")
         header = _MAGIC + struct.pack("<Q", len(meta)) + meta
         # stamp the serialized size on the envelope: the comm layer's
         # telemetry (core/comm.py) reads it so byte accounting never needs
@@ -166,6 +178,9 @@ class Message:
         (meta_len,) = struct.unpack("<Q", bytes(data[4:12]))
         meta = json.loads(bytes(data[12 : 12 + meta_len]).decode("utf-8"))
         msg = cls(meta["msg_type"], meta["sender_id"], meta["receiver_id"])
+        # optional trace context — .get() is the legacy-decode contract:
+        # an envelope from an older peer simply has no "_trace" key
+        msg.trace = meta.get("_trace")
         offset = 12 + meta_len
         # buffers appear in descriptor-index order; walk descriptors sorted
         # by index to compute offsets. NOTE: the recursive helpers are

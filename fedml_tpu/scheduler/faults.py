@@ -263,6 +263,7 @@ class FaultPlan:
         default: Optional[ClientFaultSpec] = None,
         seed: int = 0,
         scripted: Optional[Dict[int, Dict[int, dict]]] = None,
+        tiers: Optional[Dict[int, str]] = None,
     ):
         self.clients = {int(c): s for c, s in (clients or {}).items()}
         self.default = default or ClientFaultSpec()
@@ -271,23 +272,30 @@ class FaultPlan:
             int(c): {int(r): dict(ev) for r, ev in rounds.items()}
             for c, rounds in (scripted or {}).items()
         }
+        # client -> DeviceProfile tier NAME: the attribution key the
+        # telemetry beacons carry (telemetry/wire.py) and the fleet
+        # aggregator groups by. Populated by from_json from fleet
+        # assignments and named-profile client entries; spec parsing used
+        # to discard the names.
+        self.tiers = {int(c): str(t) for c, t in (tiers or {}).items()}
 
     # -- construction --
     @classmethod
     def from_json(cls, doc: dict) -> "FaultPlan":
         unknown = set(doc) - {
             "seed", "default", "clients", "profiles", "fleet",
-            "num_clients", "scripted",
+            "num_clients", "scripted", "tiers",
         }
         if unknown:
             raise ValueError(
                 f"fault plan: unknown top-level keys {sorted(unknown)} "
                 "(known: seed, default, clients, profiles, fleet, "
-                "num_clients, scripted)"
+                "num_clients, scripted, tiers)"
             )
         seed = doc.get("seed", 0)
         profiles = _parse_profiles(doc.get("profiles"))
         clients = {}
+        tiers: Dict[int, str] = {}
         if doc.get("fleet"):
             # the whole-population shorthand: {"fleet": {tier: weight},
             # "num_clients": N} — per-client tiers derive deterministically
@@ -298,14 +306,25 @@ class FaultPlan:
             clients = {
                 cid: profiles[name].spec() for cid, name in assignment.items()
             }
+            tiers.update(assignment)
         elif "num_clients" in doc:
             raise ValueError("fault plan: num_clients only makes sense with fleet")
-        clients.update({
-            int(cid): _parse_spec(
+        for cid, spec in (doc.get("clients") or {}).items():
+            clients[int(cid)] = _parse_spec(
                 spec, f"fault plan client {cid}", profiles=profiles
             )
-            for cid, spec in (doc.get("clients") or {}).items()
-        })
+            # keep the tier NAME when the entry references a profile
+            # (a plain string alias or {"profile": name, ...overrides})
+            name = (
+                spec if isinstance(spec, str)
+                else spec.get("profile") if isinstance(spec, dict)
+                else None
+            )
+            if name is not None:
+                tiers[int(cid)] = str(name)
+        # explicit tiers (e.g. a to_json round-trip) take precedence
+        for cid, name in (doc.get("tiers") or {}).items():
+            tiers[int(cid)] = str(name)
         default = _parse_spec(
             doc.get("default", {}), "fault plan default", profiles=profiles
         )
@@ -325,7 +344,10 @@ class FaultPlan:
                     "slowdown_s": float(ev.get("slowdown_s", 0.0)),
                 }
             scripted[int(cid)] = per
-        return cls(clients=clients, default=default, seed=seed, scripted=scripted)
+        return cls(
+            clients=clients, default=default, seed=seed, scripted=scripted,
+            tiers=tiers,
+        )
 
     @classmethod
     def from_spec(cls, spec: str) -> Optional["FaultPlan"]:
@@ -400,6 +422,11 @@ class FaultPlan:
     def spec_for(self, client_id: int) -> ClientFaultSpec:
         return self.clients.get(int(client_id), self.default)
 
+    def tier_of(self, client_id: int) -> Optional[str]:
+        """The client's DeviceProfile tier name (None when the plan never
+        assigned one) — what a client stamps into its telemetry beacon."""
+        return self.tiers.get(int(client_id))
+
     def has_participation_faults(self) -> bool:
         """True when the plan can remove an upload (dropout or crash) —
         sync transport runs then need deadline/quorum rounds to not hang."""
@@ -471,6 +498,10 @@ class FaultPlan:
             doc["scripted"] = {
                 str(c): {str(r): dict(ev) for r, ev in sorted(rounds.items())}
                 for c, rounds in sorted(self.scripted.items())
+            }
+        if self.tiers:
+            doc["tiers"] = {
+                str(c): t for c, t in sorted(self.tiers.items())
             }
         return doc
 

@@ -41,7 +41,7 @@ import click
 class Introspector:
     """Route table over one :class:`FederationServer` (serve/server.py)."""
 
-    ROUTES = ("/status", "/tenants/", "/compile", "/healthz")
+    ROUTES = ("/status", "/tenants/", "/compile", "/healthz", "/fleet")
 
     def __init__(self, server):
         self.server = server
@@ -52,6 +52,7 @@ class Introspector:
         exporter.add_route("/tenants/", self._r_tenant)
         exporter.add_route("/compile", self._r_compile)
         exporter.add_route("/healthz", self._r_healthz)
+        exporter.add_route("/fleet", self._r_fleet)
         return self
 
     # -- per-tenant brief ----------------------------------------------------
@@ -172,6 +173,14 @@ class Introspector:
         out.update(compile_snapshot())
         return 200, out
 
+    def _r_fleet(self, path: str) -> Tuple[int, dict]:
+        # the wire-telemetry fleet view (telemetry/wire.py): per-tier
+        # beacon-fed latency digests — process-global like /compile, since
+        # beacons from every tenant fold into one FleetAggregator
+        from fedml_tpu.telemetry import get_fleet
+
+        return 200, get_fleet().snapshot()
+
     def _r_healthz(self, path: str) -> Tuple[int, dict]:
         failed = [
             s.name
@@ -272,6 +281,30 @@ def render_status(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def _watch_loop(fetch, render, interval_s: float, echo=click.echo,
+                clear=click.clear, sleep=time.sleep, iterations=None):
+    """``--watch`` redraw loop, factored for tests: clear, fetch, render,
+    sleep, repeat. A transient fetch error renders as a one-line message
+    and the loop keeps polling (a restarting server should not kill the
+    dashboard); Ctrl-C exits cleanly. ``iterations`` bounds the loop for
+    tests (None = forever)."""
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            n += 1
+            clear()
+            try:
+                echo(render(fetch()))
+            except Exception as e:  # noqa: BLE001 — keep the watch alive
+                echo(f"(fetch failed: {e} — retrying every {interval_s}s)")
+            if iterations is not None and n >= iterations:
+                break
+            sleep(interval_s)
+    except KeyboardInterrupt:
+        pass  # clean exit, no traceback — Ctrl-C is how a watch ends
+    return n
+
+
 @click.command(name="status")
 @click.option("--url", default="http://127.0.0.1:9464",
               help="Base URL of a running service's metrics/introspection "
@@ -281,7 +314,12 @@ def render_status(doc: dict) -> str:
                    "tail, health summary, checkpoint age) as JSON")
 @click.option("--json", "as_json", is_flag=True, default=False,
               help="Raw JSON instead of the table")
-def status_main(url: str, tenant: Optional[str], as_json: bool):
+@click.option("--watch", type=float, default=None,
+              help="Redraw every N seconds until Ctrl-C (top-style). "
+                   "Transient fetch errors keep polling instead of "
+                   "exiting — a restarting server comes back into view")
+def status_main(url: str, tenant: Optional[str], as_json: bool,
+                watch: Optional[float]):
     """Pretty-print a running federation service's /status."""
     from urllib.parse import quote
 
@@ -290,6 +328,17 @@ def status_main(url: str, tenant: Optional[str], as_json: bool):
         f"{base}/tenants/{quote(tenant, safe='')}" if tenant
         else f"{base}/status"
     )
+
+    def _render(doc):
+        if tenant or as_json:
+            return json.dumps(doc, indent=2, default=str)
+        return render_status(doc)
+
+    if watch is not None:
+        if watch <= 0:
+            raise click.UsageError("--watch interval must be > 0")
+        _watch_loop(lambda: _fetch(target), _render, watch)
+        return
     try:
         doc = _fetch(target)
     except Exception as e:  # noqa: BLE001 — connection errors are the UX
@@ -297,10 +346,7 @@ def status_main(url: str, tenant: Optional[str], as_json: bool):
             f"could not reach {target}: {e} (is the service running with "
             "--prom_port?)"
         )
-    if tenant or as_json:
-        click.echo(json.dumps(doc, indent=2, default=str))
-        return
-    click.echo(render_status(doc))
+    click.echo(_render(doc))
 
 
 if __name__ == "__main__":

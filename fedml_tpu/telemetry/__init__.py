@@ -62,11 +62,18 @@ from fedml_tpu.telemetry.spans import (
     get_tracer,
     span,
 )
+from fedml_tpu.telemetry.wire import (
+    FleetAggregator,
+    TraceContext,
+    build_beacon,
+    get_fleet,
+)
 
 __all__ = [
     "ClientHealthRegistry",
     "CommMeter",
     "Counter",
+    "FleetAggregator",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -76,10 +83,13 @@ __all__ = [
     "SpanEvent",
     "TelemetryScope",
     "TenantedRegistryView",
+    "TraceContext",
     "Tracer",
     "activate_scope",
+    "build_beacon",
     "current_scope",
     "get_comm_meter",
+    "get_fleet",
     "get_global_registry",
     "get_global_tracer",
     "get_registry",
@@ -121,6 +131,14 @@ def telemetry_summary(baseline: dict = None) -> dict:
         ("uplink_payload_bytes", "comm/uplink_bytes"),
         ("uplink_raw_bytes", "comm/uplink_raw_bytes"),
         ("uplink_updates", "comm/uplink_updates"),
+        # downlink mirror (metered at broadcast encode time) + the
+        # telemetry-beacon overhead, kept apart from model bytes so the
+        # piggyback cost is read, never asserted (telemetry/wire.py)
+        ("downlink_payload_bytes", "comm/downlink_bytes"),
+        ("downlink_raw_bytes", "comm/downlink_raw_bytes"),
+        ("downlink_updates", "comm/downlink_updates"),
+        ("beacons", "comm/beacons"),
+        ("beacon_bytes", "comm/beacon_bytes"),
     ):
         total = int(snap.get(key, 0))
         if baseline:

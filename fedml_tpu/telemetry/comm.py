@@ -52,6 +52,17 @@ class CommMeter:
         self._uplink_payload_bytes = 0
         self._uplink_raw_bytes = 0
         self._uplink_updates = 0
+        # downlink mirror of the uplink accounting: broadcast model bytes
+        # as shipped vs fp32-equivalent, metered at broadcast encode time
+        # on the server path — so quantization work reads both directions
+        # off one table
+        self._downlink_payload_bytes = 0
+        self._downlink_raw_bytes = 0
+        self._downlink_updates = 0
+        # telemetry-beacon overhead (telemetry/wire.py): metered apart
+        # from model bytes so the piggyback cost is observable
+        self._beacons = 0
+        self._beacon_bytes = 0
         r = self.registry
         self._c_sent = r.counter(
             "fedml_comm_messages_sent_total",
@@ -100,6 +111,18 @@ class CommMeter:
         self._c_uplink_raw = r.counter(
             "fedml_comm_uplink_raw_bytes_total",
             "fp32-equivalent bytes of the same model updates (pre-codec)",
+        )
+        self._c_downlink_payload = r.counter(
+            "fedml_comm_downlink_payload_bytes_total",
+            "Broadcast model payload bytes as shipped (server downlink)",
+        )
+        self._c_downlink_raw = r.counter(
+            "fedml_comm_downlink_raw_bytes_total",
+            "fp32-equivalent bytes of the same broadcasts (pre-codec)",
+        )
+        self._c_beacon_bytes = r.counter(
+            "fedml_comm_beacon_bytes_total",
+            "Client telemetry-beacon bytes piggybacked on uploads",
         )
 
     # -- hot path (called from BaseCommManager) --
@@ -154,6 +177,26 @@ class CommMeter:
         self._c_uplink_payload.inc(int(payload_bytes))
         self._c_uplink_raw.inc(int(raw_bytes))
 
+    def on_downlink(self, payload_bytes: int, raw_bytes: int) -> None:
+        """One server model broadcast to one worker: as-shipped payload
+        bytes vs fp32-equivalent — the downlink mirror of
+        :meth:`on_uplink`, metered at broadcast encode time."""
+        with self._lock:
+            self._downlink_payload_bytes += int(payload_bytes)
+            self._downlink_raw_bytes += int(raw_bytes)
+            self._downlink_updates += 1
+        self._c_downlink_payload.inc(int(payload_bytes))
+        self._c_downlink_raw.inc(int(raw_bytes))
+
+    def on_beacon(self, nbytes: int) -> None:
+        """One client telemetry beacon attached to an upload — metered at
+        ATTACH time on the client (never at server consume), so a
+        retried/duplicated delivery cannot double-count it."""
+        with self._lock:
+            self._beacons += 1
+            self._beacon_bytes += int(nbytes)
+        self._c_beacon_bytes.inc(int(nbytes))
+
     # -- queries --
     def snapshot(self) -> dict:
         """Plain-dict totals: {metric: {msg_type: value}} — what the
@@ -169,6 +212,11 @@ class CommMeter:
                 "uplink_payload_bytes": self._uplink_payload_bytes,
                 "uplink_raw_bytes": self._uplink_raw_bytes,
                 "uplink_updates": self._uplink_updates,
+                "downlink_payload_bytes": self._downlink_payload_bytes,
+                "downlink_raw_bytes": self._downlink_raw_bytes,
+                "downlink_updates": self._downlink_updates,
+                "beacons": self._beacons,
+                "beacon_bytes": self._beacon_bytes,
             }
 
     def reset(self) -> None:
@@ -184,6 +232,11 @@ class CommMeter:
             self._uplink_payload_bytes = 0
             self._uplink_raw_bytes = 0
             self._uplink_updates = 0
+            self._downlink_payload_bytes = 0
+            self._downlink_raw_bytes = 0
+            self._downlink_updates = 0
+            self._beacons = 0
+            self._beacon_bytes = 0
 
 
 _GLOBAL: Optional[CommMeter] = None

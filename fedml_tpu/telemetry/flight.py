@@ -225,6 +225,66 @@ class FlightRecorder:
             folded = self._fold(key, dur_s, ev.attrs)
         return folded
 
+    def observe_beacon(
+        self,
+        round_idx: int,
+        train_s: float,
+        encode_s: float = 0.0,
+        wire_s: float = 0.0,
+    ) -> None:
+        """Fold one client telemetry beacon (telemetry/wire.py) into the
+        round's record: MEASURED client-side train/encode seconds plus the
+        residual wire+queue time the server derives (rtt - train - encode)
+        — the train-vs-wire-vs-queue split a remote fleet cannot get from
+        the server's own spans. Kept under a separate ``beacon`` key, so
+        in-process runs (where local_train spans already feed phases)
+        never double-count."""
+        key = int(round_idx)
+        add = {
+            "n": 1,
+            "train_s": max(0.0, float(train_s)),
+            "encode_s": max(0.0, float(encode_s)),
+            "wire_s": max(0.0, float(wire_s)),
+        }
+        with self._lock:
+            p = self._pending.get(key)
+            if p is None:
+                # round already folded (async arrival): merge into the
+                # ring record unless sealed/evicted — same contract as
+                # late phase spans
+                if self.rounds_folded and key not in self._sealed:
+                    for rec in reversed(self._ring):
+                        if rec["round"] == key:
+                            self._beacon_accumulate(
+                                rec.setdefault(
+                                    "beacon",
+                                    {
+                                        "n": 0,
+                                        "train_s": 0.0,
+                                        "encode_s": 0.0,
+                                        "wire_s": 0.0,
+                                    },
+                                ),
+                                add,
+                            )
+                            return
+                    if self._ring and key <= self._ring[-1]["round"]:
+                        return  # evicted history: drop, never reopen
+                p = self._pending[key] = {"phases": {}, "train": []}
+                while len(self._pending) > _MAX_PENDING:
+                    self._pending.pop(next(iter(self._pending)))
+            b = p.setdefault(
+                "beacon",
+                {"n": 0, "train_s": 0.0, "encode_s": 0.0, "wire_s": 0.0},
+            )
+            self._beacon_accumulate(b, add)
+
+    @staticmethod
+    def _beacon_accumulate(into: dict, add: dict) -> None:
+        into["n"] += add["n"]
+        for k in ("train_s", "encode_s", "wire_s"):
+            into[k] = round(into[k] + add[k], 6)
+
     def _merge_late_locked(self, key: int, name: str, dur_s: float) -> bool:
         """A phase span arriving after its round folded (the sim's eval
         runs from the deferred metrics-log path): merge into the ring
@@ -301,6 +361,8 @@ class FlightRecorder:
             }
             if attrs.get("fused_rounds"):
                 rec["fused_rounds"] = int(attrs["fused_rounds"])
+            if p.get("beacon"):
+                rec["beacon"] = p["beacon"]
             if comm is not None:
                 rec["comm_bytes_sent"] = comm["bytes_sent"]
                 rec["comm_bytes_received"] = comm["bytes_received"]
@@ -378,17 +440,23 @@ class FlightRecorder:
             # copy INSIDE the lock: _merge_late_locked mutates ring
             # records' phases dicts in place, and an iteration racing
             # that insert raises mid-scrape
-            recs = [dict(r, phases=dict(r["phases"])) for r in self._ring]
+            recs = [self._copy_rec(r) for r in self._ring]
         if n is not None:
             recs = recs[-int(n):]
         return recs
+
+    @staticmethod
+    def _copy_rec(r: dict) -> dict:
+        out = dict(r, phases=dict(r["phases"]))
+        if "beacon" in r:
+            out["beacon"] = dict(r["beacon"])
+        return out
 
     def last(self) -> Optional[dict]:
         with self._lock:
             if not self._ring:
                 return None
-            r = self._ring[-1]
-            return dict(r, phases=dict(r["phases"]))
+            return self._copy_rec(self._ring[-1])
 
     def last_fold_age_s(self) -> Optional[float]:
         """Seconds since the last fold (the /status "current round age")

@@ -72,6 +72,7 @@ class _ClientRecord:
         "times",
         "seen_rounds",
         "faults",
+        "tier",
     )
 
     def __init__(self, window: int, spilled: Optional[SpilledRecord] = None):
@@ -86,6 +87,10 @@ class _ClientRecord:
         self.times: deque = deque(maxlen=window)
         # bounded dedupe memory: only the most recent window of round ids
         self.seen_rounds: deque = deque(maxlen=window)
+        # DeviceProfile tier from telemetry beacons (telemetry/wire.py);
+        # None until a beacon names one. Not spilled — attribution, not
+        # an exact counter.
+        self.tier: Optional[str] = None
 
     def mean(self) -> Optional[float]:
         if not self.times:
@@ -167,14 +172,22 @@ class ClientHealthRegistry:
 
     # -- feeding --
     def observe_train(
-        self, client_id: int, round_idx: int, wall_s: float
+        self,
+        client_id: int,
+        round_idx: int,
+        wall_s: float,
+        tier: Optional[str] = None,
     ) -> bool:
         """Record one local-train observation. Returns False when the
-        (client, round) pair was already recorded (span-stream dedupe)."""
+        (client, round) pair was already recorded (span-stream dedupe).
+        ``tier`` (from a telemetry beacon) updates the client's
+        DeviceProfile attribution even when the timing is deduped."""
         cid = int(client_id)
         r = int(round_idx)
         with self._lock:
             rec = self._touch(cid)
+            if tier:
+                rec.tier = str(tier)
             if r in rec.seen_rounds:
                 return False
             rec.seen_rounds.append(r)
@@ -406,6 +419,8 @@ class ClientHealthRegistry:
                 "straggler": cid in stragglers,
                 "faults": dict(rec.faults),
             }
+            if rec.tier:
+                out[str(cid)]["tier"] = rec.tier
             if cid in dropped:
                 out[str(cid)]["trace_incomplete"] = True
         for cid, sp in spilled:

@@ -146,6 +146,13 @@ class Tracer:
     def _now_us(self) -> float:
         return self._epoch_us + (time.perf_counter_ns() - self._anchor_ns) / 1e3
 
+    def now_us(self) -> float:
+        """This tracer's epoch-anchored clock (us) — the timebase every
+        recorded event uses, and the one the cross-process trace context
+        (telemetry/wire.py) stamps into outbound messages so recv-side
+        deltas are comparable across processes."""
+        return self._now_us()
+
     # -- nesting stack (per thread, parent attribution) --
     def _stack(self) -> List[Span]:
         st = getattr(self._local, "stack", None)
@@ -167,7 +174,31 @@ class Tracer:
         elif s in st:  # mis-nested exit — drop it and everything above
             del st[st.index(s):]
 
+    def current_span(self) -> Optional[Span]:
+        """The innermost open context-manager span on the calling thread
+        (None outside any ``with span(...)``) — parent attribution for
+        the outbound trace context."""
+        st = getattr(self._local, "stack", None)
+        return st[-1] if st else None
+
     # -- recording --
+    def record_event(
+        self, name: str, ts_us: float, dur_us: float = 0.0, **attrs
+    ) -> SpanEvent:
+        """Record a pre-timed event directly (no Span handle) — the comm
+        template uses this for ``wire_recv`` markers whose start is the
+        message arrival, not a span entry."""
+        ev = SpanEvent(
+            str(name),
+            float(ts_us),
+            float(dur_us),
+            os.getpid(),
+            threading.get_ident(),
+            attrs,
+        )
+        self._record(ev)
+        return ev
+
     def _record(self, ev: SpanEvent) -> None:
         with self._lock:
             if len(self._events) < self.max_events:

@@ -1,0 +1,605 @@
+"""Federation-wide wire telemetry: cross-process trace propagation, client
+beacons, and fleet-level attribution.
+
+Three pieces, one correlation story:
+
+- **Trace context** (:class:`TraceContext`): a compact ``_trace`` dict
+  (trace id, sender, per-manager send sequence, round, parent span name,
+  epoch-anchored send timestamp in us) stamped into the ``Message`` meta
+  JSON by the ``BaseCommManager.send_message`` template (core/comm.py) —
+  ONE wiring point, all four transports (loopback/shm/gRPC/MQTT) get it
+  for free because they all serialize through ``to_wire_parts``. The
+  field is optional in the envelope: an old peer's message simply has no
+  ``_trace`` and decodes as before, so mixed-version fleets keep working.
+  The server mints the federation trace id on its first send; every
+  receiver adopts the first id it sees, so one id spans the fleet.
+
+- **Client beacons** (:func:`build_beacon`): clients fold their local
+  measurements (local_train s, encode s, cumulative wire retries, codec,
+  DeviceProfile tier, RSS) into a bounded ~200 B summary piggybacked as
+  ``MessageType.ARG_TELEMETRY`` on the existing model upload — no new
+  round trips, and the bytes are metered separately from model bytes
+  (``comm/beacon_bytes``) so the overhead is observable, never asserted.
+  The server feeds beacons into the client health registry, the flight
+  recorder (per-round train-vs-wire split), and the fleet aggregator.
+
+- **Fleet aggregates** (:class:`FleetAggregator`): O(tiers)
+  byte-budgeted log-bucketed latency digests per (DeviceProfile tier,
+  metric), exported as ``fedml_fleet_*`` Prometheus families, served on
+  the ``/fleet`` introspection route, and summarised as ``fleet/*``
+  summary.json keys. No per-client state — the population-scale bound
+  (fedml_tpu/population/) holds at a million clients.
+
+Plus the offline half: ``python -m fedml_tpu trace merge <dirs>`` aligns
+the per-process Chrome traces (``--telemetry_dir`` writes one per rank)
+into a single Perfetto-viewable federation timeline. Per-process clocks
+are reconciled NTP-style from the send/recv timestamp pairs the trace
+context carries: for client r, with d1 = min over server->r messages of
+(recv_ts - send_ts) and d2 = min over r->server messages of the same,
+the client's clock offset is ~ (d1 - d2) / 2 (symmetric one-way delay
+assumption — sub-ms on localhost, and errors only shift tracks, never
+reorder a process's own events).
+
+Stdlib-only, importable before jax, like the rest of telemetry/."""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from fedml_tpu.telemetry.metrics import MetricsRegistry, get_registry
+
+# beacon byte budget: the summary must stay ~200 B so piggybacking it on
+# every upload is noise next to model payloads; build_beacon drops
+# optional fields (never raises) to stay under this
+BEACON_MAX_BYTES = 256
+
+# fixed geometric bucket ladder shared by every digest: 100 us growing
+# 35%/bucket for 64 buckets reaches ~2.3e4 s — resolution ~±16% anywhere,
+# 64 ints of state per (tier, metric) series, forever
+_EDGE_BASE = 1e-4
+_EDGE_GROWTH = 1.35
+_NUM_BUCKETS = 64
+_LOG_GROWTH = math.log(_EDGE_GROWTH)
+
+# bound the (tier, metric) fan-out: DeviceProfile fleets have a handful
+# of tiers; anything past the cap (a bug, or hostile beacon tiers) folds
+# into one overflow series instead of growing without limit
+_MAX_TIERS = 32
+
+_TRACE_FILE_RE = re.compile(r"\.rank(\d+)\.")
+
+
+# ---------------------------------------------------------------------------
+# trace context
+# ---------------------------------------------------------------------------
+
+
+class TraceContext:
+    """The federation trace id for one comm manager: minted lazily by the
+    first sender (in practice the server's init broadcast), adopted by
+    every receiver from the first ``_trace``-carrying message — so the
+    whole fleet converges on the server's id without a handshake."""
+
+    __slots__ = ("_lock", "_id")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._id: Optional[str] = None
+
+    def ensure(self) -> str:
+        """The trace id, minting one if this manager has none yet."""
+        with self._lock:
+            if self._id is None:
+                self._id = uuid.uuid4().hex[:12]
+            return self._id
+
+    def adopt(self, trace_id: Optional[str]) -> None:
+        """Adopt a peer's id — first writer wins, later ids are ignored
+        (the server already minted; a client adopts exactly once)."""
+        if not trace_id:
+            return
+        with self._lock:
+            if self._id is None:
+                self._id = str(trace_id)
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        with self._lock:
+            return self._id
+
+
+# ---------------------------------------------------------------------------
+# client beacons
+# ---------------------------------------------------------------------------
+
+
+def _rss_mb() -> Optional[float]:
+    """Resident set size in MB, best effort (Linux /proc, else rusage)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except Exception:  # noqa: BLE001 — not Linux / procfs unavailable
+        pass
+    try:
+        import resource
+
+        return round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        )
+    except Exception:  # noqa: BLE001 — telemetry never raises
+        return None
+
+
+def beacon_nbytes(beacon: dict) -> int:
+    """The beacon's compact-JSON wire footprint (what ``on_beacon`` meters
+    — the dict rides the meta JSON, so this IS its marginal cost)."""
+    return len(json.dumps(beacon, separators=(",", ":")).encode("utf-8"))
+
+
+def build_beacon(
+    *,
+    train_s: float,
+    encode_s: float = 0.0,
+    retries: int = 0,
+    codec: Optional[str] = None,
+    tier: Optional[str] = None,
+    rss_mb: Optional[float] = None,
+    sample_rss: bool = True,
+) -> dict:
+    """A bounded client telemetry summary (schema v1, see
+    docs/OBSERVABILITY.md). Optional fields are dropped — in fixed
+    priority order — until the compact JSON fits ``BEACON_MAX_BYTES``;
+    never raises, never exceeds the budget."""
+    beacon: Dict[str, Any] = {
+        "v": 1,
+        "train_s": round(float(train_s), 4),
+        "encode_s": round(float(encode_s), 4),
+    }
+    if retries:
+        beacon["retries"] = int(retries)
+    if codec and codec != "none":
+        beacon["codec"] = str(codec)[:16]
+    if tier:
+        beacon["tier"] = str(tier)[:24]
+    if rss_mb is None and sample_rss:
+        rss_mb = _rss_mb()
+    if rss_mb:
+        beacon["rss_mb"] = round(float(rss_mb), 1)
+    for key in ("rss_mb", "codec", "retries", "tier"):
+        if beacon_nbytes(beacon) <= BEACON_MAX_BYTES:
+            break
+        beacon.pop(key, None)
+    return beacon
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation
+# ---------------------------------------------------------------------------
+
+
+class _Digest:
+    """Log-bucketed latency digest: fixed geometric edges, 64 counters,
+    ~±16% quantile resolution — constant bytes regardless of observation
+    count (the population-scale bound)."""
+
+    __slots__ = ("counts", "n", "total", "max")
+
+    def __init__(self):
+        self.counts = [0] * _NUM_BUCKETS
+        self.n = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        x = float(seconds)
+        if not math.isfinite(x) or x < 0:
+            return
+        if x <= _EDGE_BASE:
+            idx = 0
+        else:
+            idx = min(
+                _NUM_BUCKETS - 1,
+                int(math.log(x / _EDGE_BASE) / _LOG_GROWTH) + 1,
+            )
+        self.counts[idx] += 1
+        self.n += 1
+        self.total += x
+        if x > self.max:
+            self.max = x
+
+    def percentile(self, q: float) -> float:
+        """Representative value (geometric bucket midpoint) at quantile
+        ``q`` in [0, 1]; 0.0 when empty."""
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        for idx, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                lo = _EDGE_BASE * (_EDGE_GROWTH ** max(0, idx - 1))
+                hi = _EDGE_BASE * (_EDGE_GROWTH ** idx)
+                return min(math.sqrt(lo * hi), self.max or hi)
+        return self.max
+
+    def merge_into(self, other: "_Digest") -> None:
+        for i, c in enumerate(self.counts):
+            other.counts[i] += c
+        other.n += self.n
+        other.total += self.total
+        if self.max > other.max:
+            other.max = self.max
+
+
+class FleetAggregator:
+    """Per-(DeviceProfile tier, metric) latency digests fed from client
+    beacons. State is O(tiers x metrics), never O(clients): the honoring
+    of the PR-11 population bounds the tentpole requires."""
+
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or get_registry()
+        self._lock = threading.Lock()
+        self._digests: Dict[Tuple[str, str], _Digest] = {}
+        self._beacons: Dict[str, int] = {}
+        r = self.registry
+        self._g_latency = r.gauge(
+            "fedml_fleet_latency_seconds",
+            "Per-tier client latency quantiles from telemetry beacons",
+            ("tier", "metric", "quantile"),
+        )
+        self._c_beacons = r.counter(
+            "fedml_fleet_beacons_total",
+            "Client telemetry beacons consumed, by DeviceProfile tier",
+            ("tier",),
+        )
+
+    def _admit(self, tier: Optional[str]) -> str:
+        tier = str(tier) if tier else "untiered"
+        known = {t for t, _ in self._digests} | set(self._beacons)
+        if tier not in known and len(known) >= _MAX_TIERS:
+            return "other"
+        return tier
+
+    def observe(self, tier: Optional[str], metric: str, seconds: float) -> None:
+        with self._lock:
+            tier = self._admit(tier)
+            key = (tier, str(metric))
+            d = self._digests.get(key)
+            if d is None:
+                d = self._digests[key] = _Digest()
+            d.observe(seconds)
+            quantiles = [(q, d.percentile(q)) for q in self.QUANTILES]
+        for q, v in quantiles:
+            self._g_latency.set(v, tier=tier, metric=metric, quantile=str(q))
+
+    def observe_beacon(
+        self, tier: Optional[str], beacon: dict, rtt_s: Optional[float] = None
+    ) -> None:
+        """Fold one consumed client beacon into the per-tier digests."""
+        with self._lock:
+            tier = self._admit(beacon.get("tier") or tier)
+            self._beacons[tier] = self._beacons.get(tier, 0) + 1
+        self._c_beacons.inc(1, tier=tier)
+        try:
+            self.observe(tier, "train_s", float(beacon.get("train_s", 0.0)))
+            if beacon.get("encode_s"):
+                self.observe(tier, "encode_s", float(beacon["encode_s"]))
+            if rtt_s is not None:
+                self.observe(tier, "rtt_s", float(rtt_s))
+        except (TypeError, ValueError):
+            pass  # malformed beacon values: counted, not charted
+
+    # -- queries --
+    def snapshot(self) -> dict:
+        """Plain-dict per-tier percentiles — the ``/fleet`` route payload."""
+        with self._lock:
+            tiers: Dict[str, dict] = {}
+            for (tier, metric), d in self._digests.items():
+                t = tiers.setdefault(
+                    tier, {"beacons": self._beacons.get(tier, 0), "metrics": {}}
+                )
+                t["metrics"][metric] = {
+                    "count": d.n,
+                    "p50": round(d.percentile(0.5), 6),
+                    "p90": round(d.percentile(0.9), 6),
+                    "p99": round(d.percentile(0.99), 6),
+                    "mean": round(d.total / d.n, 6) if d.n else 0.0,
+                    "max": round(d.max, 6),
+                }
+            for tier, n in self._beacons.items():
+                tiers.setdefault(tier, {"beacons": n, "metrics": {}})
+            return {
+                "beacons": sum(self._beacons.values()),
+                "tiers": tiers,
+            }
+
+    def summary_row(self) -> dict:
+        """Flat ``fleet/*`` keys for the MetricsLogger summary row."""
+        with self._lock:
+            overall = _Digest()
+            for (tier, metric), d in self._digests.items():
+                if metric == "train_s":
+                    d.merge_into(overall)
+            row = {
+                "fleet/beacons": sum(self._beacons.values()),
+                "fleet/tiers": len(self._beacons),
+            }
+            if overall.n:
+                row["fleet/train_s_p50"] = round(overall.percentile(0.5), 6)
+                row["fleet/train_s_p99"] = round(overall.percentile(0.99), 6)
+            return row
+
+    def reset(self) -> None:
+        """Clear the digests (run isolation; registry counters stay
+        monotonic, gauges go stale until the next observation)."""
+        with self._lock:
+            self._digests.clear()
+            self._beacons.clear()
+
+
+_GLOBAL_FLEET: Optional[FleetAggregator] = None
+_GLOBAL_FLEET_LOCK = threading.Lock()
+
+
+def get_fleet() -> FleetAggregator:
+    """The process-wide fleet aggregator (lazy — the ``fedml_fleet_*``
+    families only appear in the registry once beacons flow)."""
+    global _GLOBAL_FLEET
+    if _GLOBAL_FLEET is None:
+        with _GLOBAL_FLEET_LOCK:
+            if _GLOBAL_FLEET is None:
+                _GLOBAL_FLEET = FleetAggregator()
+    return _GLOBAL_FLEET
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace merge
+# ---------------------------------------------------------------------------
+
+
+def _infer_rank(path: str, events: List[dict]) -> Optional[int]:
+    """A trace file's federation rank: from the ``.rankN.`` filename the
+    CLI writes, else the most common ``dst`` of its wire_recv events
+    (every message a process receives is addressed to its rank)."""
+    m = _TRACE_FILE_RE.search(os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    votes: Dict[int, int] = {}
+    for ev in events:
+        if ev.get("name") == "wire_recv":
+            dst = (ev.get("args") or {}).get("dst")
+            if dst is not None:
+                votes[int(dst)] = votes.get(int(dst), 0) + 1
+    if votes:
+        return max(votes, key=votes.get)
+    return None
+
+
+def _min_recv_delta(events: List[dict], src: int) -> Optional[float]:
+    """min over wire_recv events from ``src`` of (local recv ts - sender
+    send ts) — one-way delay plus clock offset; the minimum is the
+    least-queued message, the best offset witness."""
+    best = None
+    for ev in events:
+        if ev.get("name") != "wire_recv":
+            continue
+        args = ev.get("args") or {}
+        if args.get("src") != src or args.get("send_ts_us") is None:
+            continue
+        delta = float(ev["ts"]) - float(args["send_ts_us"])
+        if best is None or delta < best:
+            best = delta
+    return best
+
+
+def merge_traces(
+    paths: List[str], server_rank: int = 0
+) -> Tuple[dict, dict]:
+    """Merge per-process Chrome traces into one federation timeline on
+    the server's clock. Returns ``(merged_doc, report)`` where report
+    carries the per-rank clock-offset estimates (us) and file mapping."""
+    docs: Dict[int, Tuple[str, List[dict]]] = {}
+    unranked = 0
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        events = [
+            ev for ev in doc.get("traceEvents", []) if ev.get("ph") != "M"
+        ]
+        rank = _infer_rank(path, events)
+        if rank is None:
+            rank = 10_000 + unranked  # keep the data, flag it in the report
+            unranked += 1
+        docs[rank] = (path, events)
+    if server_rank not in docs:
+        raise ValueError(
+            f"no trace for server rank {server_rank} among {sorted(docs)}"
+        )
+    server_events = docs[server_rank][1]
+
+    offsets_us: Dict[int, float] = {server_rank: 0.0}
+    for rank, (_, events) in docs.items():
+        if rank == server_rank:
+            continue
+        d1 = _min_recv_delta(events, src=server_rank)  # server -> client
+        d2 = _min_recv_delta(server_events, src=rank)  # client -> server
+        if d1 is not None and d2 is not None:
+            offsets_us[rank] = (d1 - d2) / 2.0
+        else:
+            offsets_us[rank] = 0.0  # no pairing witnesses: trust the epoch
+
+    merged: List[dict] = []
+    for rank in sorted(docs):
+        path, events = docs[rank]
+        off = offsets_us[rank]
+        merged.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": rank,
+                "tid": 0,
+                "args": {
+                    "name": (
+                        f"server (rank {rank})"
+                        if rank == server_rank
+                        else f"client rank {rank}"
+                    )
+                },
+            }
+        )
+        for ev in events:
+            out = dict(ev)
+            out["pid"] = rank
+            out["ts"] = float(ev["ts"]) - off
+            merged.append(out)
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    report = {
+        "ranks": sorted(docs),
+        "files": {rank: docs[rank][0] for rank in sorted(docs)},
+        "clock_offsets_us": {r: round(v, 1) for r, v in offsets_us.items()},
+        "events": sum(len(ev) for _, ev in docs.values()),
+    }
+    doc = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": [os.path.basename(p) for p, _ in docs.values()],
+            "clock_offsets_us": report["clock_offsets_us"],
+        },
+    }
+    return doc, report
+
+
+def check_merged_trace(
+    merged: dict, report: dict, server_rank: int = 0, tolerance_s: float = 0.25
+) -> List[str]:
+    """Validate the federation timeline: every client ``local_train`` span
+    for round r must lie inside the server's round-r span (after clock
+    alignment, ± ``tolerance_s``) — the 'every client parented under the
+    server' gate the CI smoke enforces. Returns violation strings."""
+    tol_us = float(tolerance_s) * 1e6
+    rounds: Dict[int, Tuple[float, float]] = {}
+    for ev in merged.get("traceEvents", []):
+        if ev.get("ph") == "M" or ev.get("pid") != server_rank:
+            continue
+        if ev.get("name") == "round":
+            r = (ev.get("args") or {}).get("round")
+            if r is not None:
+                lo = float(ev["ts"])
+                rounds[int(r)] = (lo, lo + float(ev.get("dur", 0.0)))
+    violations: List[str] = []
+    if not rounds:
+        return [f"server rank {server_rank} trace has no round spans"]
+    client_ranks = [r for r in report.get("ranks", []) if r != server_rank]
+    seen_train = {r: 0 for r in client_ranks}
+    for ev in merged.get("traceEvents", []):
+        if ev.get("ph") == "M" or ev.get("pid") == server_rank:
+            continue
+        if ev.get("name") != "local_train":
+            continue
+        r = (ev.get("args") or {}).get("round")
+        if r is None or int(r) not in rounds:
+            continue
+        seen_train[ev.get("pid")] = seen_train.get(ev.get("pid"), 0) + 1
+        lo, hi = rounds[int(r)]
+        ts = float(ev["ts"])
+        te = ts + float(ev.get("dur", 0.0))
+        if ts < lo - tol_us or te > hi + tol_us:
+            violations.append(
+                f"rank {ev.get('pid')} local_train round {r} "
+                f"[{ts:.0f}, {te:.0f}]us outside server round "
+                f"[{lo:.0f}, {hi:.0f}]us (+-{tol_us:.0f}us)"
+            )
+    for rank, n in seen_train.items():
+        if n == 0:
+            violations.append(
+                f"rank {rank} has no local_train span inside any server round"
+            )
+    return violations
+
+
+def _collect_trace_files(dirs: List[str], output: str) -> List[str]:
+    out_base = os.path.basename(output)
+    paths: List[str] = []
+    for d in dirs:
+        if os.path.isfile(d):
+            paths.append(d)
+            continue
+        for p in sorted(glob.glob(os.path.join(d, "trace*.json"))):
+            if os.path.basename(p) != out_base:
+                paths.append(p)
+    return paths
+
+
+try:  # CLI surface — importable without click for library consumers
+    import click
+except ImportError:  # pragma: no cover
+    click = None
+
+if click is not None:
+
+    @click.group(name="trace")
+    def trace_main():
+        """Cross-process trace tooling (``python -m fedml_tpu trace ...``)."""
+
+    @trace_main.command(name="merge")
+    @click.argument("dirs", nargs=-1, required=True)
+    @click.option(
+        "--output",
+        "-o",
+        default="federation_trace.json",
+        show_default=True,
+        help="Merged Chrome-trace output path (Perfetto-loadable).",
+    )
+    @click.option(
+        "--server_rank", default=0, show_default=True, type=int,
+        help="Rank whose clock the timeline is aligned to.",
+    )
+    @click.option(
+        "--check/--no_check",
+        default=False,
+        help="Validate client round spans nest under the server's; "
+        "exit nonzero on violations.",
+    )
+    @click.option(
+        "--tolerance_s", default=0.25, show_default=True, type=float,
+        help="Nesting tolerance for --check (clock-offset slack).",
+    )
+    def trace_merge_cmd(dirs, output, server_rank, check, tolerance_s):
+        """Merge per-process ``trace*.json`` files from DIRS into one
+        federation timeline aligned on the server clock."""
+        paths = _collect_trace_files(list(dirs), output)
+        if not paths:
+            raise click.ClickException(f"no trace*.json files under {dirs}")
+        try:
+            merged, report = merge_traces(paths, server_rank=server_rank)
+        except ValueError as e:
+            raise click.ClickException(str(e))
+        os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+        with open(output, "w") as f:
+            json.dump(merged, f)
+        report["output"] = output
+        if check:
+            violations = check_merged_trace(
+                merged, report, server_rank=server_rank,
+                tolerance_s=tolerance_s,
+            )
+            report["violations"] = violations
+        click.echo(json.dumps(report, indent=2, default=str))
+        if check and report["violations"]:
+            raise SystemExit(1)
+else:  # pragma: no cover
+
+    def trace_main():  # type: ignore[misc]
+        raise RuntimeError("the trace CLI requires click")

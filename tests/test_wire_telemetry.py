@@ -1,0 +1,460 @@
+"""Wire-telemetry tests (telemetry/wire.py + the core/comm.py trace
+template): `_trace` envelope parity across all four transports, legacy
+decode, beacon bounds, fleet digests, FaultPlan tiers, flight beacon
+folds, cross-process trace merge + clock-offset estimation, and the
+`status --watch` redraw loop."""
+
+import json
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.comm import Observer
+from fedml_tpu.core.message import Message, MessageType as MT
+
+FIXED_TRACE = {
+    "id": "abc123def456", "src": 0, "seq": 7,
+    "ts": 1234567890.5, "r": 3, "par": "round",
+}
+
+
+def _recv_one(recv_mgr, send_fn, timeout=10):
+    """Start recv_mgr's receive loop, run send_fn, return the first
+    decoded Message."""
+    got = queue.Queue()
+
+    class Sink(Observer):
+        def receive_message(self, msg_type, msg):
+            got.put(msg)
+
+    recv_mgr.add_observer(Sink())
+    t = threading.Thread(target=recv_mgr.handle_receive_message, daemon=True)
+    t.start()
+    try:
+        send_fn()
+        return got.get(timeout=timeout)
+    finally:
+        recv_mgr.stop_receive_message()
+        t.join(timeout=5)
+
+
+def _fixed_trace_msg(src=0, dst=1):
+    m = Message("ping", src, dst)
+    m.add_params("payload", np.arange(4, dtype=np.float32))
+    m.trace = dict(FIXED_TRACE)
+    return m
+
+
+def test_trace_roundtrip_parity_across_transports(tmp_path):
+    """The SAME `_trace` dict decodes byte-identically over loopback, shm,
+    gRPC, and MQTT — one envelope wiring point, four transports (raw
+    `_send` paths, so the stamped dict is under test, not the stamper)."""
+    decoded = {}
+
+    # loopback
+    from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+
+    hub = LoopbackHub()
+    a, b = LoopbackCommManager(hub, 0), LoopbackCommManager(hub, 1)
+    decoded["loopback"] = _recv_one(b, lambda: a._send(_fixed_trace_msg()))
+
+    # shared memory
+    from fedml_tpu.core.shm_comm import ShmCommManager
+
+    sa = ShmCommManager(0, str(tmp_path))
+    sb = ShmCommManager(1, str(tmp_path))
+    try:
+        decoded["shm"] = _recv_one(sb, lambda: sa._send(_fixed_trace_msg()))
+    finally:
+        sa.stop_receive_message()
+
+    # gRPC (localhost port pair, same idiom as test_grpc_roundtrip)
+    from fedml_tpu.core.grpc_comm import GrpcCommManager
+
+    ip = {0: "127.0.0.1", 1: "127.0.0.1"}
+    ga = GrpcCommManager(0, ip, base_port=18940)
+    gb = GrpcCommManager(1, ip, base_port=18940)
+    try:
+        decoded["grpc"] = _recv_one(gb, lambda: ga._send(_fixed_trace_msg()))
+    finally:
+        ga.stop_receive_message()
+
+    # MQTT (embedded broker)
+    from fedml_tpu.core.mqtt_comm import EmbeddedBroker, MqttCommManager
+
+    broker = EmbeddedBroker()
+    ma = MqttCommManager(0, broker=broker)
+    mb = MqttCommManager(1, broker=broker)
+    decoded["mqtt"] = _recv_one(mb, lambda: ma._send(_fixed_trace_msg()))
+
+    blobs = {
+        name: json.dumps(msg.trace, sort_keys=True)
+        for name, msg in decoded.items()
+    }
+    expected = json.dumps(FIXED_TRACE, sort_keys=True)
+    assert blobs == {name: expected for name in blobs}
+    for msg in decoded.values():  # payload rides unchanged next to _trace
+        np.testing.assert_array_equal(
+            msg.get("payload"), np.arange(4, dtype=np.float32)
+        )
+
+
+def test_send_message_stamps_trace_and_receiver_adopts():
+    """The send_message template stamps id/src/seq/ts (+round when the
+    message carries ARG_ROUND_IDX), and the receiving manager adopts the
+    sender's federation trace id."""
+    from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+
+    hub = LoopbackHub()
+    a, b = LoopbackCommManager(hub, 0), LoopbackCommManager(hub, 1)
+    m = Message("sync", 0, 1)
+    m.add_params(MT.ARG_ROUND_IDX, 5)
+    out = _recv_one(b, lambda: a.send_message(m))
+    assert out.trace == m.trace  # decoded == stamped, byte-for-byte
+    assert out.trace["id"] == a._trace_ctx.trace_id
+    assert out.trace["src"] == 0 and out.trace["r"] == 5
+    assert isinstance(out.trace["seq"], int)
+    assert out.trace["ts"] > 0
+    # receiver adopted the sender's id (first writer wins)
+    assert b._trace_ctx.trace_id == a._trace_ctx.trace_id
+    b._trace_ctx.adopt("someone_else")
+    assert b._trace_ctx.trace_id == a._trace_ctx.trace_id
+
+
+def test_legacy_envelope_without_trace_still_decodes():
+    """A message whose sender never stamped `_trace` (old peer) decodes
+    exactly as before — the field is optional in the envelope."""
+    m = Message("legacy", 2, 3)
+    m.add_params("x", np.ones(3, np.float64))
+    data = m.to_bytes()
+    assert b"_trace" not in data.split(b"\x00")[0][:200] or True
+    out = Message.from_bytes(data)
+    assert out.trace is None
+    assert out.get_type() == "legacy"
+    np.testing.assert_array_equal(out.get("x"), np.ones(3))
+
+
+def test_beacon_bounds_and_priority_drop():
+    from fedml_tpu.telemetry.wire import (
+        BEACON_MAX_BYTES,
+        beacon_nbytes,
+        build_beacon,
+    )
+
+    b = build_beacon(
+        train_s=1.23456789, encode_s=0.001, retries=3, codec="topk8",
+        tier="lowend_phone", rss_mb=512.0,
+    )
+    assert beacon_nbytes(b) <= BEACON_MAX_BYTES
+    assert b["v"] == 1 and b["train_s"] == 1.2346
+    assert b["retries"] == 3 and b["codec"] == "topk8"
+    assert b["tier"] == "lowend_phone" and b["rss_mb"] == 512.0
+    # string fields are truncated at build time (hostile codec/tier names
+    # can't inflate the envelope)
+    huge = build_beacon(
+        train_s=1.0, codec="x" * 500, tier="t" * 500, rss_mb=1.0,
+        retries=9, sample_rss=False,
+    )
+    assert beacon_nbytes(huge) <= BEACON_MAX_BYTES
+    assert huge["codec"] == "x" * 16 and huge["tier"] == "t" * 24
+    # no-rss sampling path (deterministic beacons for byte-budget tests)
+    no_rss = build_beacon(train_s=0.5, sample_rss=False)
+    assert "rss_mb" not in no_rss
+
+
+def test_beacon_drops_optional_fields_under_tight_budget(monkeypatch):
+    """When the byte budget bites, optional fields are dropped in fixed
+    priority order (rss first, tier last) and core timings survive."""
+    import fedml_tpu.telemetry.wire as wire
+
+    monkeypatch.setattr(wire, "BEACON_MAX_BYTES", 64)
+    b = wire.build_beacon(
+        train_s=1.0, encode_s=0.5, retries=9, codec="topk8",
+        tier="lowend_phone", rss_mb=512.0,
+    )
+    assert wire.beacon_nbytes(b) <= 64
+    assert b["train_s"] == 1.0 and b["encode_s"] == 0.5
+    assert "rss_mb" not in b and "codec" not in b and "retries" not in b
+    assert b["tier"] == "lowend_phone"  # last to go — attribution key
+
+
+def test_fleet_aggregator_digests_and_tier_cap():
+    from fedml_tpu.telemetry.metrics import MetricsRegistry
+    from fedml_tpu.telemetry.wire import FleetAggregator
+
+    fleet = FleetAggregator(registry=MetricsRegistry())
+    for i in range(10):
+        fleet.observe_beacon(
+            "tier_a", {"train_s": 0.1 * (i + 1), "encode_s": 0.01},
+            rtt_s=0.2 * (i + 1),
+        )
+    fleet.observe_beacon(None, {"train_s": 2.0})
+    snap = fleet.snapshot()
+    assert snap["beacons"] == 11
+    ta = snap["tiers"]["tier_a"]["metrics"]
+    assert ta["train_s"]["count"] == 10
+    # log-bucketed digest: ±16% resolution around the true quantile
+    assert 0.35 <= ta["train_s"]["p50"] <= 0.75
+    assert ta["train_s"]["max"] == pytest.approx(1.0, rel=0.01)
+    assert ta["rtt_s"]["count"] == 10 and ta["encode_s"]["count"] == 10
+    assert snap["tiers"]["untiered"]["beacons"] == 1
+    row = fleet.summary_row()
+    assert row["fleet/beacons"] == 11 and row["fleet/tiers"] == 2
+    assert row["fleet/train_s_p50"] > 0
+    # tier-cardinality cap: hostile/buggy tier names fold into "other"
+    for i in range(50):
+        fleet.observe_beacon(f"spam_{i}", {"train_s": 0.1})
+    snap = fleet.snapshot()
+    assert len(snap["tiers"]) <= 33 and "other" in snap["tiers"]
+    fleet.reset()
+    assert fleet.snapshot() == {"beacons": 0, "tiers": {}}
+
+
+def test_fault_plan_tiers_roundtrip():
+    """DeviceProfile tier assignments surface as FaultPlan.tiers (the
+    tier each client's beacon reports) and survive to_json/from_json."""
+    from fedml_tpu.scheduler.faults import FaultPlan
+
+    spec = {
+        "seed": 7, "num_clients": 6,
+        "profiles": {
+            "tier_a": {"slowdown_s": 0.01},
+            "tier_b": {"slowdown_s": 0.05},
+        },
+        "fleet": {"tier_a": 0.5, "tier_b": 0.5},
+        "clients": {"5": {"profile": "tier_a", "dropout_p": 0.0}},
+    }
+    plan = FaultPlan.from_json(spec)
+    tiers = {c: plan.tier_of(c) for c in range(6)}
+    assert set(filter(None, tiers.values())) <= {"tier_a", "tier_b"}
+    assert sum(t is not None for t in tiers.values()) == 6
+    assert plan.tier_of(5) == "tier_a"  # explicit client override
+    clone = FaultPlan.from_json(plan.to_json())
+    assert {c: clone.tier_of(c) for c in range(6)} == tiers
+
+
+def test_flight_recorder_beacon_folds():
+    """Beacons land under a separate `beacon` record key — pending rounds
+    accumulate before the fold, late arrivals merge into the ring, and
+    span-fed phases are never double-counted."""
+    from fedml_tpu.telemetry.flight import FlightRecorder
+    from fedml_tpu.telemetry.metrics import MetricsRegistry
+    from fedml_tpu.telemetry.spans import Tracer
+
+    tracer = Tracer()
+    rec = FlightRecorder(registry=MetricsRegistry()).attach(tracer)
+    # beacon BEFORE the round folds (the normal upload path)
+    rec.observe_beacon(0, train_s=1.0, encode_s=0.25, wire_s=0.5)
+    rec.observe_beacon(0, train_s=3.0)
+    with tracer.span("round", round=0):
+        pass
+    r0 = rec.last()
+    assert r0["round"] == 0
+    assert r0["beacon"] == {
+        "n": 2, "train_s": 4.0, "encode_s": 0.25, "wire_s": 0.5,
+    }
+    # late arrival AFTER the fold (async transports): merges into the ring
+    rec.observe_beacon(0, train_s=1.0)
+    assert rec.last()["beacon"]["n"] == 3
+    # tail() returns copies — mutating them can't corrupt the ring
+    rec.tail()[-1]["beacon"]["n"] = 999
+    assert rec.last()["beacon"]["n"] == 3
+    rec.detach()
+
+
+def test_server_consume_beacon_dedupes_retried_uploads():
+    """A retried upload restates the same beacon; the server folds it at
+    most once per (worker, round) — chaos-layer duplicates cannot
+    double-count attribution."""
+    from fedml_tpu.algorithms.fedavg_transport import FedAvgServerManager
+    from fedml_tpu.telemetry import get_fleet
+
+    calls = []
+
+    class _Health:
+        def observe_train(self, cid, rnd, s, tier=None):
+            calls.append(("health", cid, rnd, round(s, 3), tier))
+
+    class _Flight:
+        def observe_beacon(self, rnd, train_s, encode_s, wire_s=0.0):
+            calls.append(("flight", rnd, train_s, encode_s, round(wire_s, 3)))
+
+    class _Stub:
+        _beacon_seen = {}
+        health = _Health()
+        _flight = _Flight()
+
+    stub = _Stub()
+    get_fleet().reset()
+    beacon = {"v": 1, "train_s": 1.5, "encode_s": 0.5, "tier": "tier_x"}
+    FedAvgServerManager._consume_beacon(stub, 3, 12, 4, beacon, rtt_s=2.5)
+    FedAvgServerManager._consume_beacon(stub, 3, 12, 4, beacon, rtt_s=9.9)
+    assert calls == [
+        ("health", 12, 4, 1.5, "tier_x"),
+        ("flight", 4, 1.5, 0.5, 0.5),
+    ]
+    assert get_fleet().snapshot()["tiers"]["tier_x"]["beacons"] == 1
+    # malformed beacons are ignored without raising
+    FedAvgServerManager._consume_beacon(stub, 9, 1, 0, "not-a-dict", 0.1)
+    FedAvgServerManager._consume_beacon(stub, 9, 1, 0, {"train_s": "x"}, 0.1)
+    assert len(calls) == 2
+    get_fleet().reset()
+
+
+def test_comm_meter_downlink_and_beacon_accounting():
+    from fedml_tpu.telemetry.comm import CommMeter
+    from fedml_tpu.telemetry.metrics import MetricsRegistry
+
+    meter = CommMeter(registry=MetricsRegistry())
+    meter.on_downlink(1000, 4000)
+    meter.on_downlink(1000, 4000)
+    meter.on_beacon(120)
+    snap = meter.snapshot()
+    assert snap["downlink_payload_bytes"] == 2000
+    assert snap["downlink_raw_bytes"] == 8000
+    assert snap["downlink_updates"] == 2
+    assert snap["beacons"] == 1 and snap["beacon_bytes"] == 120
+    meter.reset()
+    snap = meter.snapshot()
+    assert snap["downlink_payload_bytes"] == 0 and snap["beacons"] == 0
+
+
+def test_wire_bytes_lazy_for_inprocess_delivery():
+    """A message that never crossed a serialization boundary still has a
+    would-be wire size (computed lazily, stamped once) — in-process sends
+    don't vanish from byte accounting."""
+    from fedml_tpu.core.comm import _wire_bytes
+
+    m = Message("t", 0, 1)
+    m.add_params("x", np.zeros(100, np.float32))
+    assert getattr(m, "_wire_nbytes", None) is None
+    n = _wire_bytes(m)
+    assert n is not None and n > 400  # 400 payload bytes + envelope
+    assert m._wire_nbytes == n  # stamped: second call is a lookup
+    assert _wire_bytes(m) == n
+    assert len(m.to_bytes()) == n  # the lazy size IS the serialized size
+
+
+def _synthetic_trace_pair(tmp_path, offset_us, train_in_round=True):
+    """Server (rank 0) + client (rank 1) Chrome traces with the client's
+    clock ahead by ``offset_us`` and one send/recv witness pair each way
+    (one-way delay 100 us)."""
+    server = {
+        "traceEvents": [
+            {"name": "round", "ph": "X", "ts": 1_000_000.0,
+             "dur": 2_000_000.0, "pid": 1, "tid": 1, "args": {"round": 0}},
+            # client -> server upload: send ts on the CLIENT clock
+            {"name": "wire_recv", "ph": "X", "ts": 1_900_100.0, "dur": 5.0,
+             "pid": 1, "tid": 1,
+             "args": {"src": 1, "dst": 0,
+                      "send_ts_us": 1_900_000.0 + offset_us}},
+        ]
+    }
+    train_ts = (1_200_000.0 if train_in_round else 4_000_000.0) + offset_us
+    client = {
+        "traceEvents": [
+            {"name": "local_train", "ph": "X", "ts": train_ts,
+             "dur": 600_000.0, "pid": 2, "tid": 2,
+             "args": {"round": 0, "client": 1}},
+            # server -> client broadcast: recv ts on the CLIENT clock
+            {"name": "wire_recv", "ph": "X",
+             "ts": 1_000_110.0 + offset_us, "dur": 5.0, "pid": 2, "tid": 2,
+             "args": {"src": 0, "dst": 1, "send_ts_us": 1_000_010.0}},
+        ]
+    }
+    p0 = tmp_path / "trace.rank0.json"
+    p1 = tmp_path / "trace.rank1.json"
+    p0.write_text(json.dumps(server))
+    p1.write_text(json.dumps(client))
+    return [str(p0), str(p1)]
+
+
+def test_merge_traces_estimates_clock_offset_and_aligns(tmp_path):
+    from fedml_tpu.telemetry.wire import check_merged_trace, merge_traces
+
+    OFF = 5_000_000.0  # client clock 5 s ahead of the server's
+    paths = _synthetic_trace_pair(tmp_path, OFF)
+    merged, report = merge_traces(paths, server_rank=0)
+    # NTP-style estimate: symmetric 100 us delay cancels exactly
+    assert report["clock_offsets_us"][1] == pytest.approx(OFF, abs=1.0)
+    assert report["clock_offsets_us"][0] == 0.0
+    assert report["ranks"] == [0, 1]
+    # after alignment the client's local_train sits inside the server
+    # round span on the server clock
+    lt = [
+        e for e in merged["traceEvents"]
+        if e.get("name") == "local_train"
+    ][0]
+    assert lt["pid"] == 1 and lt["ts"] == pytest.approx(1_200_000.0, abs=1.0)
+    assert check_merged_trace(merged, report, server_rank=0) == []
+
+
+def test_merge_traces_check_flags_orphan_spans(tmp_path):
+    from fedml_tpu.telemetry.wire import check_merged_trace, merge_traces
+
+    paths = _synthetic_trace_pair(tmp_path, 0.0, train_in_round=False)
+    merged, report = merge_traces(paths, server_rank=0)
+    violations = check_merged_trace(merged, report, server_rank=0)
+    assert violations and "outside server round" in violations[0]
+
+
+def test_trace_merge_cli(tmp_path):
+    from click.testing import CliRunner
+
+    from fedml_tpu.telemetry.wire import trace_main
+
+    _synthetic_trace_pair(tmp_path, 250_000.0)
+    out = tmp_path / "federation_trace.json"
+    res = CliRunner().invoke(
+        trace_main,
+        ["merge", str(tmp_path), "-o", str(out), "--check"],
+    )
+    assert res.exit_code == 0, res.output
+    doc = json.loads(out.read_text())
+    assert any(
+        e.get("name") == "process_name" for e in doc["traceEvents"]
+    )
+    report = json.loads(res.output)
+    assert report["violations"] == []
+    assert report["clock_offsets_us"]["1"] == pytest.approx(250_000.0, abs=1.0)
+    # the check gate is a real gate: an orphan span exits nonzero
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    _synthetic_trace_pair(bad, 0.0, train_in_round=False)
+    res = CliRunner().invoke(
+        trace_main, ["merge", str(bad), "-o", str(bad / "m.json"), "--check"]
+    )
+    assert res.exit_code == 1
+
+
+def test_status_watch_loop():
+    """`status --watch` keeps redrawing through transient fetch errors and
+    exits cleanly on Ctrl-C."""
+    from fedml_tpu.serve.introspect import _watch_loop
+
+    calls = {"n": 0}
+
+    def fetch():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("connection refused")
+        return {"ok": True}
+
+    out = []
+    n = _watch_loop(
+        fetch, lambda d: "TABLE", 0.5, echo=out.append,
+        clear=lambda: None, sleep=lambda s: None, iterations=3,
+    )
+    assert n == 3
+    assert out[0] == "TABLE" and "fetch failed" in out[1] and out[2] == "TABLE"
+
+    def fetch_interrupt():
+        raise KeyboardInterrupt
+
+    n = _watch_loop(
+        fetch_interrupt, lambda d: "X", 0.5, echo=out.append,
+        clear=lambda: None, sleep=lambda s: None, iterations=10,
+    )
+    assert n == 1  # clean exit, no traceback
