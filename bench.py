@@ -216,7 +216,7 @@ def _throughput_row(api, warmup: int, timed: int, label: str,
 
 
 def _north_star_api(compute_dtype="float32", comm_round=1, fused_rounds=1,
-                    fused_plan="static"):
+                    fused_plan="static", pipeline="auto"):
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.config import DataConfig, FedConfig, RunConfig, TrainConfig
     from fedml_tpu.data.femnist_synth import femnist_synthetic
@@ -231,6 +231,7 @@ def _north_star_api(compute_dtype="float32", comm_round=1, fused_rounds=1,
             epochs=1,
             fused_rounds=fused_rounds,
             fused_plan=fused_plan,
+            pipeline=pipeline,
             frequency_of_the_test=10_000,
         ),
         train=TrainConfig(
@@ -351,6 +352,66 @@ def _fused_vs_eager(total=32, chunk=8, repeats=2):
         "timed_via": (
             f"production train() loop, interleaved best of {repeats}; "
             "planner decision from a separate fused_plan=measured run"
+        ),
+    }
+
+
+def _pipeline_rounds(total=32, repeats=2):
+    """ISSUE 17 row: the round pipeline — host prepares round r+1
+    (cohort selection, batch gather, placement) while round r's program
+    runs on device, committing at the boundary — vs --pipeline off, both
+    through the production train() loop (interleaved best-of, like
+    _trainloop_rows). Measured overlap comes off a private flight
+    recorder's folded records, and byte parity of the final train loss
+    is recorded alongside the rates (tests/test_pipeline.py pins the
+    full-tree parity; this row is the throughput record)."""
+    from fedml_tpu.telemetry import get_tracer
+    from fedml_tpu.telemetry.flight import FlightRecorder
+
+    apis = {
+        "serial": _north_star_api(
+            "float32", comm_round=total, pipeline="off"
+        ),
+        "pipelined": _north_star_api(
+            "float32", comm_round=total, pipeline="on"
+        ),
+    }
+    best = {}
+    for name, api in apis.items():  # warm: compile outside the timing
+        api.train()
+        best[name] = float("inf")
+    flight = FlightRecorder(
+        max_rounds=2 * repeats * total, budget_bytes=1 << 20
+    ).attach(get_tracer())
+    try:
+        for _ in range(repeats):
+            for name, api in apis.items():
+                _reset(api)
+                t0 = time.perf_counter()
+                api.train()
+                best[name] = min(
+                    best[name], (time.perf_counter() - t0) / total
+                )
+    finally:
+        flight.detach()
+    serial_rps = round(1.0 / best["serial"], 4)
+    pipe_rps = round(1.0 / best["pipelined"], 4)
+    frow = flight.summary_row()
+    loss = {n: api.history[-1]["Train/Loss"] for n, api in apis.items()}
+    return {
+        "label": "pipeline",
+        "compute_dtype": "float32",
+        # the pipelined rate IS the row's r/s (pipeline=auto is the
+        # production default on this config) — what --compare tracks
+        "rounds_per_sec": pipe_rps,
+        "serial_rounds_per_sec": serial_rps,
+        "pipelined_over_serial": round(pipe_rps / serial_rps, 3),
+        "pipeline_rounds": int(apis["pipelined"].pipeline_rounds),
+        "overlap_s": frow.get("flight/overlap_s", 0.0),
+        "pipelined_rounds_folded": frow.get("flight/pipelined_rounds", 0),
+        "numerics_identical": loss["serial"] == loss["pipelined"],
+        "timed_via": (
+            f"production train() loop, interleaved best of {repeats}"
         ),
     }
 
@@ -1495,7 +1556,7 @@ class _Emitter:
         "bf16_cross_silo_resnet56", "flash_attention_s8192",
         "mxu_validation", "scale_100k_clients", "scale_100k_stateful",
         "scale_1m", "fedbuff_async", "process_cold_start",
-        "fused_vs_eager", "uplink_bytes",
+        "fused_vs_eager", "pipeline", "uplink_bytes",
     )
 
     def __init__(self, t0: float, detail_path: str,
@@ -2132,6 +2193,9 @@ def main():
     def s_uplink():
         emitter.update({"uplink_bytes": _uplink_bytes_rows()})
 
+    def s_pipeline():
+        emitter.update({"pipeline": _pipeline_rounds()})
+
     if tiny:
         # CI mode (tests/test_bench_resilience.py): a fast real section,
         # then a sleeper the kill-test murders mid-flight. Proves the
@@ -2191,6 +2255,7 @@ def main():
             ("femnist_lda", s_femnist_lda, 170, 500),
             ("trainloop", s_trainloop, 125, 300),
             ("fused_vs_eager", s_fused_vs_eager, 150, 420),
+            ("pipeline", s_pipeline, 60, 300),
             ("uplink_bytes", s_uplink, 40, 240),
             ("fedbuff_async", s_fedbuff, 60, 240),
             ("process_cold_start", s_cold_start, 80, 420),

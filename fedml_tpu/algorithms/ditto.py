@@ -546,14 +546,15 @@ class DittoAPI(FedAvgAPI):
 
     def train_round(self, round_idx: int):
         sampled, _steps, _bs = self._round_plan(round_idx)
-        batch = self._round_batch(sampled, round_idx)
-        rng = jax.random.fold_in(self.rng, round_idx + 1)
+        # batch via the shared warmup/pipeline stash contract (see
+        # fedavg._round_placed — byte-identical to building it here)
+        placed = self._round_placed(round_idx, sampled)
         if self._state_mode == "device":
             self.global_vars, self.v_stack, metrics = self._ditto_round(
                 self.global_vars,
                 self.v_stack,
                 self._place_client_indices(sampled),
-                *self._place_batch(batch, rng),
+                *placed,
             )
             return sampled, metrics
         # NOTE: this take/launch/device_get/scatter choreography is the
@@ -566,7 +567,7 @@ class DittoAPI(FedAvgAPI):
         self.global_vars, new_rows, metrics = self._ditto_round(
             self.global_vars,
             v_rows,
-            *self._place_batch(batch, rng),
+            *placed,
         )
         # overlap the next cohort's disk gather with this round's device
         # compute; rows scattered below are excluded (no torn reads)

@@ -472,6 +472,13 @@ class FedAvgAPI:
     # whose round programs don't emit the vectors (mesh shard_map) fall
     # back to the cohort-mean signal.
     _client_loss_vectors = True
+    # Round pipeline (FedConfig.pipeline): subclasses whose train_round
+    # bypasses the _round_placed stash contract (hierarchical group
+    # loops) or whose _place_batch is not a pure function of
+    # (round, config.seed, rng) (the backdoor attack mask reads
+    # _current_round) set this False — preparing round r+1 during round
+    # r would leak stashes or bake stale state into the batch.
+    _supports_pipeline = True
 
     def __init__(
         self,
@@ -541,9 +548,26 @@ class FedAvgAPI:
         # overwrite them with the cohort mean
         self._client_loss_rounds: set = set()
         # round -> placed device batch, populated by the AOT warmup path
-        # and consumed (popped) by train_round so warmup's signature
-        # derivation doesn't double the round-0 stack + H2D cost
+        # AND by the round pipeline (_pipeline_prepare) and consumed
+        # (popped) by train_round so neither pays the round's stack + H2D
+        # cost twice. The stash is the pipeline's COMMIT POINT: values are
+        # pure in (round, config.seed, self.rng), so a stashed batch is
+        # byte-identical to the one the serial schedule would build at the
+        # round boundary.
         self._warm_placed: dict = {}
+        # Round pipeline (FedConfig.pipeline): after round r's async
+        # dispatch, the host prepares round r+1's cohort/batch/placement
+        # while the device still executes r. _pipeline_overlap holds the
+        # measured host seconds hidden per prepared round (attached to
+        # that round's span as overlap_s → flight records);
+        # pipeline_rounds counts rounds the pipeline prepared ahead.
+        if config.fed.pipeline not in ("off", "auto", "on"):
+            raise ValueError(
+                "FedConfig.pipeline must be 'off', 'auto' or 'on'; got "
+                f"{config.fed.pipeline!r}"
+            )
+        self._pipeline_overlap: dict = {}
+        self.pipeline_rounds = 0
         # (start_round, n_rounds) -> (fn, rest): same contract for the
         # fused path — the chunk's gather-index/mask stacking and H2D
         # transfer is paid once at warmup, not again at dispatch. Valid
@@ -651,16 +675,13 @@ class FedAvgAPI:
         with self._tracer.span(
             "broadcast", round=round_idx, clients=len(sampled)
         ):
-            # the AOT warmup path already stacked + placed this round's
-            # batch to derive its lowering signature — consume it instead
-            # of paying the host stack + H2D transfer twice (the inputs
-            # are pure functions of (round, rng), so the values are
-            # identical either way)
-            placed = self._warm_placed.pop(round_idx, None)
-            if placed is None:
-                batch = self._round_batch(sampled, round_idx)
-                rng = jax.random.fold_in(self.rng, round_idx + 1)
-                placed = self._place_batch(batch, rng)
+            # the AOT warmup path (or the round pipeline, which prepared
+            # this round while the previous one executed) already stacked
+            # + placed this round's batch — consume it instead of paying
+            # the host stack + H2D transfer twice (the inputs are pure
+            # functions of (round, rng), so the values are identical
+            # either way)
+            placed = self._round_placed(round_idx, sampled)
         kw = {}
         if getattr(self.round_fn, "supports_may_pad", False):
             kw["may_pad"] = self._round_may_pad(round_idx)
@@ -681,6 +702,69 @@ class FedAvgAPI:
         ):
             self._report_client_losses(sampled, metrics, round_idx)
         return sampled, metrics
+
+    def _round_placed(self, round_idx: int, sampled):
+        """This round's placed device batch: the warmup/pipeline stash
+        when one exists (byte-identical by the determinism contract —
+        every input is pure in (round, config.seed, self.rng), and
+        self.rng is never reassigned after __init__), else built now.
+        Shared by FedAvg's train_round and the stateful subclasses
+        (SCAFFOLD/Ditto), so the pipeline serves all of them."""
+        placed = self._warm_placed.pop(round_idx, None)
+        if placed is not None:
+            return placed
+        batch = self._round_batch(sampled, round_idx)
+        rng = jax.random.fold_in(self.rng, round_idx + 1)
+        return self._place_batch(batch, rng)
+
+    def _pipeline_prepare(self, next_round: int) -> None:
+        """The round pipeline's host stage: while the JUST-DISPATCHED
+        round still executes on device (async dispatch), select round
+        ``next_round``'s cohort, gather/stack its batch, and issue its
+        H2D placement, stashing the result under the ``_warm_placed``
+        commit contract. Degrades to serial (returns without stashing)
+        whenever preparing ahead could change what the serial schedule
+        would do:
+
+        - pipeline "off";
+        - adaptive selection (power_of_choice / straggler_aware feed on
+          round r's losses/straggler flags before selecting r+1);
+        - an active fault plan with participation faults (cohorts shrink
+          per round; fault accounting must describe executed rounds);
+        - the next segment runs as a fused chunk (it amortizes dispatch
+          on device and stacks its own inputs);
+        - a planner probe round (its fold must measure the serial
+          schedule cost — round_planner.py).
+
+        The measured host seconds land in ``_pipeline_overlap`` and ride
+        the next round's span as ``overlap_s`` (flight records)."""
+        cfg = self.config
+        if (
+            cfg.fed.pipeline == "off"
+            or not self._supports_pipeline
+            or next_round >= cfg.fed.comm_round
+        ):
+            return
+        if next_round in self._warm_placed:
+            return  # warmup already stashed it
+        if cfg.fed.selection in ("power_of_choice", "straggler_aware"):
+            return
+        if (
+            self.faults is not None
+            and self.faults.plan.has_participation_faults()
+        ):
+            return
+        if self._fused_chunk_len(next_round) != 1:
+            return
+        if self.planner is not None and self.planner.wants_sync(next_round):
+            return
+        t0 = time.perf_counter()
+        sampled, _steps, _bs = self._round_plan(next_round)
+        batch = self._round_batch(sampled, next_round)
+        rng = jax.random.fold_in(self.rng, next_round + 1)
+        self._warm_placed[next_round] = self._place_batch(batch, rng)
+        self._pipeline_overlap[next_round] = time.perf_counter() - t0
+        self.pipeline_rounds += 1
 
     def _report_client_losses(self, sampled, metrics, round_idx: int):
         """Feed the scheduler TRUE per-client losses from the round's
@@ -1229,7 +1313,17 @@ class FedAvgAPI:
                 first_round, last_round = round_idx, round_idx + L - 1
                 round_idx += L
             else:
-                with self._tracer.span("round", round=round_idx):
+                # a round the pipeline prepared carries its measured
+                # hidden-host-time as span attrs — the flight recorder
+                # folds them into the round record (overlap_s), keeping
+                # the phase accounting honest under overlap: this span's
+                # broadcast phase is ~0 BECAUSE overlap_s was spent
+                # during the previous round's device execution
+                attrs = {}
+                ov = self._pipeline_overlap.pop(round_idx, None)
+                if ov is not None:
+                    attrs = {"overlap_s": round(ov, 6), "pipeline_depth": 1}
+                with self._tracer.span("round", round=round_idx, **attrs):
                     _, metrics = self.train_round(round_idx)
                     if probe:
                         jax.block_until_ready(self.global_vars)
@@ -1239,6 +1333,14 @@ class FedAvgAPI:
                 )
                 first_round = last_round = round_idx
                 round_idx += 1
+            # round pipeline: the dispatched rounds are still executing on
+            # device (async dispatch; probe segments already synced inside
+            # their span) — prepare the NEXT round's cohort/batch/placement
+            # now, so its broadcast phase is host time the device never
+            # waits for. Commit point: the _warm_placed stash popped at the
+            # round boundary; _pipeline_prepare degrades to serial for
+            # adaptive policies, fault plans, fused chunks and probe rounds.
+            self._pipeline_prepare(round_idx)
             # health: the cohort trained as one program — every sampled
             # client shares the round's wall time; participation/last-seen
             # are exact per client (_round_plan is memoized, so this costs

@@ -231,6 +231,21 @@ class FedAvgServerManager(ServerManager):
                 f"client_num_per_round ({config.fed.client_num_per_round}): "
                 "clients derive the mask registry from the latter"
             )
+        # downlink quantization (CommConfig.downlink_compression): int8
+        # only — the top-k family zeroes model coordinates outright, which
+        # is a delta codec's trick, not a model broadcast's
+        dl = config.comm.downlink_compression
+        if dl not in ("none", "int8"):
+            raise ValueError(
+                f"downlink_compression supports 'none' or 'int8'; got {dl!r}"
+            )
+        if config.comm.secure_agg and dl != "none":
+            # masked uploads are field vectors over the EXACT broadcast
+            # reference; requantizing the reference each round would put
+            # the two wire ends in different fields
+            raise ValueError(
+                "secure_agg and downlink_compression are mutually exclusive"
+            )
         self._masked_uploads: Dict[int, np.ndarray] = {}
         self._masked_ns: Dict[int, float] = {}
         # client-held-key exchange state (secagg/secure_aggregation.py
@@ -414,16 +429,52 @@ class FedAvgServerManager(ServerManager):
         sampled = self.scheduler.select(r, k=self.worker_num)
         self._round_span = self._tracer.start_span("round", round=r)
         with self._tracer.span("broadcast", round=r):
-            shipped, raw = _model_wire_cost(self.global_vars)
-            for worker, client_idx in enumerate(sampled, start=1):
-                msg = Message(MT.S2C_INIT_CONFIG, 0, worker)
-                msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
-                msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
-                msg.add_params(MT.ARG_ROUND_IDX, r)
-                self._assigned[worker] = (int(client_idx), time.monotonic())
-                if self._broadcast(msg):
-                    get_comm_meter().on_downlink(shipped, raw)
+            self._broadcast_round(MT.S2C_INIT_CONFIG, r, sampled)
         self._arm_deadline()
+
+    def _broadcast_round(self, msg_type: str, round_idx: int, sampled):
+        """Ship the round's model to the sampled cohort, encoding the
+        payload ONCE per round instead of once per worker.
+
+        The model tree is host-materialised contiguous up front, so every
+        worker's Message references the SAME buffers and the envelope's
+        per-param ``ascontiguousarray`` is a no-op — K workers cost one
+        model copy, not K (the wire cost is computed once too). With
+        ``CommConfig.downlink_compression`` the tree is int8-quantized
+        once and the DEQUANTIZED tree becomes the round's reference model
+        (``self.global_vars``): clients train from exactly it, compressed
+        uplink deltas decode against exactly it, and the next pseudo-
+        gradient is measured from exactly it — both wire ends agree
+        byte-for-byte on the round's starting point."""
+        host = jax.tree_util.tree_map(
+            lambda a: np.ascontiguousarray(np.asarray(a)), self.global_vars
+        )
+        dl = self.config.comm.downlink_compression
+        payload = None
+        if dl != "none":
+            from fedml_tpu.core import compression as CZ
+
+            payload = CZ.encode_delta(host, dl, self.config.comm.topk_frac)
+            self.global_vars = CZ.decode_delta(payload, host, dl)
+            shipped = CZ.payload_bytes(payload)
+            raw = 4 * sum(
+                int(np.size(a)) for a in jax.tree_util.tree_leaves(host)
+            )
+        else:
+            self.global_vars = host
+            shipped, raw = _model_wire_cost(host)
+        for worker, client_idx in enumerate(sampled, start=1):
+            msg = Message(msg_type, 0, worker)
+            if payload is not None:
+                msg.add_params(MT.ARG_MODEL_QUANT, payload)
+                msg.add_params(MT.ARG_MODEL_CODEC, dl)
+            else:
+                msg.add_params(MT.ARG_MODEL_PARAMS, host)
+            msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
+            msg.add_params(MT.ARG_ROUND_IDX, round_idx)
+            self._assigned[worker] = (int(client_idx), time.monotonic())
+            if self._broadcast(msg):
+                get_comm_meter().on_downlink(shipped, raw)
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
@@ -880,15 +931,7 @@ class FedAvgServerManager(ServerManager):
         sampled = self.scheduler.select(self.round_idx, k=self.worker_num)
         self._round_span = self._tracer.start_span("round", round=self.round_idx)
         with self._tracer.span("broadcast", round=self.round_idx):
-            shipped, raw = _model_wire_cost(self.global_vars)
-            for worker, client_idx in enumerate(sampled, start=1):
-                msg = Message(MT.S2C_SYNC_MODEL, 0, worker)
-                msg.add_params(MT.ARG_MODEL_PARAMS, self.global_vars)
-                msg.add_params(MT.ARG_CLIENT_INDEX, int(client_idx))
-                msg.add_params(MT.ARG_ROUND_IDX, self.round_idx)
-                self._assigned[worker] = (int(client_idx), time.monotonic())
-                if self._broadcast(msg):
-                    get_comm_meter().on_downlink(shipped, raw)
+            self._broadcast_round(MT.S2C_SYNC_MODEL, self.round_idx, sampled)
         self._arm_deadline()
 
 
@@ -930,6 +973,9 @@ class FedAvgClientManager(ClientManager):
         self._secagg_party = None
         self._secagg_round = -1
         self._secagg_pending = None
+        # quantized-downlink decode template (shapes/treedef only; leaf
+        # VALUES are never read) — built lazily on the first quantized sync
+        self._downlink_template = None
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MT.S2C_INIT_CONFIG, self._on_sync)
@@ -975,6 +1021,26 @@ class FedAvgClientManager(ClientManager):
         self.trainer.update_dataset(msg.get(MT.ARG_CLIENT_INDEX))
         round_idx = msg.get(MT.ARG_ROUND_IDX)
         w_round = msg.get(MT.ARG_MODEL_PARAMS)
+        if w_round is None:
+            # quantized downlink: rebuild the broadcast model from the
+            # codec-tagged payload. The decode template only supplies leaf
+            # shapes and the treedef, so a fresh model.init works — the
+            # decoded tree is byte-identical to the dequantized reference
+            # the server kept as this round's global model.
+            from fedml_tpu.core import compression as CZ
+
+            payload = msg.get(MT.ARG_MODEL_QUANT)
+            codec = msg.get(MT.ARG_MODEL_CODEC)
+            if payload is None or codec is None:
+                raise ValueError(
+                    f"model sync for round {round_idx} carries neither "
+                    "model_params nor a codec-tagged quantized payload"
+                )
+            if self._downlink_template is None:
+                self._downlink_template = jax.device_get(
+                    self.trainer.model.init(jax.random.PRNGKey(0))
+                )
+            w_round = CZ.decode_delta(payload, self._downlink_template, codec)
         fd = None
         if self._faults is not None:
             cid = int(self.trainer.client_index)

@@ -712,8 +712,11 @@ class ScaffoldAPI(FedAvgAPI):
 
     def train_round(self, round_idx: int):
         sampled, _steps, _bs = self._round_plan(round_idx)
-        batch = self._round_batch(sampled, round_idx)
-        rng = jax.random.fold_in(self.rng, round_idx + 1)
+        # batch via the shared warmup/pipeline stash contract — a
+        # pipelined run pops the batch the host prepared during the
+        # previous round's device execution (byte-identical by the
+        # determinism contract, fedavg._round_placed)
+        placed = self._round_placed(round_idx, sampled)
         if self._state_mode == "device":
             (
                 self.global_vars,
@@ -725,7 +728,7 @@ class ScaffoldAPI(FedAvgAPI):
                 self.c_server,
                 self.c_stack,
                 self._place_client_indices(sampled),
-                *self._place_batch(batch, rng),
+                *placed,
             )
             return sampled, metrics
         # spilled store: host-gather the cohort's control rows (prefetched
@@ -742,7 +745,7 @@ class ScaffoldAPI(FedAvgAPI):
             self.global_vars,
             self.c_server,
             c_rows,
-            *self._place_batch(batch, rng),
+            *placed,
         )
         # the round is dispatched async: start reading the NEXT cohort's
         # rows off disk while the device computes this one. Rows being

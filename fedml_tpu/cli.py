@@ -159,6 +159,15 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
                    "(legacy); 'measured' probes BOTH schedules over the "
                    "first rounds (flight-recorder phase costs) and commits "
                    "to the measured winner (algorithms/round_planner.py)")
+@click.option("--pipeline", type=click.Choice(("off", "auto", "on")),
+              default="auto",
+              help="Round pipelining (sim runtimes): while round r runs on "
+                   "device, prepare round r+1's cohort/batch/placement on "
+                   "the host (algorithms/fedavg.py _pipeline_prepare). "
+                   "Numerics are byte-identical to serial; adaptive "
+                   "selection policies, active fault plans, fused chunks "
+                   "and planner probe rounds degrade to serial "
+                   "automatically. 'on' is an explicit alias of 'auto'")
 @click.option("--client_parallelism", type=click.Choice(("auto", "vmap", "scan")),
               default="auto",
               help="How one chip runs the sampled clients: vmap (batched) "
@@ -268,6 +277,14 @@ RUNTIMES = ("vmap", "mesh", "loopback", "mqtt", "shm", "grpc")
                    "(core/compression.py) — int8/int4 (nibble-packed) "
                    "quantization, top-k sparsification, or topk8 (top-k "
                    "with int8 values) of the round delta")
+@click.option("--downlink_compression", type=click.Choice(("none", "int8")),
+              default="none",
+              help="Transport runtimes: quantize the server->client model "
+                   "broadcast int8 (encoded ONCE per round, shared across "
+                   "the cohort; ~4x downlink cut). The server keeps the "
+                   "dequantized tree as the round's reference, so both "
+                   "wire ends train/decode against the identical model; "
+                   "metered as comm/downlink_* in summary.json")
 @click.option("--topk_frac", type=float, default=0.01,
               help="compression=topk/topk8: fraction of entries kept per tensor")
 @click.option("--error_feedback", is_flag=True, default=False,
@@ -598,6 +615,7 @@ def build_config(opt) -> RunConfig:
             state_store=opt.get("state_store", "auto"),
             state_budget_bytes=opt.get("state_budget_bytes", 8 << 30),
             state_dir=opt.get("state_dir", ""),
+            pipeline=opt.get("pipeline", "auto"),
         ),
         train=TrainConfig(
             client_optimizer=opt["client_optimizer"],
@@ -615,6 +633,7 @@ def build_config(opt) -> RunConfig:
         ),
         comm=CommConfig(
             compression=opt.get("compression", "none"),
+            downlink_compression=opt.get("downlink_compression", "none"),
             topk_frac=opt.get("topk_frac", 0.01),
             error_feedback=opt.get("error_feedback", False),
             secure_agg=opt.get("secure_agg", False),
@@ -879,6 +898,12 @@ def run(**opt):
                     "(loopback/shm/grpc/mqtt); the vmap/mesh runtimes exchange "
                     "no messages, so the flag would be silently ignored"
                 )
+            if config.comm.downlink_compression != "none":
+                raise click.UsageError(
+                    "--downlink_compression applies to the transport runtimes "
+                    "(loopback/shm/grpc/mqtt); the vmap/mesh runtimes exchange "
+                    "no messages, so the flag would be silently ignored"
+                )
             if config.fed.deadline_s or config.fed.min_clients != 1:
                 raise click.UsageError(
                     "--deadline_s/--min_clients apply to the transport runtimes "
@@ -900,6 +925,12 @@ def run(**opt):
                 raise click.UsageError(
                     "--secure_agg and --compression are mutually exclusive: "
                     "masked field vectors cannot be sparsified/quantized"
+                )
+            if config.comm.downlink_compression != "none":
+                raise click.UsageError(
+                    "--secure_agg and --downlink_compression are mutually "
+                    "exclusive: masked uploads are field vectors over the "
+                    "exact broadcast reference, which requantizing would break"
                 )
         if config.comm.error_feedback:
             from fedml_tpu.core.compression import EF_METHODS
@@ -1093,6 +1124,11 @@ def run(**opt):
                 # both arms' probed per-round costs (flight/planner_*) —
                 # the ci.sh fused-vs-eager gate reads the winner here
                 log_fn(api.planner.summary_row())
+            if getattr(api, "pipeline_rounds", 0):
+                # round pipeline: rounds whose host prep was hidden behind
+                # the previous round's device dispatch (FedConfig.pipeline;
+                # the per-round overlap seconds fold into flight/overlap_s)
+                log_fn({"fed/pipeline_rounds": int(api.pipeline_rounds)})
             if poison_spec is not None:
                 from fedml_tpu.data.edge_cases import attack_success_rate
 

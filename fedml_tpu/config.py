@@ -94,6 +94,23 @@ class FedConfig:
     # either way (fused == eager is a test contract); only wall clock
     # differs.
     fused_plan: str = "static"
+    # Round pipeline (eager rounds): while round r's programs execute on
+    # device (JAX dispatch is async), the host prepares round r+1 —
+    # cohort selection, batch gather/stack, H2D placement — and stashes
+    # the placed batch for the round boundary (the _warm_placed commit
+    # contract warmup already uses). Inputs are pure in
+    # (round, config.seed, rng), so numerics are BYTE-IDENTICAL to the
+    # serial schedule (tests/test_pipeline.py). "auto" (default)
+    # pipelines wherever that purity holds and degrades to serial
+    # automatically: adaptive selection (power_of_choice /
+    # straggler_aware need round r's signals before selecting r+1),
+    # active fault plans that shrink cohorts, fused chunks (the chunk
+    # already amortizes dispatch on device), and planner probe rounds
+    # (their folds must measure the serial schedule). "on" is an alias
+    # of "auto" (the degradations are correctness rules, not
+    # preferences); "off" forces the serial schedule. Overlap is
+    # measured and folded per round as flight `overlap_s`.
+    pipeline: str = "auto"
     # Eval rounds evaluate on every client's local train/test shards
     # (ref _local_test_on_all_clients, fedavg_api.py:117-180) instead of the
     # central test set.
@@ -178,8 +195,10 @@ class CommConfig:
     wire can additionally carry compressed client UPLINK updates
     (core/compression.py): the client sends encode(w_local − w_round) and
     the server reconstructs w_round + decode(...) before aggregating.
-    Downlink (broadcast) stays exact, so the compression error enters only
-    through the weighted average — the standard FL-compression setup."""
+    Downlink (broadcast) is exact by default; ``downlink_compression``
+    optionally ships the round's model itself int8-quantized — encoded
+    ONCE per round through the same codec registry, with both ends
+    training/decoding against the identical dequantized tree."""
 
     # "none" | "int8" (per-tensor linear quantization) | "int4" (packed
     # low-bit: 4-bit levels, two per byte — ~8x; pair with
@@ -187,6 +206,15 @@ class CommConfig:
     # density) | "topk8" (top-k with int8-quantized values).
     compression: str = "none"
     topk_frac: float = 0.01
+    # Downlink (broadcast) quantization, transport runtimes: "none"
+    # ships the fp32 model; "int8" encodes it once per round
+    # (core/compression.py encode_int8 — per-tensor symmetric scales)
+    # and every worker's envelope carries the SAME payload. The server
+    # keeps the dequantized tree as the round's reference — clients
+    # train from it and uplink deltas encode/decode against it on both
+    # ends, so quantized downlink composes with every uplink codec.
+    # Payload-vs-raw bytes are metered per broadcast (comm/downlink_*).
+    downlink_compression: str = "none"
     # Lossy codecs (topk/topk8/int4/int8): per-client residual memory
     # (error feedback) — dropped coordinates AND quantization error
     # accumulate and ship in later rounds instead of being lost. Off by

@@ -63,6 +63,13 @@ class MessageType:
     # --compression mismatch is handled instead of crashing the FSM)
     ARG_MODEL_DELTA = "model_delta"
     ARG_COMPRESSION = "compression"
+    # quantized downlink broadcast (CommConfig.downlink_compression) —
+    # carried INSTEAD of ARG_MODEL_PARAMS on server->client syncs: the
+    # server encodes the model ONCE per round and every worker's message
+    # shares the same payload tree, tagged with the codec so clients
+    # decode by protocol, not by their own config
+    ARG_MODEL_QUANT = "model_quant"
+    ARG_MODEL_CODEC = "model_codec"
     # pairwise-masked field vector (secagg/secure_aggregation.py) — carried
     # instead of ARG_MODEL_PARAMS when CommConfig.secure_agg is on
     ARG_MASKED_UPDATE = "masked_update"

@@ -170,6 +170,8 @@ class HierarchicalShardedAPI(FedAvgAPI):
 
     _use_device_store = False
     _supports_fused = False
+    # group-loop train_round never consumes the _round_placed stash
+    _supports_pipeline = False
     _donate = True
 
     def __init__(

@@ -75,6 +75,11 @@ class BackdoorFedAvgAPI(RobustFedAvgAPI):
     be poisoned (data/edge_cases.py); their uploads are boosted inside the
     round; the configured defense then runs server-side."""
 
+    # _place_batch reads self._current_round to build the attack mask, so
+    # it is not a pure function of (round, seed, rng) — preparing round
+    # r+1 during round r would bake round r's mask into r+1's batch.
+    _supports_pipeline = False
+
     def __init__(self, config, data, model, robust=RobustConfig(), attack=AttackConfig(), **kw):
         self.attack = attack
         self._attacker_set = set(int(a) for a in attack.attacker_ids)

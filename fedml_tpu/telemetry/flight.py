@@ -361,6 +361,13 @@ class FlightRecorder:
             }
             if attrs.get("fused_rounds"):
                 rec["fused_rounds"] = int(attrs["fused_rounds"])
+            if attrs.get("overlap_s") is not None:
+                # host prep for the NEXT round that hid behind this round's
+                # device work (FedConfig.pipeline) — recorded additively:
+                # t_s stays the round's true wall clock, overlap_s is the
+                # host time the pipeline kept OFF the critical path
+                rec["overlap_s"] = float(attrs["overlap_s"])
+                rec["pipeline_depth"] = int(attrs.get("pipeline_depth", 1))
             if p.get("beacon"):
                 rec["beacon"] = p["beacon"]
             if comm is not None:
@@ -520,6 +527,15 @@ class FlightRecorder:
             ]
             if recompile_rows:
                 row["flight/recompiles_in_ring"] = sum(recompile_rows)
+            overlap_rows = [
+                r["overlap_s"] for r in recs if "overlap_s" in r
+            ]
+            if overlap_rows:
+                # total host time the round pipeline hid behind device
+                # work, and how many ring rounds were prepared ahead —
+                # the ci gate's measured evidence that overlap happened
+                row["flight/overlap_s"] = round(sum(overlap_rows), 6)
+                row["flight/pipelined_rounds"] = len(overlap_rows)
         rate = self.rounds_per_s()
         if rate is not None:
             row["flight/rounds_per_s"] = round(rate, 3)
