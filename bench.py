@@ -1211,6 +1211,134 @@ def _fedbuff_async(workers=4, straggle_ms=800.0, sync_rounds=6, async_steps=18):
     }
 
 
+def _wire_fleet(population=48, max_live=12, rounds=24):
+    """Wire-fleet throughput (fedml_tpu/fleet/): one serve-layer tenant
+    under a churning OS-process client population. Two small arms, both
+    REAL forkserver processes over gRPC on localhost through the SAME
+    launcher the ≥1000-process CI gate uses (one code path for 8 and
+    1000; CPU subprocesses — the section measures fleet-runtime
+    mechanics: spawn/join throughput, admission-door refusals, sustained
+    server steps under churn + send chaos, and the server's bounded
+    thread count; chip speed is not the subject):
+
+    - ``churn`` (the headline): a FedBuff fleet of ``population``
+      distinct clients over ``max_live`` concurrent slots with seeded
+      leave/back-fill waves, ``max_workers`` < first wave so the door
+      refuses (priced, not silent), 2% injected send faults riding the
+      retry layer. ``rounds_per_sec`` = sustained server steps/sec over
+      the whole run (spawn ramp included — that IS fleet wall clock).
+    - ``sync_beacons``: a fixed-K FedAvg fleet whose client beacons feed
+      the per-tier fleet digests — p50/p95 train_s and rtt_s come off
+      the recorded percentiles (fleet_telemetry.json), not timers in
+      this process.
+    """
+    import subprocess
+    import sys
+    import tempfile
+
+    import shutil
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+
+    def run_fleet(name, doc, timeout_s):
+        out_dir = tempfile.mkdtemp(prefix=f"fedml_tpu_fleet_{name}_")
+        spec_path = os.path.join(out_dir, "spec.json")
+        with open(spec_path, "w") as f:
+            json.dump(doc, f)
+        p = subprocess.run(
+            [
+                sys.executable, "-m", "fedml_tpu", "fleet",
+                "--spec", spec_path, "--out_dir", out_dir,
+            ],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        try:
+            with open(os.path.join(out_dir, "fleet_stats.json")) as f:
+                stats = json.load(f)
+            telemetry = {}
+            tpath = os.path.join(out_dir, "fleet_telemetry.json")
+            if os.path.exists(tpath):
+                with open(tpath) as f:
+                    telemetry = json.load(f)
+        except OSError as e:
+            raise RuntimeError(
+                f"{name} fleet left no stats (exit {p.returncode}): "
+                f"{(p.stderr or p.stdout)[-800:]} ({e})"
+            )
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+        if p.returncode != 0 or not stats.get("ok"):
+            raise RuntimeError(
+                f"{name} fleet not ok (exit {p.returncode}): {stats} "
+                f"{(p.stderr or p.stdout)[-400:]}"
+            )
+        return stats, telemetry
+
+    churn, _ = run_fleet("churn", {
+        "population": population, "max_live": max_live,
+        # max_workers below the first wave width: the admission door MUST
+        # refuse under this spec, so the bench prices refusal throughput
+        # instead of only ever measuring the happy path
+        "max_workers": max(2, max_live - 2),
+        "algorithm": "fedbuff", "rounds": rounds, "async_buffer_k": 2,
+        "assignments": [1, 2], "tiers": {"highend_phone": 1.0},
+        "send_fault_p": 0.02, "seed": 0, "base_port": 19700,
+        "orphan_deadline_s": 60.0, "client_deadline_s": 120.0,
+        "run_deadline_s": 240.0,
+    }, timeout_s=270)
+    sync, tele = run_fleet("sync_beacons", {
+        "population": 6, "algorithm": "fedavg", "rounds": 8,
+        "tiers": {"highend_phone": 1.0}, "deadline_s": 30.0,
+        "send_fault_p": 0.02, "seed": 0, "base_port": 19730,
+        "run_deadline_s": 180.0,
+    }, timeout_s=210)
+
+    def pct(metric, key):
+        for tier in (tele.get("tiers") or {}).values():
+            d = (tier.get("metrics") or {}).get(metric)
+            if d:
+                return d.get(key)
+        return None
+
+    elapsed = max(1e-9, float(churn["elapsed_s"]))
+    return {
+        "setup": (
+            f"churn arm: {population} fedbuff clients over {max_live} "
+            f"slots (max_workers {max(2, max_live - 2)} forces door "
+            f"refusals), budgets [1,2], 2% send faults, {rounds} server "
+            "steps; sync arm: 6 fedavg clients, 8 rounds, beacons on; "
+            "forkserver CPU processes via the fleet launcher (fleet "
+            "runtime benchmark, not a chip benchmark)"
+        ),
+        "rounds_per_sec": round(churn["server_steps"] / elapsed, 3),
+        "clients_joined_per_s": churn.get("joined_per_s"),
+        "wall_s": churn["elapsed_s"],
+        "spawned": churn["spawned"],
+        "joins_accepted": churn.get("joins_accepted"),
+        "joins_refused": churn.get("joins_refused"),
+        "leaves": churn.get("leaves"),
+        "comm_refused": churn.get("comm/refused"),
+        "send_refused": churn.get("comm/send_refused"),
+        "fault_events": churn.get("fault_events"),
+        "grpc_threads_max": churn.get("grpc_threads_max"),
+        "grpc_executor_workers": churn.get("grpc_executor_workers"),
+        "thread_bound_ok": churn.get("thread_bound_ok"),
+        "sync_beacons": {
+            "rounds_per_sec": round(
+                float(sync["round"]) / max(1e-9, float(sync["elapsed_s"])), 3
+            ) if sync.get("round") else None,
+            "beacons": tele.get("beacons"),
+            "train_s_p50": pct("train_s", "p50"),
+            "train_s_p99": pct("train_s", "p99"),
+            "rtt_s_p50": pct("rtt_s", "p50"),
+            "rtt_s_p99": pct("rtt_s", "p99"),
+        },
+    }
+
+
 def _process_cold_start(comm_round=1):
     """Time-to-first-round of a FRESH PROCESS, with and without the
     serialized-executable cache (fedml_tpu/compile/executable_cache.py —
@@ -1555,7 +1683,7 @@ class _Emitter:
         "north_star_eager_trainloop", "north_star_fused",
         "bf16_cross_silo_resnet56", "flash_attention_s8192",
         "mxu_validation", "scale_100k_clients", "scale_100k_stateful",
-        "scale_1m", "fedbuff_async", "process_cold_start",
+        "scale_1m", "fedbuff_async", "wire_fleet", "process_cold_start",
         "fused_vs_eager", "pipeline", "uplink_bytes",
     )
 
@@ -2175,6 +2303,9 @@ def main():
     def s_fedbuff():
         emitter.update({"fedbuff_async": _fedbuff_async()})
 
+    def s_wire_fleet():
+        emitter.update({"wire_fleet": _wire_fleet()})
+
     def s_scale():
         emitter.update({"scale_100k_clients": _scale_100k()})
 
@@ -2258,6 +2389,7 @@ def main():
             ("pipeline", s_pipeline, 60, 300),
             ("uplink_bytes", s_uplink, 40, 240),
             ("fedbuff_async", s_fedbuff, 60, 240),
+            ("wire_fleet", s_wire_fleet, 60, 480),
             ("process_cold_start", s_cold_start, 80, 420),
             ("flash_attention", s_flash, 80, 240),
             ("scale", s_scale, 140, 480),

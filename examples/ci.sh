@@ -944,22 +944,35 @@ wf_common=(--algorithm fedavg --runtime grpc --model lr --dataset synthetic
 run_wf_fleet() {  # $1 = out dir, $2 = base port, $3 = server prom port
   # (0 = none); remaining flags go to EVERY rank (clients attach the
   # beacons, so --no_beacons must reach them) — only the server gets
-  # --prom_port (nine processes cannot share one listen socket)
+  # --prom_port + --checkpoint_path via cli_rank0_args. The 9 ranks run
+  # through the SAME fleet launcher (mode="cli") that drives the
+  # 1000-process gate below — one code path for 8 and 1000
+  # (fedml_tpu/fleet/, docs/FLEET.md); "{rank}" in cli_args expands to
+  # each process's rank so every rank keeps its own --log_dir.
   local dir=$1 port=$2 prom=$3; shift 3
-  local wf_pids=()
-  for r in $(seq 1 8); do
-    python -m fedml_tpu "${wf_common[@]}" "$@" --rank "$r" \
-      --base_port "$port" --telemetry_dir "$dir/telemetry" \
-      --log_dir "$dir/rank$r" > /dev/null 2>&1 &
-    wf_pids+=($!)
-  done
-  local srv_flags=()
-  if [ "$prom" != 0 ]; then srv_flags+=(--prom_port "$prom"); fi
-  python -m fedml_tpu "${wf_common[@]}" "$@" --rank 0 \
-    --base_port "$port" --telemetry_dir "$dir/telemetry" \
-    --log_dir "$dir/rank0" --checkpoint_path "$dir/ck" \
-    "${srv_flags[@]}" > /dev/null
-  for pid in "${wf_pids[@]}"; do wait "$pid"; done
+  local rank0=(--checkpoint_path "$dir/ck")
+  if [ "$prom" != 0 ]; then rank0+=(--prom_port "$prom"); fi
+  python - "$dir" "$port" "${#rank0[@]}" "${rank0[@]}" \
+      "${wf_common[@]}" "$@" <<'PY'
+import json, os, sys
+out, port, n0 = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rank0, common = sys.argv[4:4 + n0], sys.argv[4 + n0:]
+os.makedirs(out, exist_ok=True)
+json.dump({
+    "population": 8,
+    "mode": "cli",
+    "base_port": port,
+    "run_deadline_s": 420.0,
+    "cli_args": common + [
+        "--base_port", str(port),
+        "--telemetry_dir", f"{out}/telemetry",
+        "--log_dir", f"{out}/rank{{rank}}",
+    ],
+    "cli_rank0_args": rank0,
+}, open(f"{out}/fleet_spec.json", "w"))
+PY
+  python -m fedml_tpu fleet --spec "$dir/fleet_spec.json" \
+    --out_dir "$dir/fleet" > /dev/null
 }
 
 # capture /fleet DURING the run — the exporter dies with the server, so
@@ -1035,6 +1048,134 @@ print(f"  wire-fleet ok: {report['events']} merged events over "
       f"{len(keys)} checkpoint arrays byte-identical beacons on/off")
 PY
 rm -rf "$WFDIR"
+
+echo "== wire-fleet scale gate: ${FLEET_N:-1000}-process churn fleet against one tenant (docs/FLEET.md) =="
+# The fleet gate (ISSUE 18): ≥1000 OS-process gRPC clients churn through
+# one server-only tenant to completion — seed-deterministic join/leave
+# waves through the admission door, transport chaos on every send, door
+# refusals under wave pressure priced LIVE on /status, the server
+# executor's thread count ASSERTED against its configured bound, zero
+# stuck ranks. Demand (rounds × buffer_k = 98% of the population's
+# one-assignment supply) is sized so every rank must cycle through the
+# tenant: spawned >= FLEET_N is part of the gate. Door pressure is
+# STRUCTURAL, not a race: an 8 s device-profile slowdown makes every
+# admitted member hold its seat for seconds while max_live keeps spare
+# clients spawned and knocking, so max_workers (< the live wave) must
+# refuse continuously; refused ranks requeue at the launcher and land
+# later — the door sheds load without shrinking the population's
+# assignment supply.
+FGDIR=$(mktemp -d)
+FLEET_N=${FLEET_N:-1000}
+FG_PROM=19468
+python - "$FGDIR" "$FLEET_N" <<'PY'
+import json, sys
+out, n = sys.argv[1], int(sys.argv[2])
+json.dump({
+    "population": n,
+    "max_live": 64,
+    # seats < the live wave at any scale (56 at n=1000, n//4 small-n)
+    "max_workers": min(56, max(2, n // 4)),
+    "rounds": max(2, (n * 98) // (100 * 4)),
+    "async_buffer_k": 4,
+    "assignments": [1, 1],       # every rank: one assignment, then leave
+    # custom lingering tier: the 8 s slowdown is what keeps seats
+    # occupied long enough that the door MUST refuse the spare wave;
+    # dropout stays 0 so the supply==population math is exact
+    "fault_plan": json.dumps({
+        "seed": 0,
+        "profiles": {"edge_slow": {"slowdown_s": 8.0}},
+        "fleet": {"edge_slow": 1.0},
+        "num_clients": n,
+    }, sort_keys=True),
+    "send_fault_p": 0.02,
+    "send_retries": 6,
+    "seed": 0,
+    "base_port": 21000,
+    "grpc_max_workers": 16,
+    "orphan_deadline_s": 120.0,
+    "client_deadline_s": 300.0,
+    "run_deadline_s": 780.0,
+}, open(f"{out}/spec.json", "w"), indent=2)
+PY
+# capture /status DURING the run — refusal pricing must be live ops
+# surface, not a post-mortem file
+python - "$FGDIR" "$FG_PROM" <<'PY' &
+import json, sys, time, urllib.request
+out, port = sys.argv[1], int(sys.argv[2])
+deadline = time.time() + 700
+while time.time() < deadline:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=2
+        ) as r:
+            doc = json.loads(r.read().decode())
+        brief = doc.get("tenants", {}).get("fleet", {})
+        if brief.get("joins_refused", 0) >= 1:
+            json.dump(doc, open(f"{out}/status.json", "w"))
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.5)
+sys.exit(1)
+PY
+FG_POLL=$!
+python -m fedml_tpu fleet --spec "$FGDIR/spec.json" --out_dir "$FGDIR/run" \
+  --prom_port "$FG_PROM" --json > "$FGDIR/stats.json"
+wait "$FG_POLL"  # red unless /status priced >=1 door refusal mid-run
+python - "$FGDIR" "$FLEET_N" <<'PY'
+import json, sys
+d, n = sys.argv[1], int(sys.argv[2])
+s = json.load(open(f"{d}/stats.json"))
+assert s["ok"], s
+assert s["spawned"] >= n, (s["spawned"], n)
+assert s["stuck"] == 0 and s["errors"] == 0 and s["orphaned"] == 0, s
+# thread bound: asserted, not eyeballed — the launcher sampled the live
+# grpc-comm executor threads for the whole run
+assert s["thread_bound_ok"], s
+assert s["grpc_threads_max"] <= s["grpc_executor_workers"] == 16, s
+assert s["joins_refused"] >= 1, s
+st = json.load(open(f"{d}/status.json"))["tenants"]["fleet"]
+assert st["joins_refused"] >= 1, st
+assert "comm/refused" in st and "comm/send_refused" in st, st
+print(f"  fleet gate ok: {s['spawned']} processes over max_live "
+      f"{s['max_live']}, {s['server_steps']} server steps, "
+      f"{s['joins_accepted']} joins (+{s['joins_refused']} refused, "
+      f"priced live on /status), {s['leaves']} leaves, "
+      f"{s['fault_events']} fault events, threads "
+      f"{s['grpc_threads_max']}<={s['grpc_executor_workers']}, "
+      f"{s['joined_per_s']}/s over {s['elapsed_s']}s")
+PY
+
+# determinism leg: a recorded fleet FaultTrace replays byte-identically
+# through the SAME launcher (sync transport: the deterministic cohort —
+# fedbuff round assignment is timing-dependent by design, so the replay
+# guarantee lives where rounds are, docs/FLEET.md)
+python - "$FGDIR" <<'PY'
+import json, sys
+out = sys.argv[1]
+base = {
+    "population": 8, "algorithm": "fedavg", "rounds": 2, "seed": 5,
+    "fault_plan": json.dumps({
+        "seed": 5, "default": {"slowdown_s": 0.05, "flaky_upload_p": 0.7},
+    }, sort_keys=True),
+    "run_deadline_s": 240.0,
+}
+json.dump({**base, "base_port": 21200}, open(f"{out}/rec.json", "w"))
+json.dump({**base, "base_port": 21220}, open(f"{out}/rep.json", "w"))
+PY
+python -m fedml_tpu fleet --spec "$FGDIR/rec.json" --out_dir "$FGDIR/rec" > /dev/null
+python - "$FGDIR" <<'PY'
+import json, sys
+out = sys.argv[1]
+doc = json.load(open(f"{out}/rep.json"))
+doc["fault_plan"] = f"trace:{out}/rec/fault_trace.json"
+json.dump(doc, open(f"{out}/rep.json", "w"))
+PY
+python -m fedml_tpu fleet --spec "$FGDIR/rep.json" --out_dir "$FGDIR/rep" > /dev/null
+cmp "$FGDIR/rec/fault_trace.json" "$FGDIR/rep/fault_trace.json" \
+  || { echo "FAULT TRACE REPLAY DIVERGED"; exit 1; }
+echo "  fault-trace replay byte-identical ($(wc -c < "$FGDIR/rec/fault_trace.json") bytes)"
+rm -rf "$FGDIR"
 
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

@@ -17,6 +17,14 @@ elif len(sys.argv) > 1 and sys.argv[1] == "status":
 
     del sys.argv[1]
     status_main()
+elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
+    # `python -m fedml_tpu fleet --spec fleet.json` — the wire-fleet
+    # launcher: prefork thousands of OS-process gRPC clients against one
+    # server-only tenant (fedml_tpu/fleet/)
+    from fedml_tpu.fleet.cli import main as fleet_entry
+
+    del sys.argv[1]
+    fleet_entry()
 elif len(sys.argv) > 1 and sys.argv[1] == "trace":
     # `python -m fedml_tpu trace merge <dirs>` — cross-process trace
     # merge: align each rank's Chrome trace on send/recv wire timestamp

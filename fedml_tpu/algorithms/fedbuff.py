@@ -106,7 +106,14 @@ class FedBuffServerManager(ServerManager):
         self.data = data
         self.task = task
         self.log_fn = log_fn or (lambda m: None)
-        self.worker_num = worker_num or config.fed.client_num_per_round
+        # None-check, not truthiness: worker_num=0 is a real fleet mode —
+        # the server starts with an EMPTY fleet and every client enters
+        # through the C2S_JOIN admission door (the fleet launcher's churn
+        # path); `or` would silently coerce it to client_num_per_round
+        self.worker_num = (
+            worker_num if worker_num is not None
+            else config.fed.client_num_per_round
+        )
         # Elastic-fleet cap (fedml_tpu/serve/): C2S_JOIN from a rank
         # beyond the current fleet is accepted while the live worker
         # count is below this, refused with FINISH past it (backpressure
@@ -134,6 +141,14 @@ class FedBuffServerManager(ServerManager):
         self._buffer_taus: List[int] = []
         self._finished = False
         self._dead_workers: set = set()
+        # ranks that have actually been part of the fleet: the preset
+        # in-process workers plus every admitted C2S_JOIN. The FINISH
+        # broadcast iterates THIS set — under an admission-door fleet,
+        # `range(1, worker_num+1)` contains phantom ranks that never
+        # joined (spawned but reaped, refused, still dialing), and a
+        # FINISH to a never-seen peer blocks in wait_for_ready for the
+        # full send timeout, per phantom, while holding _lock
+        self._joined: set = set(range(1, self.worker_num + 1))
         # fault-starvation valve: consecutive DECLINED assignments with no
         # intervening real upload. A plan that crashes/drops every client
         # would otherwise spin the decline/re-dispatch loop forever with
@@ -256,9 +271,12 @@ class FedBuffServerManager(ServerManager):
 
     # -- elastic fleet membership (fedml_tpu/serve/) --
     def _live_worker_count(self) -> int:
-        """Caller holds _lock."""
-        dead = sum(1 for w in self._dead_workers if 1 <= w <= self.worker_num)
-        return self.worker_num - dead
+        """Caller holds _lock. Membership is the _joined SET, not the
+        1..worker_num range: an external fleet joins in arbitrary rank
+        order, and counting the range would let one high-rank joiner
+        inflate the live count by hundreds of phantoms (refusing every
+        later join while the fleet is near-empty)."""
+        return sum(1 for w in self._joined if w not in self._dead_workers)
 
     def _on_join(self, msg: Message):
         with self._lock:
@@ -271,8 +289,12 @@ class FedBuffServerManager(ServerManager):
                 except Exception:  # noqa: BLE001 — dead peer
                     pass
                 return
+            # set membership, not rank comparison: under sparse/shuffled
+            # external joins, `sender <= worker_num` would treat every
+            # never-joined rank below the current max as a live member
+            # and wave it past the admission cap
             alive = (
-                sender <= self.worker_num and sender not in self._dead_workers
+                sender in self._joined and sender not in self._dead_workers
             )
             if not alive and self._live_worker_count() >= self.max_workers:
                 # backpressure: the fleet is at capacity — refuse at the
@@ -295,6 +317,7 @@ class FedBuffServerManager(ServerManager):
                 return
             self._dead_workers.discard(sender)
             self.worker_num = max(self.worker_num, sender)
+            self._joined.add(sender)
             self.joins_accepted += 1
             self._dispatch(sender)
 
@@ -315,11 +338,14 @@ class FedBuffServerManager(ServerManager):
         """FINISH the fleet and stop this server's loop. Caller holds
         _lock (or is the constructor-less starvation path, same thread)."""
         self._finished = True
-        for worker in range(1, self.worker_num + 1):
+        for worker in sorted(self._joined):
             if worker in self._dead_workers:
                 continue
             try:
-                self.send_message(Message(MT.FINISH, 0, worker))
+                # single attempt on purpose: a dead rank at shutdown must
+                # cost one bounded timeout, not the whole retry schedule —
+                # multiplied by the dead fraction of a 1000-rank fleet
+                self.comm.send_message_nowait(Message(MT.FINISH, 0, worker))
             except Exception:  # noqa: BLE001 — dead peer at shutdown
                 pass
         self.finish()

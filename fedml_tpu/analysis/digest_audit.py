@@ -282,6 +282,12 @@ KNOWN_BENIGN = frozenset({
     "comm.secure_agg", "comm.send_retries", "comm.send_backoff_s",
     "comm.send_backoff_max_s", "comm.send_retry_deadline_s",
     "comm.send_timeout_s", "comm.send_fault_p", "comm.beacons",
+    # connection-scaling knobs (fedml_tpu/fleet/): executor sizing, stream
+    # budgets, and broker caps steer transport-side threads/queues only —
+    # nothing here can reach a traced program
+    "comm.grpc_max_workers", "comm.grpc_stream_budget",
+    "comm.grpc_max_message_mb", "comm.grpc_keepalive_s",
+    "comm.mqtt_max_connections",
     "mesh.client_shards", "mesh.axis_name",
     "compile.warmup", "compile.cache_dir", "compile.min_compile_time_s",
     "compile.executable_cache", "compile.recompile_budget",

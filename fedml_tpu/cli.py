@@ -1688,6 +1688,10 @@ def _run_grpc_process(config, data, model, task, log_fn, opt):
     comm = GrpcCommManager(
         rank, table, base_port=opt["base_port"],
         send_timeout_s=config.comm.send_timeout_s,
+        max_workers=config.comm.grpc_max_workers,
+        stream_budget=config.comm.grpc_stream_budget,
+        max_message_mb=config.comm.grpc_max_message_mb,
+        keepalive_s=config.comm.grpc_keepalive_s,
     )
     # per-process fault injector (client ranks only): the plan is
     # deterministic in (seed, client, round), so every process injects

@@ -253,6 +253,32 @@ class CommConfig:
     # secagg/secure_aggregation.py SECURITY NOTE); mutually exclusive with
     # compression.
     secure_agg: bool = False
+    # gRPC server executor size (core/grpc_comm.py — was a hard-coded
+    # ThreadPoolExecutor(max_workers=8)). 0 = auto: sized from the
+    # expected cohort (the rank's ip_config table), capped — handler
+    # work is a queue put, so a small pool serves thousands of streams;
+    # the bound is what the fleet gate ASSERTS (examples/ci.sh).
+    grpc_max_workers: int = 0
+    # Inbound stream budget (server-side backpressure): when > 0, a
+    # received RPC is REFUSED (RESOURCE_EXHAUSTED) while more than this
+    # many messages sit undrained in the receive queue — graceful
+    # refusal instead of unbounded queue growth; the refused sender
+    # redials under its retry policy (core/retry.py) and both ends
+    # meter the refusal (comm/refused, comm/send_refused). 0 = off.
+    grpc_stream_budget: int = 0
+    # gRPC channel/server max message size in MB (was the module-constant
+    # 1000 MB mirroring the reference's grpc_comm_manager.py:35-39).
+    grpc_max_message_mb: int = 1000
+    # gRPC keepalive ping interval in seconds; 0 = transport default
+    # (no explicit keepalive options). Long-lived fleet channels set
+    # this so half-open connections die instead of wedging a worker.
+    grpc_keepalive_s: float = 0.0
+    # MiniMqttBroker connection cap (core/mqtt_broker.py): past it a
+    # CONNECT is answered CONNACK 0x03 (server unavailable) and closed
+    # instead of growing one reader thread per connection without
+    # bound; refusals are metered (comm/refused). 0 = unbounded
+    # (legacy behavior).
+    mqtt_max_connections: int = 0
     # Client telemetry beacons (telemetry/wire.py): a bounded ~200 B
     # summary of local measurements (train s, encode s, retries, codec,
     # DeviceProfile tier, RSS) piggybacked as ARG_TELEMETRY on model

@@ -28,7 +28,7 @@ import time
 from typing import List, Optional
 
 from fedml_tpu.core.message import Message, MessageType
-from fedml_tpu.core.retry import InjectedSendFault, RetryPolicy
+from fedml_tpu.core.retry import InjectedSendFault, RemoteRefusal, RetryPolicy
 from fedml_tpu.telemetry.comm import get_comm_meter
 from fedml_tpu.telemetry.spans import get_tracer
 from fedml_tpu.telemetry.wire import TraceContext
@@ -154,7 +154,12 @@ class BaseCommManager(abc.ABC):
                 # Exception, not BaseException: KeyboardInterrupt/
                 # SystemExit must abort the send, not be retried N times
                 # under backoff
-                except Exception:  # noqa: BLE001 — transport boundary
+                except Exception as e:  # noqa: BLE001 — transport boundary
+                    if isinstance(e, RemoteRefusal):
+                        # the server SHED this attempt at its budget —
+                        # metered apart from transport faults, then the
+                        # normal backoff schedule owns the redial
+                        self._meter.on_send_refused(mt)
                     attempt += 1
                     delay = policy.backoff_s(seq, attempt)
                     out_of_attempts = attempt >= policy.max_attempts
@@ -167,6 +172,19 @@ class BaseCommManager(abc.ABC):
                     self._meter.on_send_retry(mt)
                     time.sleep(delay)
         self._meter.on_sent(msg.get_type(), _wire_bytes(msg), wire_s)
+
+    def send_message_nowait(self, msg: Message, **kwargs) -> None:
+        """Single-attempt send (stamped + metered, NEVER retried): for
+        shutdown/FINISH broadcasts, where a dead peer must cost at most
+        one bounded timeout. Running a fleet-sized broadcast through the
+        retry schedule would pay backoff × attempts PER dead rank — at
+        1000 clients that turns a teardown into minutes of blocking."""
+        self._stamp_trace(msg)
+        t0 = time.perf_counter()
+        self._send(msg, **kwargs)
+        self._meter.on_sent(
+            msg.get_type(), _wire_bytes(msg), time.perf_counter() - t0
+        )
 
     def _stamp_trace(self, msg: Message) -> None:
         """Stamp the compact ``_trace`` context onto the envelope (carried

@@ -23,15 +23,59 @@ from typing import Dict, Optional
 
 from fedml_tpu.core.comm import BaseCommManager
 from fedml_tpu.core.message import Message
+from fedml_tpu.core.retry import RemoteRefusal
 
 _METHOD = "/fedml_tpu.Comm/SendMessage"
 _STOP = object()
 
-_GRPC_OPTIONS = [
-    ("grpc.max_send_message_length", 1000 * 1024 * 1024),
-    ("grpc.max_receive_message_length", 1000 * 1024 * 1024),
-    ("grpc.enable_http_proxy", 0),
-]
+#: per-process sequence so each manager's executor threads carry a unique
+#: name prefix — a thread census scoped to ONE server (the fleet launcher's
+#: thread-bound assertion) must not count idle executor threads left behind
+#: by earlier managers in the same process
+_exec_seq = iter(range(1 << 30))
+_exec_seq_lock = threading.Lock()
+
+# Executor sizing bounds for the auto path (grpc_max_workers=0): enough
+# threads that a wave of concurrent uploads doesn't serialize behind the
+# enqueue handler, capped so a 1000-client fleet cannot ask one process
+# for 1000 OS threads — the handler only does Queue.put, so threads above
+# the cap buy nothing but stack memory.
+_AUTO_WORKERS_MIN = 8
+_AUTO_WORKERS_CAP = 64
+
+
+def _grpc_options(max_message_mb: int = 1000, keepalive_s: float = 0.0):
+    """Channel/server options (ref grpc_comm_manager.py:35-39) — message
+    caps + keepalive now come from CommConfig instead of module constants."""
+    opts = [
+        ("grpc.max_send_message_length", int(max_message_mb) * 1024 * 1024),
+        ("grpc.max_receive_message_length", int(max_message_mb) * 1024 * 1024),
+        ("grpc.enable_http_proxy", 0),
+    ]
+    if keepalive_s and keepalive_s > 0:
+        ka_ms = int(float(keepalive_s) * 1000)
+        opts += [
+            ("grpc.keepalive_time_ms", ka_ms),
+            ("grpc.keepalive_timeout_ms", max(1000, ka_ms // 2)),
+            ("grpc.keepalive_permit_without_calls", 1),
+            ("grpc.http2.max_pings_without_data", 0),
+        ]
+    return opts
+
+
+def executor_workers_for(max_workers: int, expected_peers: int) -> int:
+    """Resolve the server executor size: explicit ``grpc_max_workers`` wins;
+    0 = auto-size from the expected cohort (~1 thread per 8 peers, floored
+    at 8, capped at 64 — see _AUTO_WORKERS_*). Pure so the fleet gate can
+    assert the exact bound the server is running with."""
+    if max_workers and max_workers > 0:
+        return int(max_workers)
+    peers = max(int(expected_peers), 1)
+    return min(_AUTO_WORKERS_CAP, max(_AUTO_WORKERS_MIN, (peers + 7) // 8))
+
+# legacy module constant kept for external callers; internal paths build
+# options from config via _grpc_options()
+_GRPC_OPTIONS = _grpc_options()
 
 
 def read_ip_config(path: str) -> Dict[int, str]:
@@ -56,6 +100,11 @@ class GrpcCommManager(BaseCommManager):
         bind_host: str = "0.0.0.0",
         send_timeout_s: float = 30.0,
         handshake_timeout_s: float = 120.0,
+        max_workers: int = 0,
+        stream_budget: int = 0,
+        max_message_mb: int = 1000,
+        keepalive_s: float = 0.0,
+        expected_peers: Optional[int] = None,
     ):
         import grpc
 
@@ -71,9 +120,33 @@ class GrpcCommManager(BaseCommManager):
         self._q: "queue.Queue" = queue.Queue()
         self._channels: Dict[int, object] = {}
         self._handshaken: set = set()
+        # retry-path bookkeeping: peers we already spent the one
+        # wait-for-bind window on (see _send) — later attempts fail fast
+        self._hs_waited: set = set()
         self._grpc = grpc
+        self._options = _grpc_options(max_message_mb, keepalive_s)
+        # Inbound stream budget: while more than this many messages sit
+        # undrained in the receive queue, new RPCs are shed with
+        # RESOURCE_EXHAUSTED instead of piling onto an unbounded queue.
+        # The sender's retry layer owns the redial (RemoteRefusal below),
+        # so shedding is backpressure, not message loss. 0 = off.
+        self.stream_budget = int(stream_budget)
+        # Executor size: the historical hardcoded 8 threads can't serve a
+        # fleet; sized from config / expected cohort and exposed so the
+        # fleet gate can assert the server's thread count is bounded by it.
+        self.executor_workers = executor_workers_for(
+            max_workers,
+            expected_peers if expected_peers is not None else len(ip_config),
+        )
 
         def handle(request: bytes, context) -> bytes:
+            if self.stream_budget > 0 and self._q.qsize() >= self.stream_budget:
+                self._meter.on_refused("grpc_stream")
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"receive queue over stream budget "
+                    f"({self.stream_budget}); redial under backoff",
+                )
             self._q.put(request)
             return b"ok"
 
@@ -87,8 +160,14 @@ class GrpcCommManager(BaseCommManager):
                 )
             },
         )
+        with _exec_seq_lock:
+            self.thread_prefix = f"grpc-comm-{next(_exec_seq)}"
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=8), options=_GRPC_OPTIONS
+            futures.ThreadPoolExecutor(
+                max_workers=self.executor_workers,
+                thread_name_prefix=self.thread_prefix,
+            ),
+            options=self._options,
         )
         self._server.add_generic_rpc_handlers((handler,))
         self.port = base_port + rank
@@ -104,7 +183,7 @@ class GrpcCommManager(BaseCommManager):
         if receiver not in self._channels:
             target = f"{self.ip_config[receiver]}:{self.base_port + receiver}"
             self._channels[receiver] = self._grpc.insecure_channel(
-                target, options=_GRPC_OPTIONS
+                target, options=self._options
             )
         ch = self._channels[receiver]
         return ch.unary_unary(
@@ -116,20 +195,39 @@ class GrpcCommManager(BaseCommManager):
         if self.retry_policy is not None:
             # The retry layer (core/retry.py, via the send_message
             # template) owns reconnects: every attempt is bounded by
-            # send_timeout_s and failures are retried under backoff — no
-            # one-shot 120 s handshake stall, no attempted-once handshake
-            # bookkeeping. Until a peer has answered once, attempts keep
-            # wait_for_ready=True (still capped at send_timeout_s) so the
+            # send_timeout_s and failures are retried under backoff. A
+            # peer that has never answered gets exactly ONE
+            # wait_for_ready=True window (capped at send_timeout_s) so the
             # multi-process startup race waits for the peer's server to
-            # BIND instead of burning the whole retry budget on instant
-            # connection-refused errors; after first contact a dead peer
-            # fails fast and the backoff schedule owns the redials.
-            first = receiver not in self._handshaken
-            self._stub(receiver)(
-                msg.to_bytes(),
-                wait_for_ready=first,
-                timeout=timeout if timeout is not None else self.send_timeout_s,
+            # BIND instead of burning the retry budget on instant
+            # connection-refused errors — but only one: at fleet scale a
+            # JOIN reply can target a client that died in the queue, and
+            # waiting a full window on EVERY retry (attempts ×
+            # send_timeout_s, minutes) starves the server's single drain
+            # thread and parks the whole fleet. After the one window (or
+            # after first contact) a dead peer fails fast and the backoff
+            # schedule owns the redials.
+            first = (
+                receiver not in self._handshaken
+                and receiver not in self._hs_waited
             )
+            if first:
+                self._hs_waited.add(receiver)
+            try:
+                self._stub(receiver)(
+                    msg.to_bytes(),
+                    wait_for_ready=first,
+                    timeout=(
+                        timeout if timeout is not None else self.send_timeout_s
+                    ),
+                )
+            except self._grpc.RpcError as e:
+                if e.code() == self._grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    # the receiver SHED us at its stream budget — reclassify
+                    # so the send template meters a refusal (not a fault)
+                    # and the backoff schedule redials
+                    raise RemoteRefusal(str(e.details())) from e
+                raise
             self._handshaken.add(receiver)  # on SUCCESS only (vs legacy)
             return
         # Legacy single-attempt path: wait_for_ready on the FIRST send per

@@ -81,11 +81,26 @@ def _read_mqtt_str(body: bytes, off: int):
 class MiniMqttBroker:
     """Threaded QoS-0 broker: one reader thread per connection, exact-topic
     routing, per-connection write lock (PUBLISH fan-out and PINGRESP can
-    race on the same socket)."""
+    race on the same socket).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``max_connections`` bounds reader-thread growth for fleet scale: past
+    the cap a dialer gets a clean CONNACK return code 0x03 ("server
+    unavailable", spec §3.2.2.3) and the socket closes — MiniMqttClient
+    raises :class:`~fedml_tpu.core.retry.RemoteRefusal` on that code, so
+    a capped client redials under the retry layer's backoff instead of
+    holding a reader thread. 0 = unbounded (legacy behavior). Refusals
+    are counted on ``self.refused`` and metered on the comm meter
+    (``refused["mqtt_conn"]``)."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0,
+        max_connections: int = 0,
+    ):
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()[:2]
+        self.max_connections = int(max_connections)
+        self.refused = 0
+        self._live = 0
         self._subs: Dict[str, Set[socket.socket]] = {}
         self._locks: Dict[socket.socket, threading.Lock] = {}
         self._lock = threading.Lock()
@@ -99,10 +114,50 @@ class MiniMqttBroker:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            if self.max_connections > 0:
+                with self._lock:
+                    at_cap = self._live >= self.max_connections
+                    if not at_cap:
+                        self._live += 1
+                if at_cap:
+                    self.refused += 1
+                    try:
+                        from fedml_tpu.telemetry.comm import get_comm_meter
+
+                        get_comm_meter().on_refused("mqtt_conn")
+                    except Exception:  # noqa: BLE001 — metering best-effort
+                        pass
+                    # refusal must not block the accept loop: a short-lived
+                    # thread reads the CONNECT (bounded) and answers
+                    # CONNACK 0x03 so the client sees a deliberate refusal,
+                    # not a hung dial
+                    threading.Thread(
+                        target=self._refuse, args=(conn,), daemon=True
+                    ).start()
+                    continue
+            else:
+                with self._lock:
+                    self._live += 1
             self._locks[conn] = threading.Lock()
             threading.Thread(
                 target=self._serve, args=(conn,), daemon=True
             ).start()
+
+    def _refuse(self, conn):
+        try:
+            conn.settimeout(5.0)
+            ptype, _, _ = _read_packet(conn)
+            if ptype == CONNECT:
+                # CONNACK: session-present 0, return code 3 = server
+                # unavailable (spec §3.2.2.3)
+                conn.sendall(_packet(CONNACK, 0, b"\x00\x03"))
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _send(self, conn, data: bytes):
         lock = self._locks.get(conn)
@@ -118,7 +173,12 @@ class MiniMqttBroker:
         with self._lock:
             for subs in self._subs.values():
                 subs.discard(conn)
-            self._locks.pop(conn, None)
+            # _drop can race from _send and _serve for the same socket:
+            # the lock-table pop is the idempotency token, so the live
+            # count (what the connection cap admits against) decrements
+            # exactly once per admitted connection
+            if self._locks.pop(conn, None) is not None:
+                self._live -= 1
         try:
             conn.close()
         except OSError:
@@ -206,6 +266,17 @@ class MiniMqttClient:
         self._sock.sendall(_packet(CONNECT, 0, body))
         ptype, _, ack = _read_packet(self._sock)
         if ptype != CONNACK or ack[1] != 0:
+            self._sock.close()
+            if ptype == CONNACK and len(ack) >= 2 and ack[1] == 3:
+                # return code 3 = server unavailable: the broker's
+                # connection cap shed us deliberately — raise the refusal
+                # subclass so callers redial under backoff
+                from fedml_tpu.core.retry import RemoteRefusal
+
+                raise RemoteRefusal(
+                    "MQTT connect refused at broker connection cap "
+                    f"(CONNACK rc=3): {ack!r}"
+                )
             raise ConnectionError(f"MQTT connect refused: {ack!r}")
         self._sock.settimeout(None)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)

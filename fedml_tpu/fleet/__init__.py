@@ -1,0 +1,18 @@
+"""Wire-fleet runtime: thousands of OS-process clients against one tenant.
+
+- :mod:`fedml_tpu.fleet.spec` — :class:`FleetSpec`, the declarative
+  fleet description (population, tier mix, churn, chaos, budgets);
+- :mod:`fedml_tpu.fleet.launcher` — :class:`FleetLauncher`, the
+  forkserver-preforked supervisor (churn loop, straggler reaping,
+  bounded logging, thread-bound assertion, FaultTrace merge);
+- :mod:`fedml_tpu.fleet.client` — the per-process client entry
+  (preload target; numpy-only LiteTrainer over the real gRPC wire);
+- :mod:`fedml_tpu.fleet.cli` — ``python -m fedml_tpu fleet``.
+
+See docs/FLEET.md.
+"""
+
+from fedml_tpu.fleet.launcher import FleetLauncher
+from fedml_tpu.fleet.spec import FleetSpec
+
+__all__ = ["FleetLauncher", "FleetSpec"]

@@ -1,0 +1,94 @@
+"""``python -m fedml_tpu fleet`` — launch a wire fleet from a FleetSpec.
+
+Examples::
+
+    # 1000-process fedbuff churn fleet against one tenant
+    python -m fedml_tpu fleet --spec fleet.json --out_dir /tmp/fleet
+
+    # inline spec, ops port for live /fleet + /status
+    python -m fedml_tpu fleet --spec '{"population": 64, "rounds": 10}' \\
+        --prom_port 9109 --out_dir /tmp/fleet
+
+Exit status is 0 only when the launcher's ``ok`` verdict holds (tenant
+finished, zero stuck ranks, zero client errors, thread bound held).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from fedml_tpu.fleet.launcher import FleetLauncher
+from fedml_tpu.fleet.spec import FleetSpec
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m fedml_tpu fleet",
+        description="Launch a wire fleet (fedml_tpu/fleet/) from a spec.",
+    )
+    p.add_argument(
+        "--spec", required=True,
+        help="fleet spec: inline JSON or a path to a JSON file",
+    )
+    p.add_argument(
+        "--out_dir", default="fleet_out",
+        help="run directory (fleet_stats.json, fault_trace.json, "
+        "per-tenant telemetry)",
+    )
+    p.add_argument(
+        "--prom_port", type=int, default=None,
+        help="ops port for the hosting FederationServer "
+        "(/metrics, /status, /fleet)",
+    )
+    p.add_argument(
+        "--population", type=int, default=None,
+        help="override the spec's population",
+    )
+    p.add_argument(
+        "--max_live", type=int, default=None,
+        help="override the spec's concurrent-process wave width",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the final launcher stats as JSON on stdout",
+    )
+    return p
+
+
+def fleet_main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    spec = FleetSpec.from_spec(args.spec)
+    if args.population is not None or args.max_live is not None:
+        doc = spec.to_json()
+        if args.population is not None:
+            doc["population"] = args.population
+        if args.max_live is not None:
+            doc["max_live"] = args.max_live
+        spec = FleetSpec(doc)
+    launcher = FleetLauncher(
+        spec, args.out_dir, prom_port=args.prom_port
+    )
+    stats = launcher.run()
+    if args.json:
+        print(json.dumps(stats, indent=2, sort_keys=True))
+    else:
+        keys = (
+            "population", "spawned", "completed", "left", "finished_early",
+            "orphaned", "errors", "reaped", "stuck", "joins_accepted",
+            "joins_refused", "comm/refused", "grpc_threads_max",
+            "grpc_executor_workers", "elapsed_s", "joined_per_s", "ok",
+        )
+        for k in keys:
+            if k in stats:
+                print(f"{k}: {stats[k]}")
+    return 0 if stats.get("ok") else 1
+
+
+def main() -> None:
+    sys.exit(fleet_main())
+
+
+if __name__ == "__main__":
+    main()

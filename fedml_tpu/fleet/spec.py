@@ -1,0 +1,257 @@
+"""FleetSpec — the declarative description of a wire fleet.
+
+One JSON document describes everything the fleet launcher
+(fleet/launcher.py) needs to materialize ≥1000 OS-process gRPC clients
+against one serve-layer tenant: the population size, the DeviceProfile
+tier mix (reusing the fault-plan ``"fleet"`` shorthand,
+scheduler/faults.py), the seed-deterministic churn schedule (per-client
+assignment budgets — a client leaves through the admission door after
+its budget is spent, and the launcher back-fills the freed slot from the
+remaining population: the join/leave waves ARE the rolling population),
+the chaos knobs (``send_fault_p`` transport chaos rides the PR-10 retry
+layer), and the connection budgets the server side enforces
+(``grpc_max_workers`` / ``grpc_stream_budget`` / tenant ``max_workers``).
+
+Everything derived here is pure in the spec (notably ``seed``): the same
+spec materializes the same tier assignment, the same join order, and the
+same per-client assignment budgets in every run — which is what lets a
+recorded :class:`~fedml_tpu.scheduler.faults.FaultTrace` replay
+byte-identically against the same fleet.
+
+Schema (all keys optional except ``population``)::
+
+    {
+      "population": 1000,        # total distinct client processes over the run
+      "max_live": 96,            # concurrent client processes (the wave width)
+      "algorithm": "fedbuff",    # "fedbuff" (churn fleet) | "fedavg" (fixed K)
+      "mode": "lite",            # "lite" (forkserver fleet clients) | "cli"
+      "rounds": 30,              # server steps (fedbuff) / comm rounds (sync)
+      "max_workers": 64,         # tenant admission cap (fedbuff; 0 = max_live)
+      "async_buffer_k": 4,
+      "tiers": {"midrange_phone": 0.7, "lowend_phone": 0.3},
+      "assignments": [1, 3],     # per-client churn budget range (0 = no churn)
+      "seed": 0,
+      "base_port": 19400,
+      "send_fault_p": 0.02,      # transport chaos (core/retry.py)
+      "send_retries": 6,
+      "send_timeout_s": 20.0,
+      "deadline_s": 60.0,        # sync quorum deadline (required with tiers)
+      "grpc_max_workers": 0,     # server executor size (0 = auto from cohort)
+      "grpc_stream_budget": 0,   # inbound queue budget (0 = off)
+      "orphan_deadline_s": 60.0, # fedbuff client deadman
+      "client_deadline_s": 300,  # straggler/zombie reap deadline per client
+      "run_deadline_s": 900,     # whole-fleet watchdog
+      "fault_plan": "",          # override: replay "trace:<path>" verbatim
+      "feat_dim": 8, "num_classes": 3, "batch_size": 8,   # lite model dims
+      "cli_args": [...],         # mode="cli": argv tail for python -m fedml_tpu
+                                 #   ("{rank}" expands to the process rank)
+      "cli_rank0_args": [...]    #   extra args for rank 0 only (e.g. --prom_port)
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+FLEET_MODES = ("lite", "cli")
+FLEET_ALGORITHMS = ("fedbuff", "fedavg")
+
+_KNOWN_KEYS = {
+    "population", "max_live", "algorithm", "mode", "rounds", "max_workers",
+    "async_buffer_k", "tiers", "assignments", "seed", "base_port",
+    "send_fault_p", "send_retries", "send_timeout_s", "deadline_s",
+    "grpc_max_workers", "grpc_stream_budget", "orphan_deadline_s",
+    "client_deadline_s", "run_deadline_s", "fault_plan",
+    "feat_dim", "num_classes", "batch_size",
+    "cli_args", "cli_rank0_args",
+}
+
+
+class FleetSpec:
+    """Parsed + validated fleet description (see module docstring)."""
+
+    def __init__(self, doc: dict):
+        unknown = set(doc) - _KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"fleet spec: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(_KNOWN_KEYS)})"
+            )
+        self.population = int(doc.get("population", 0))
+        if self.population < 1:
+            raise ValueError("fleet spec: population must be >= 1")
+        self.max_live = int(doc.get("max_live", min(64, self.population)))
+        if not 1 <= self.max_live:
+            raise ValueError("fleet spec: max_live must be >= 1")
+        self.max_live = min(self.max_live, self.population)
+        self.algorithm = str(doc.get("algorithm", "fedbuff"))
+        if self.algorithm not in FLEET_ALGORITHMS:
+            raise ValueError(
+                f"fleet spec: algorithm must be one of {FLEET_ALGORITHMS}, "
+                f"got {self.algorithm!r}"
+            )
+        self.mode = str(doc.get("mode", "lite"))
+        if self.mode not in FLEET_MODES:
+            raise ValueError(
+                f"fleet spec: mode must be one of {FLEET_MODES}, "
+                f"got {self.mode!r}"
+            )
+        self.rounds = int(doc.get("rounds", 20))
+        self.max_workers = int(doc.get("max_workers", 0)) or self.max_live
+        self.async_buffer_k = int(doc.get("async_buffer_k", 4))
+        self.tiers: Dict[str, float] = {
+            str(k): float(v) for k, v in (doc.get("tiers") or {}).items()
+        }
+        asg = doc.get("assignments", [0, 0])
+        if not (isinstance(asg, (list, tuple)) and len(asg) == 2):
+            raise ValueError(
+                "fleet spec: assignments must be a [min, max] budget range"
+            )
+        self.assignments = (int(asg[0]), int(asg[1]))
+        if self.assignments[0] < 0 or self.assignments[1] < self.assignments[0]:
+            raise ValueError(
+                "fleet spec: assignments range must satisfy 0 <= min <= max"
+            )
+        self.seed = int(doc.get("seed", 0))
+        self.base_port = int(doc.get("base_port", 19400))
+        self.send_fault_p = float(doc.get("send_fault_p", 0.0))
+        self.send_retries = int(doc.get("send_retries", 6))
+        self.send_timeout_s = float(doc.get("send_timeout_s", 20.0))
+        self.deadline_s = float(doc.get("deadline_s", 0.0))
+        self.grpc_max_workers = int(doc.get("grpc_max_workers", 0))
+        self.grpc_stream_budget = int(doc.get("grpc_stream_budget", 0))
+        self.orphan_deadline_s = float(doc.get("orphan_deadline_s", 60.0))
+        self.client_deadline_s = float(doc.get("client_deadline_s", 300.0))
+        self.run_deadline_s = float(doc.get("run_deadline_s", 900.0))
+        self.fault_plan = str(doc.get("fault_plan", ""))
+        self.feat_dim = int(doc.get("feat_dim", 8))
+        self.num_classes = int(doc.get("num_classes", 3))
+        self.batch_size = int(doc.get("batch_size", 8))
+        self.cli_args: List[str] = [str(a) for a in doc.get("cli_args", [])]
+        self.cli_rank0_args: List[str] = [
+            str(a) for a in doc.get("cli_rank0_args", [])
+        ]
+        if self.algorithm == "fedavg":
+            # the sync transport has a fixed per-round fleet: every wire
+            # rank must exist for the whole run — no rolling population
+            if self.population > self.max_live:
+                raise ValueError(
+                    "fleet spec: algorithm=fedavg needs population <= "
+                    "max_live (sync rounds have a fixed wire fleet; churn "
+                    "is a fedbuff admission-door feature)"
+                )
+            if self.assignments != (0, 0):
+                raise ValueError(
+                    "fleet spec: assignments churn budgets are a fedbuff "
+                    "feature (sync workers live for the whole run)"
+                )
+            if self._plan_has_participation_faults() and self.deadline_s <= 0:
+                raise ValueError(
+                    "fleet spec: sync fleets with dropout-capable tiers "
+                    "need deadline_s > 0 (the server's all-received "
+                    "barrier would wait forever)"
+                )
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "FleetSpec":
+        return cls(doc)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FleetSpec":
+        """Inline JSON (starts with ``{``) or a path to a JSON file."""
+        text = str(spec).strip()
+        if not text.startswith("{"):
+            if not os.path.exists(text):
+                raise ValueError(
+                    f"fleet spec {text!r} is neither inline JSON nor an "
+                    "existing file"
+                )
+            with open(text) as f:
+                text = f.read()
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"fleet spec is not valid JSON: {e}") from e
+        return cls.from_json(doc)
+
+    def to_json(self) -> dict:
+        return {
+            "population": self.population,
+            "max_live": self.max_live,
+            "algorithm": self.algorithm,
+            "mode": self.mode,
+            "rounds": self.rounds,
+            "max_workers": self.max_workers,
+            "async_buffer_k": self.async_buffer_k,
+            "tiers": dict(self.tiers),
+            "assignments": list(self.assignments),
+            "seed": self.seed,
+            "base_port": self.base_port,
+            "send_fault_p": self.send_fault_p,
+            "send_retries": self.send_retries,
+            "send_timeout_s": self.send_timeout_s,
+            "deadline_s": self.deadline_s,
+            "grpc_max_workers": self.grpc_max_workers,
+            "grpc_stream_budget": self.grpc_stream_budget,
+            "orphan_deadline_s": self.orphan_deadline_s,
+            "client_deadline_s": self.client_deadline_s,
+            "run_deadline_s": self.run_deadline_s,
+            "fault_plan": self.fault_plan,
+            "feat_dim": self.feat_dim,
+            "num_classes": self.num_classes,
+            "batch_size": self.batch_size,
+            "cli_args": list(self.cli_args),
+            "cli_rank0_args": list(self.cli_rank0_args),
+        }
+
+    # -- derived (all pure in the spec) ------------------------------------
+
+    def fault_plan_spec(self) -> str:
+        """The fault-plan string clients and server inject from: an
+        explicit ``fault_plan`` override (e.g. ``trace:<path>`` replay)
+        wins; otherwise the tier mix materializes through the fault-plan
+        ``"fleet"`` shorthand; '' = no faults."""
+        if self.fault_plan:
+            return self.fault_plan
+        if not self.tiers:
+            return ""
+        return json.dumps({
+            "seed": self.seed,
+            "fleet": dict(self.tiers),
+            "num_clients": self.population,
+        }, sort_keys=True)
+
+    def _plan_has_participation_faults(self) -> bool:
+        from fedml_tpu.scheduler.faults import FaultPlan
+
+        plan = FaultPlan.from_spec(self.fault_plan_spec())
+        return plan is not None and plan.has_participation_faults()
+
+    def assignment_budget(self, rank: int) -> int:
+        """Per-client churn budget: how many dispatches client ``rank``
+        handles before requesting leave (0 = stay until FINISH). Pure in
+        (seed, rank) so a replayed fleet churns identically."""
+        lo, hi = self.assignments
+        if hi <= 0:
+            return 0
+        rng = np.random.default_rng(
+            [self.seed & 0x7FFFFFFF, int(rank), 0xC4B2]
+        )
+        return int(rng.integers(lo, hi + 1))
+
+    def join_order(self) -> List[int]:
+        """The deterministic order client ranks enter the fleet (the wave
+        schedule: the launcher spawns from this list as slots free up)."""
+        ranks = np.arange(1, self.population + 1)
+        rng = np.random.default_rng([self.seed & 0x7FFFFFFF, 0x10C4])
+        rng.shuffle(ranks)
+        return [int(r) for r in ranks]
+
+    def client_ranks(self) -> List[int]:
+        return list(range(1, self.population + 1))

@@ -74,6 +74,13 @@ class Introspector:
             ),
             "restarts": st.get("supervisor/restarts", 0),
         }
+        # fleet backpressure, priced on the top-level brief: admission-door
+        # refusals (fedbuff max_workers) and transport-budget sheds
+        # (grpc_stream / mqtt_conn) — so "is the door refusing" never
+        # needs the per-tenant deep route
+        for key in ("joins_refused", "comm/refused", "comm/send_refused"):
+            if key in st:
+                brief[key] = st[key]
         budget = st.get("supervisor/restart_budget")
         if budget is not None:
             brief["restart_budget_remaining"] = int(budget) - int(

@@ -95,6 +95,7 @@ class FedSession:
         scope: Optional[TelemetryScope] = None,
         slo=None,
         device_slice=None,
+        external_clients: bool = False,
     ):
         if algorithm not in SESSION_ALGORITHMS:
             raise ValueError(
@@ -134,6 +135,27 @@ class FedSession:
         self.checkpoint_every = int(checkpoint_every)
         self.resume = bool(resume)
         self.max_workers = max_workers
+        # External-client mode (the fleet runtime, fedml_tpu/fleet/): this
+        # session hosts ONLY the server side of the federation — the client
+        # managers live in other OS processes that dial in over the
+        # comm_factory's wire (gRPC). Sync mode keeps worker_num=K and
+        # waits for K wire ranks; fedbuff mode starts with worker_num=0 so
+        # the entire fleet enters through the C2S_JOIN admission door.
+        self.external_clients = bool(external_clients)
+        if external_clients and comm_factory is None:
+            raise ValueError(
+                "external_clients requires a comm_factory whose rank-0 "
+                "endpoint external processes can reach (e.g. gRPC)"
+            )
+        if external_clients and algorithm == "fedbuff" and max_workers is None:
+            # worker_num starts at 0 in external fedbuff mode, and
+            # max_workers defaults to worker_num — without an explicit cap
+            # every join would be refused at the door of an empty fleet
+            raise ValueError(
+                "external_clients with algorithm=fedbuff requires an "
+                "explicit max_workers admission cap (worker_num starts "
+                "at 0; the default cap would refuse every join)"
+            )
         self.scope = scope
         # the tenant's device/mesh handle (serve/placement.py): every
         # thread this session spawns — and its build — runs under the
@@ -267,26 +289,6 @@ class FedSession:
             # reads its plan); point its fault accounting at the server's
             # registry
             injector.health = server.health
-        shared_train = shared_local_train(self.model, config, self.task)
-        if self.warmup and self.trainer_factory is None:
-            from fedml_tpu.compile import warmup_local_train
-
-            warmup_local_train(
-                shared_train,
-                config,
-                self.data,
-                server.global_vars,
-                # client_ids=None: warm every shape class the PARTITION can
-                # produce, not just the opening cohort's (data/base.py
-                # partition_shape_classes is the enumeration contract)
-                log_fn=self._log,
-            )
-        make_trainer = self.trainer_factory or (
-            lambda rank: LocalTrainer(
-                config, self.data, self.model, self.task,
-                local_train_fn=shared_train,
-            )
-        )
         # one shared error-feedback store: residuals are keyed by client id
         # and the sampler re-assigns clients to ranks each round
         from fedml_tpu.core.compression import ErrorFeedback
@@ -297,13 +299,40 @@ class FedSession:
                 "error_feedback cannot be combined with deadline_s quorum "
                 "rounds: a dropped late upload loses residual-cleared mass"
             )
-        self.clients = [
-            FedAvgClientManager(
-                config, self.comm_factory(rank), rank, make_trainer(rank),
-                ef=shared_ef, faults=injector,
+        if self.external_clients:
+            # fleet mode: the K wire ranks are OS processes the launcher
+            # owns; this session hosts only the server FSM — no client
+            # train program is ever compiled in this process
+            self.clients = []
+            make_trainer = self.trainer_factory
+        else:
+            shared_train = shared_local_train(self.model, config, self.task)
+            if self.warmup and self.trainer_factory is None:
+                from fedml_tpu.compile import warmup_local_train
+
+                warmup_local_train(
+                    shared_train,
+                    config,
+                    self.data,
+                    server.global_vars,
+                    # client_ids=None: warm every shape class the PARTITION
+                    # can produce, not just the opening cohort's (data/base.py
+                    # partition_shape_classes is the enumeration contract)
+                    log_fn=self._log,
+                )
+            make_trainer = self.trainer_factory or (
+                lambda rank: LocalTrainer(
+                    config, self.data, self.model, self.task,
+                    local_train_fn=shared_train,
+                )
             )
-            for rank in range(1, K + 1)
-        ]
+            self.clients = [
+                FedAvgClientManager(
+                    config, self.comm_factory(rank), rank, make_trainer(rank),
+                    ef=shared_ef, faults=injector,
+                )
+                for rank in range(1, K + 1)
+            ]
         self.server = server
         self._injector = injector
         self._make_trainer = make_trainer
@@ -322,36 +351,47 @@ class FedSession:
 
         config = self.config
         K = config.fed.client_num_per_round
+        # external fleet: start with an EMPTY fleet (worker_num=0) — every
+        # wire client announces itself with C2S_JOIN and is admitted or
+        # refused at max_workers (the admission door IS the churn surface)
         server = FedBuffServerManager(
             config,
             self.comm_factory(0),
             self.model,
             data=self.data,
             task=self.task,
-            worker_num=K,
+            worker_num=0 if self.external_clients else K,
             log_fn=self._log,
             max_workers=self.max_workers,
         )
         injector = FaultInjector.from_config(
             config, health=server.health, tracer=get_tracer()
         )
-        # THE shared transport local-train program: deduped through the
-        # process-wide ProgramCache, so this tenant shares compiles with
-        # the sync transports AND every co-tenant of the same model family
-        shared_train = shared_local_train(self.model, config, self.task)
-        make_trainer = self.trainer_factory or (
-            lambda rank: LocalTrainer(
-                config, self.data, self.model, self.task,
-                local_train_fn=shared_train,
+        if self.external_clients:
+            # server-only tenant: the workers are other OS processes on
+            # the comm_factory's wire — building in-process clients here
+            # would bind their ports AND compile a train program this
+            # process never runs
+            self.clients = []
+            make_trainer = self.trainer_factory
+        else:
+            # THE shared transport local-train program: deduped through the
+            # process-wide ProgramCache, so this tenant shares compiles with
+            # the sync transports AND every co-tenant of the same model family
+            shared_train = shared_local_train(self.model, config, self.task)
+            make_trainer = self.trainer_factory or (
+                lambda rank: LocalTrainer(
+                    config, self.data, self.model, self.task,
+                    local_train_fn=shared_train,
+                )
             )
-        )
-        self.clients = [
-            FedBuffClientManager(
-                config, self.comm_factory(rank), rank, make_trainer(rank),
-                faults=injector,
-            )
-            for rank in range(1, K + 1)
-        ]
+            self.clients = [
+                FedBuffClientManager(
+                    config, self.comm_factory(rank), rank, make_trainer(rank),
+                    faults=injector,
+                )
+                for rank in range(1, K + 1)
+            ]
         self.server = server
         self._injector = injector
         self._make_trainer = make_trainer
@@ -901,6 +941,14 @@ class FedSession:
                 )
         if self.scope is not None:
             row["compile/recompiles"] = self.scope.recompiles()
+            # connection/stream refusal pricing (fleet backpressure): how
+            # often this tenant's transports shed inbound work at a budget
+            # — the /status companion to the fedbuff joins_refused door
+            snap = self.scope.comm_meter.snapshot()
+            row["comm/refused"] = sum(snap.get("refused", {}).values())
+            row["comm/send_refused"] = sum(
+                snap.get("send_refused", {}).values()
+            )
         return row
 
     def summary_row(self) -> dict:
@@ -920,6 +968,10 @@ class FedSession:
             row["comm_bytes_sent"] = sum(snap["bytes_sent"].values())
             row["comm/retries"] = sum(snap.get("send_retries", {}).values())
             row["comm/gave_up"] = sum(snap.get("send_gave_up", {}).values())
+            row["comm/refused"] = sum(snap.get("refused", {}).values())
+            row["comm/send_refused"] = sum(
+                snap.get("send_refused", {}).values()
+            )
         if self.flight is not None:
             row.update(self.flight.summary_row())
         if self._slo_watchdog is not None:

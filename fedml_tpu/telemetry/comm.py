@@ -63,6 +63,13 @@ class CommMeter:
         # from model bytes so the piggyback cost is observable
         self._beacons = 0
         self._beacon_bytes = 0
+        # connection/stream shedding (fleet-scale backpressure): the
+        # SERVER side counts what it refused by kind (grpc_stream,
+        # mqtt_conn), the CLIENT side counts sends that came back
+        # refused by message type — both priced on /status so shed
+        # load is observable, never silent
+        self._refused: Dict[str, int] = {}
+        self._send_refused: Dict[str, int] = {}
         r = self.registry
         self._c_sent = r.counter(
             "fedml_comm_messages_sent_total",
@@ -124,6 +131,18 @@ class CommMeter:
             "fedml_comm_beacon_bytes_total",
             "Client telemetry-beacon bytes piggybacked on uploads",
         )
+        self._c_refused = r.counter(
+            "fedml_comm_refused_total",
+            "Inbound connections/streams refused at the server's budget "
+            "(graceful shed, never an unbounded thread/queue explosion)",
+            ("kind",),
+        )
+        self._c_send_refused = r.counter(
+            "fedml_comm_send_refused_total",
+            "Send attempts the remote end refused at its budget "
+            "(RemoteRefusal — redialed under the retry policy)",
+            ("msg_type",),
+        )
 
     # -- hot path (called from BaseCommManager) --
     def on_sent(self, msg_type: str, nbytes: Optional[int], seconds: float) -> None:
@@ -163,6 +182,26 @@ class CommMeter:
                 self._send_gave_up.get(msg_type, 0) + 1
             )
         self._c_gave_up.inc(1, msg_type=msg_type)
+
+    def on_refused(self, kind: str) -> None:
+        """One inbound connection/stream shed at a server-side budget
+        (``grpc_stream`` queue budget, ``mqtt_conn`` connection cap) —
+        metered where the refusal is DECIDED, so the count is exact even
+        when the refused peer never observes it."""
+        with self._lock:
+            self._refused[kind] = self._refused.get(kind, 0) + 1
+        self._c_refused.inc(1, kind=kind)
+
+    def on_send_refused(self, msg_type: str) -> None:
+        """One send attempt the remote end refused at its budget (the
+        client-side mirror of :meth:`on_refused`); the attempt re-enters
+        the retry loop, so a refusal is also counted as a retry unless
+        it exhausted the policy."""
+        with self._lock:
+            self._send_refused[msg_type] = (
+                self._send_refused.get(msg_type, 0) + 1
+            )
+        self._c_send_refused.inc(1, msg_type=msg_type)
 
     def on_uplink(self, payload_bytes: int, raw_bytes: int) -> None:
         """One client model-update upload: its as-shipped payload bytes
@@ -217,6 +256,8 @@ class CommMeter:
                 "downlink_updates": self._downlink_updates,
                 "beacons": self._beacons,
                 "beacon_bytes": self._beacon_bytes,
+                "refused": dict(self._refused),
+                "send_refused": dict(self._send_refused),
             }
 
     def reset(self) -> None:
@@ -237,6 +278,8 @@ class CommMeter:
             self._downlink_updates = 0
             self._beacons = 0
             self._beacon_bytes = 0
+            self._refused.clear()
+            self._send_refused.clear()
 
 
 _GLOBAL: Optional[CommMeter] = None

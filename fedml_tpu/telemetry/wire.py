@@ -252,6 +252,11 @@ class FleetAggregator:
         self._lock = threading.Lock()
         self._digests: Dict[Tuple[str, str], _Digest] = {}
         self._beacons: Dict[str, int] = {}
+        # launcher-side fleet counters (fedml_tpu/fleet/launcher.py):
+        # spawned/completed/refused/reaped etc., folded into the /fleet
+        # payload so one endpoint tells the whole fleet story — the
+        # server-side beacon digests AND the supervisor's process ledger
+        self._launcher: Dict[str, object] = {}
         r = self.registry
         self._g_latency = r.gauge(
             "fedml_fleet_latency_seconds",
@@ -300,6 +305,12 @@ class FleetAggregator:
         except (TypeError, ValueError):
             pass  # malformed beacon values: counted, not charted
 
+    def set_launcher_stats(self, stats: dict) -> None:
+        """Replace the launcher's process-ledger block (bounded: the
+        launcher passes counters, never per-client rows)."""
+        with self._lock:
+            self._launcher = dict(stats)
+
     # -- queries --
     def snapshot(self) -> dict:
         """Plain-dict per-tier percentiles — the ``/fleet`` route payload."""
@@ -319,10 +330,13 @@ class FleetAggregator:
                 }
             for tier, n in self._beacons.items():
                 tiers.setdefault(tier, {"beacons": n, "metrics": {}})
-            return {
+            out = {
                 "beacons": sum(self._beacons.values()),
                 "tiers": tiers,
             }
+            if self._launcher:
+                out["launcher"] = dict(self._launcher)
+            return out
 
     def summary_row(self) -> dict:
         """Flat ``fleet/*`` keys for the MetricsLogger summary row."""
@@ -346,6 +360,7 @@ class FleetAggregator:
         with self._lock:
             self._digests.clear()
             self._beacons.clear()
+            self._launcher.clear()
 
 
 _GLOBAL_FLEET: Optional[FleetAggregator] = None
