@@ -488,6 +488,132 @@ def _uplink_bytes_rows(comm_round=12):
     return out
 
 
+def _splitfed_rows(comm_round=8):
+    """Split federation (docs/SPLITFED.md): boundary-transport throughput
+    vs the fused simulator over IDENTICAL scheduler cohorts, plus the
+    activation-wire byte cut per codec arm read off ``comm/uplink_*`` /
+    ``comm/downlink_*`` (metered at codec time on real boundary
+    payloads). The headline ``rounds_per_sec`` is the TRANSPORT arm —
+    the production path --compare should track; ``sim_rounds_per_sec``
+    prices the wire's overhead against the same compute. Numerics parity
+    (byte for SplitNN, allclose for VFL) lives in tests/test_splitfed.py;
+    this section is the THROUGHPUT + BYTES record."""
+    from fedml_tpu.algorithms.split_nn import SplitNNAPI, default_split_models
+    from fedml_tpu.config import (
+        CommConfig, DataConfig, FedConfig, RunConfig, TrainConfig,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.scheduler import ClientScheduler
+    from fedml_tpu.splitfed.split_transport import run_loopback_splitnn
+    from fedml_tpu.telemetry import get_comm_meter
+
+    total, workers = 8, 4
+    data = synthetic_classification(
+        num_clients=total, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=9,
+    )
+
+    def cfg(comm=None):
+        return RunConfig(
+            data=DataConfig(batch_size=8),
+            fed=FedConfig(
+                client_num_in_total=total, client_num_per_round=workers,
+                comm_round=comm_round, epochs=1,
+                frequency_of_the_test=comm_round,
+            ),
+            train=TrainConfig(
+                client_optimizer="sgd", lr=0.1, momentum=0.9, wd=5e-4
+            ),
+            comm=comm if comm is not None else CommConfig(),
+            seed=11,
+        )
+
+    out = {"label": "splitfed", "comm_round": comm_round,
+           "workers": workers}
+
+    # warm pass compiles the shared boundary/fused programs so both
+    # timed arms dispatch warm (one ProgramCache — the sim's fused step
+    # and the transport's boundary programs are both digested factories)
+    run_loopback_splitnn(cfg(), data)
+
+    t0 = time.perf_counter()
+    server = run_loopback_splitnn(cfg(), data)
+    wire_s = time.perf_counter() - t0
+    out["rounds_per_sec"] = round(comm_round / wire_s, 3)
+    out["final_test_acc"] = round(
+        float(server.history[-1].get("Test/Acc", float("nan"))), 4
+    )
+
+    base = cfg()
+    bottom, top = default_split_models(
+        tuple(data.client_x[0].shape[1:]), data.num_classes
+    )
+    sched = ClientScheduler.from_config(
+        base, num_clients=total, data=data
+    )
+    cohorts = [sched.select(r, k=workers) for r in range(comm_round)]
+    api = SplitNNAPI(bottom, top, lr=base.train.lr,
+                     momentum=base.train.momentum, wd=base.train.wd,
+                     seed=base.seed)
+    # the transport warm pass warmed the BOUNDARY programs; the sim's
+    # fused step is a different digest — one throwaway ring pays its
+    # compile so the timed arms compare dispatch against dispatch
+    SplitNNAPI(
+        bottom, top, lr=base.train.lr, momentum=base.train.momentum,
+        wd=base.train.wd, seed=base.seed,
+    ).train_ring(
+        [(data.client_x[c], data.client_y[c]) for c in cohorts[0]],
+        batch_size=base.data.batch_size,
+        epochs_per_client=base.fed.epochs,
+    )
+    t0 = time.perf_counter()
+    for cohort in cohorts:
+        api.train_ring(
+            [(data.client_x[c], data.client_y[c]) for c in cohort],
+            batch_size=base.data.batch_size,
+            epochs_per_client=base.fed.epochs,
+        )
+    sim_s = time.perf_counter() - t0
+    out["sim_rounds_per_sec"] = round(comm_round / sim_s, 3)
+    out["wire_overhead_x"] = round(wire_s / max(sim_s, 1e-9), 2)
+
+    # activation-wire byte arms: payload vs fp32-equivalent raw bytes
+    # per round, each arm's cut from its OWN metered raw (no cross-arm
+    # denominator), both directions (acts up, activation-grads down)
+    for name, comm in (
+        ("none", CommConfig()),
+        ("int8", CommConfig(activation_compression="int8",
+                            activation_error_feedback=True)),
+        ("int4", CommConfig(activation_compression="int4",
+                            activation_error_feedback=True)),
+    ):
+        snap0 = get_comm_meter().snapshot()
+        arm_server = run_loopback_splitnn(cfg(comm=comm), data)
+        snap1 = get_comm_meter().snapshot()
+        up_p = (snap1["uplink_payload_bytes"]
+                - snap0.get("uplink_payload_bytes", 0))
+        up_r = snap1["uplink_raw_bytes"] - snap0.get("uplink_raw_bytes", 0)
+        dn_p = (snap1["downlink_payload_bytes"]
+                - snap0.get("downlink_payload_bytes", 0))
+        dn_r = (snap1["downlink_raw_bytes"]
+                - snap0.get("downlink_raw_bytes", 0))
+        row = {
+            "acts_up_bytes_per_round": round(up_p / comm_round, 1),
+            "grads_down_bytes_per_round": round(dn_p / comm_round, 1),
+            "final_test_acc": round(
+                float(arm_server.history[-1].get("Test/Acc", float("nan"))),
+                4,
+            ),
+        }
+        if name != "none" and up_p and dn_p:
+            row["cut_up_x"] = round(up_r / up_p, 2)
+            row["cut_down_x"] = round(dn_r / dn_p, 2)
+        out[name] = row
+    if "cut_up_x" in out.get("int4", {}):
+        out["activation_cut_x"] = out["int4"]["cut_up_x"]
+    return out
+
+
 def _bf16_cross_silo(quick: bool = False):
     """resnet56 @ CIFAR cross-silo shapes (benchmark/README.md:105):
     fp32 vs bf16, wall + device + analytic MFU + accuracy parity.
@@ -1684,7 +1810,7 @@ class _Emitter:
         "bf16_cross_silo_resnet56", "flash_attention_s8192",
         "mxu_validation", "scale_100k_clients", "scale_100k_stateful",
         "scale_1m", "fedbuff_async", "wire_fleet", "process_cold_start",
-        "fused_vs_eager", "pipeline", "uplink_bytes",
+        "fused_vs_eager", "pipeline", "uplink_bytes", "splitfed",
     )
 
     def __init__(self, t0: float, detail_path: str,
@@ -1819,6 +1945,11 @@ def _sec_digest(key: str, v) -> str:
         )
     if "cut_x" in v:
         return f"{v['cut_x']}x uplink cut (int4)"
+    if "activation_cut_x" in v:  # splitfed
+        return (
+            f"{v.get('rounds_per_sec')} r/s wire "
+            f"{v['activation_cut_x']}x act cut (int4)"
+        )
     if "rounds_per_sec" in v and "accuracy_gate" in v:  # flagship
         g = v["accuracy_gate"]
         return (
@@ -2324,6 +2455,9 @@ def main():
     def s_uplink():
         emitter.update({"uplink_bytes": _uplink_bytes_rows()})
 
+    def s_splitfed():
+        emitter.update({"splitfed": _splitfed_rows()})
+
     def s_pipeline():
         emitter.update({"pipeline": _pipeline_rounds()})
 
@@ -2388,6 +2522,7 @@ def main():
             ("fused_vs_eager", s_fused_vs_eager, 150, 420),
             ("pipeline", s_pipeline, 60, 300),
             ("uplink_bytes", s_uplink, 40, 240),
+            ("splitfed", s_splitfed, 60, 300),
             ("fedbuff_async", s_fedbuff, 60, 240),
             ("wire_fleet", s_wire_fleet, 60, 480),
             ("process_cold_start", s_cold_start, 80, 420),

@@ -1177,6 +1177,129 @@ cmp "$FGDIR/rec/fault_trace.json" "$FGDIR/rep/fault_trace.json" \
 echo "  fault-trace replay byte-identical ($(wc -c < "$FGDIR/rec/fault_trace.json") bytes)"
 rm -rf "$FGDIR"
 
+echo "== splitfed gate: split tenant co-resident with a horizontal tenant, mid-flight kill + self-heal, metered activation cut (docs/SPLITFED.md) =="
+# ROADMAP item-5 gate. One process, one device, two tenant families:
+# "horiz" (fedavg) and "split_a" (SplitNN relay ring over the boundary
+# transport) run concurrently under ONE recompile budget. split_a is
+# SUPERVISED and killed mid-flight (round 2) — the supervisor restores
+# it from its rolling checkpoint and the final model must be
+# bit-identical to an uninterrupted reference run, int8 activation
+# compression and all. (Stateless int8 on purpose: error-feedback
+# residuals are in-memory per-stream state, not checkpointed — a
+# restart would replay rounds against zeroed accumulators. The
+# error-feedback accuracy contract is pinned in tests/test_splitfed.py
+# instead.) The activation-wire cut factor
+# is READ OFF the tenant's summary comm accounting (on_uplink /
+# on_downlink at codec time), never asserted from codec math. The split
+# family is pre-warmed by the reference run, so the co-resident split
+# tenant must trigger ZERO XLA compiles of its own (the soak stage's
+# cross-tenant sharing gate, now for boundary programs).
+timeout 600 python - <<'PY'
+import json
+
+import jax
+import numpy as np
+
+from fedml_tpu.analysis.sentinel import (
+    RecompileSentinel,
+    ensure_backend_listener,
+)
+from fedml_tpu.config import (
+    CommConfig,
+    DataConfig,
+    FedConfig,
+    RunConfig,
+    TrainConfig,
+)
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.models import create_model
+from fedml_tpu.serve import FederationServer, RestartPolicy, FedSession
+
+def cfg(rounds, workers, total, seed, comm=None, feat=(10,)):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(client_num_in_total=total, client_num_per_round=workers,
+                      comm_round=rounds, epochs=1,
+                      frequency_of_the_test=10**6),
+        train=TrainConfig(client_optimizer="sgd", lr=0.1, momentum=0.9,
+                          wd=5e-4),
+        comm=comm if comm is not None else CommConfig(),
+        seed=seed,
+    )
+
+wire = CommConfig(activation_compression="int8")
+split_data = synthetic_classification(
+    num_clients=8, num_classes=3, feat_shape=(10,), samples_per_client=24,
+    partition_method="homo", seed=0)
+horiz_data = synthetic_classification(
+    num_clients=8, num_classes=4, feat_shape=(16,), samples_per_client=24,
+    partition_method="homo", seed=1)
+horiz_model = create_model("lr", "synthetic", (16,), 4)
+
+ensure_backend_listener()
+# uninterrupted split reference, --warmup AOT path included: every
+# boundary/fused program is compiled HERE, before the service starts
+ref = FedSession(cfg(6, 4, 8, 11, comm=wire), split_data, None,
+                 algorithm="split_nn", warmup=True).run()
+assert ref.round_idx == 6, ref.round_idx
+
+killed = {"done": False}
+def chaos_kill(row):
+    if row.get("round") == 2 and "t_s" in row and not killed["done"]:
+        killed["done"] = True
+        raise RuntimeError("splitfed chaos kill")
+
+import tempfile
+ck_dir = tempfile.mkdtemp(prefix="fedml_splitfed_ci_")
+with RecompileSentinel(budget=24, label="splitfed-service") as sent:
+    srv = FederationServer()
+    horiz = srv.create_session("horiz", cfg(40, 2, 8, 3), horiz_data,
+                               horiz_model, algorithm="fedavg")
+    split = srv.create_session(
+        "split_a", cfg(6, 4, 8, 11, comm=wire), split_data, None,
+        algorithm="split_nn",
+        restart=RestartPolicy(budget=2, backoff_base_s=0.05),
+        checkpoint_path=f"{ck_dir}/ck", checkpoint_every=1,
+        log_fn=chaos_kill)
+    srv.start()
+    results = srv.wait(timeout=420)
+    srv.close()
+sent.check()  # the whole co-resident service fit the recompile budget
+
+assert all(r["ok"] for r in results.values()), results
+# mid-flight kill + self-heal with bit parity to never having died
+assert killed["done"], "the chaos kill never fired"
+assert split.restarts == 1, split.restarts
+assert results["split_a"]["summary"]["supervisor/restarts"] == 1
+for la, lb in zip(jax.tree_util.tree_leaves(ref.global_vars),
+                  jax.tree_util.tree_leaves(split.global_vars)):
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+assert len(horiz.history) == 40, len(horiz.history)
+
+# cut factor off the summary row (the serve analog of summary.json):
+# int8 on float32 activations must show >= 3x in BOTH directions
+summary = json.loads(json.dumps(results["split_a"]["summary"]))
+up = summary["comm/uplink_raw_bytes"] / summary["comm/uplink_payload_bytes"]
+down = (summary["comm/downlink_raw_bytes"]
+        / summary["comm/downlink_payload_bytes"])
+assert summary["comm/uplink_updates"] > 0, summary
+assert up >= 3.0, f"uplink cut {up:.2f}x < 3x"
+assert down >= 3.0, f"downlink cut {down:.2f}x < 3x"
+
+# co-residency program sharing: the split family was warmed by the
+# reference run, so the split tenant itself compiled NOTHING — even
+# across its supervised restart
+assert split.scope.recompiles() == 0, sent.describe()
+
+import shutil
+shutil.rmtree(ck_dir, ignore_errors=True)
+print(f"  splitfed ok: split tenant healed bit-identical after 1 kill "
+      f"co-resident with {len(horiz.history)} fedavg rounds, activation "
+      f"cut {up:.1f}x up / {down:.1f}x down off the comm accounting, "
+      f"split-tenant recompiles == 0 "
+      f"(service paid {sent.recompiles()} within budget 24)")
+PY
+
 echo "== multichip dryrun (DP/SP/TP/EP/PP) =="
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 

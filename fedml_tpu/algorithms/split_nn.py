@@ -52,13 +52,15 @@ def default_split_models(input_shape, num_classes: int, width: int = 32):
             return nn.Dense(num_classes)(a)
 
     bottom = ModelDef(Bottom(), tuple(input_shape), num_classes, name="split_bottom")
-    feat_dim = (
-        width
-        if len(input_shape) == 1
-        else width
-        * max(1, (input_shape[0] + 3) // 4)
-        * max(1, (input_shape[1] + 3) // 4)
+    # the top's input width is whatever the bottom emits — derive it from
+    # an abstract eval of the cut instead of hand-replicating the conv
+    # stride arithmetic (which silently drifts if either changes)
+    x_sds = jax.ShapeDtypeStruct((1,) + tuple(input_shape), jnp.float32)
+    abstract_vars = jax.eval_shape(bottom.module.init, jax.random.PRNGKey(0), x_sds)
+    acts = jax.eval_shape(
+        lambda v, x: bottom.module.apply(v, x, train=False), abstract_vars, x_sds
     )
+    feat_dim = int(acts.shape[-1])
     top = ModelDef(Top(), (feat_dim,), num_classes, name="split_top")
     return bottom, top
 
@@ -75,39 +77,27 @@ class SplitNNAPI:
         wd: float = 5e-4,
         seed: int = 0,
     ):
+        from fedml_tpu.splitfed.programs import (
+            make_split_optimizer,
+            make_splitnn_fused_step,
+        )
+
         self.bottom = bottom
         self.top = top
         k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
         self.bottom_vars = bottom.init(k1)
         self.top_vars = top.init(k2)
         # ref client optimizer: SGD(0.1, momentum=0.9, wd=5e-4) client.py:18-19
-        self.opt = optax.chain(
-            optax.add_decayed_weights(wd), optax.sgd(lr, momentum=momentum)
-        )
+        self.opt = make_split_optimizer(lr, momentum, wd)
         self.opt_state = self.opt.init(
             {"bottom": self.bottom_vars["params"], "top": self.top_vars["params"]}
         )
-        self._step = jax.jit(self._make_step())  # fedlint: disable=uncached-jit -- per-API-instance split step over opaque self state; long-tail driver outside the warmup/dedup path
-
-    def _make_step(self):
-        bottom, top, opt = self.bottom, self.top, self.opt
-
-        def loss_fn(params, x, y):
-            acts, _ = bottom.apply({"params": params["bottom"]}, x, train=True)
-            logits, _ = top.apply({"params": params["top"]}, acts, train=True)
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-            correct = jnp.sum(jnp.argmax(logits, -1) == y)
-            return loss, correct
-
-        def step(params, opt_state, x, y):
-            (loss, correct), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, x, y
-            )
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, correct
-
-        return step
+        # the fused step is a digested ProgramCache factory shared with the
+        # transport runtime (fedml_tpu/splitfed/programs.py) — warmed, deduped,
+        # and persisted like every other program in the stack
+        self._step = make_splitnn_fused_step(
+            bottom, top, lr=lr, momentum=momentum, wd=wd
+        )
 
     def train_ring(self, client_data, batch_size: int = 32, epochs_per_client: int = 1):
         """Relay ring: each client in turn runs its epochs with the shared

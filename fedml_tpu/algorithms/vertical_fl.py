@@ -61,39 +61,26 @@ class VFLAPI:
         lr: float = 0.05,
         seed: int = 0,
     ):
+        from fedml_tpu.splitfed.programs import make_vfl_fused_step
+
         rngs = jax.random.split(jax.random.PRNGKey(seed), len(feature_splits))
         self.parties: List[VFLParty] = [
             VFLParty(d, hidden_dim, out_dim, rngs[i], has_labels=(i == 0))
             for i, d in enumerate(feature_splits)
         ]
+        self.feature_splits = tuple(int(d) for d in feature_splits)
+        self.hidden_dim = int(hidden_dim)
+        self.out_dim = int(out_dim)
         self.opt = optax.sgd(lr, momentum=0.9)
         self.params = [p.params for p in self.parties]
         self.opt_state = self.opt.init(self.params)
-        self._step = jax.jit(self._make_step())  # fedlint: disable=uncached-jit -- per-API-instance VFL step over opaque self state; long-tail driver outside the warmup/dedup path
-
-    def _make_step(self):
-        parties = self.parties
-        opt = self.opt
-
-        def loss_fn(all_params, xs, y):
-            total = sum(
-                p.contribution(pp, x)
-                for p, pp, x in zip(parties, all_params, xs)
-            )
-            logit = total.reshape(-1)
-            loss = optax.sigmoid_binary_cross_entropy(logit, y).mean()
-            correct = jnp.sum((logit > 0) == (y > 0.5))
-            return loss, correct
-
-        def step(all_params, opt_state, xs, y):
-            (loss, correct), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                all_params, xs, y
-            )
-            updates, opt_state = opt.update(grads, opt_state, all_params)
-            all_params = optax.apply_updates(all_params, updates)
-            return all_params, opt_state, loss, correct
-
-        return step
+        # the fused multi-party step is a digested ProgramCache factory keyed
+        # on the feature split + module dims + optimizer config
+        # (fedml_tpu/splitfed/programs.py), shared with the guest/host
+        # transport runtime
+        self._step = make_vfl_fused_step(
+            self.feature_splits, hidden_dim=hidden_dim, out_dim=out_dim, lr=lr
+        )
 
     def train_epoch(self, xs_parties: Sequence[np.ndarray], y: np.ndarray, batch_size: int = 32):
         n = len(y)

@@ -204,6 +204,41 @@ def _model(ctx: dict):
     return ctx["model"]
 
 
+def _split_models(ctx: dict, width: int = 32):
+    """Bottom/top ModelDef pair for one SplitNN cut width — the '@width'
+    kwarg perturbation moves the CUT LAYER (a wider bottom emits a wider
+    activation), which must split the digest (splitnn_cut_spec's model
+    fingerprints)."""
+    key = f"split_models_w{width}"
+    if key not in ctx:
+        from fedml_tpu.algorithms.split_nn import default_split_models
+
+        ctx[key] = default_split_models(FEAT, NCLS, width=width)
+    return ctx[key]
+
+
+def _vfl_party_shapes(feature_dim: int, hidden_dim: int, out_dim: int,
+                      has_labels: bool):
+    """Abstract param shapes for one VFL party (extractor + dense head),
+    matching algorithms/vertical_fl.py VFLParty.params."""
+    import jax
+    import numpy as np
+
+    from fedml_tpu.models.vfl import VFLClassifier, VFLFeatureExtractor
+
+    ex = VFLFeatureExtractor(output_dim=hidden_dim)
+    de = VFLClassifier(output_dim=out_dim, use_bias=has_labels)
+    k = jax.random.PRNGKey(0)
+    return {
+        "extractor": jax.eval_shape(
+            ex.init, k, _sds((1, feature_dim), np.float32)
+        ),
+        "dense": jax.eval_shape(
+            de.init, k, _sds((1, hidden_dim), np.float32)
+        ),
+    }
+
+
 def _mesh(ctx: dict):
     if "mesh" not in ctx:
         from fedml_tpu.parallel.mesh import make_mesh
@@ -241,6 +276,7 @@ _CHOICE_VALUES: Dict[str, Any] = {
     "fed.state_store": "mmap",
     "server.server_optimizer": "adam",
     "comm.compression": "int8",
+    "comm.activation_compression": "int8",
     "model": "mlp",
 }
 
@@ -279,6 +315,11 @@ KNOWN_BENIGN = frozenset({
     "fed.async_staleness_exp", "fed.async_server_lr", "fed.state_store",
     "fed.state_budget_bytes", "fed.state_dir",
     "comm.compression", "comm.topk_frac", "comm.error_feedback",
+    # activation-wire compression (fedml_tpu/splitfed/codec.py): encode/
+    # decode run HOST-SIDE on the boundary payloads between dispatches —
+    # the traced forward/server-step/backward programs see plain float32
+    # arrays either way, so neither leaf can reach a program
+    "comm.activation_compression", "comm.activation_error_feedback",
     "comm.secure_agg", "comm.send_retries", "comm.send_backoff_s",
     "comm.send_backoff_max_s", "comm.send_retry_deadline_s",
     "comm.send_timeout_s", "comm.send_fault_p", "comm.beacons",
@@ -589,6 +630,90 @@ def default_specs() -> List[FactorySpec]:
             _sds((Cm,), np.int32),
         ) + _cohort(cfg, Cm)
 
+    def splitnn_fused_build(cfg, ctx, kw):
+        from fedml_tpu.splitfed.programs import make_splitnn_fused_step
+
+        bottom, top = _split_models(ctx, kw.get("width", 32))
+        return make_splitnn_fused_step(
+            bottom, top, lr=cfg.train.lr, momentum=cfg.train.momentum,
+            wd=cfg.train.wd,
+        )
+
+    def splitnn_fused_args(cfg, ctx, kw):
+        import jax
+        import numpy as np
+
+        from fedml_tpu.splitfed.programs import make_split_optimizer
+
+        bottom, top = _split_models(ctx, kw.get("width", 32))
+        params = {
+            "bottom": _gv_shapes(bottom)["params"],
+            "top": _gv_shapes(top)["params"],
+        }
+        opt = make_split_optimizer(
+            cfg.train.lr, cfg.train.momentum, cfg.train.wd
+        )
+        return (
+            params,
+            jax.eval_shape(opt.init, params),
+            _sds((B,) + FEAT, np.float32),
+            _sds((B,), np.int32),
+        )
+
+    def splitnn_server_build(cfg, ctx, kw):
+        from fedml_tpu.splitfed.programs import make_splitnn_server_step
+
+        _bottom, top = _split_models(ctx, kw.get("width", 32))
+        return make_splitnn_server_step(
+            top, cfg.train.lr, cfg.train.momentum, cfg.train.wd
+        )
+
+    def splitnn_server_args(cfg, ctx, kw):
+        import jax
+        import numpy as np
+
+        from fedml_tpu.splitfed.programs import make_split_optimizer
+
+        width = kw.get("width", 32)
+        _bottom, top = _split_models(ctx, width)
+        tp = _gv_shapes(top)["params"]
+        opt = make_split_optimizer(
+            cfg.train.lr, cfg.train.momentum, cfg.train.wd
+        )
+        return (
+            tp,
+            jax.eval_shape(opt.init, tp),
+            _sds((B, width), np.float32),
+            _sds((B,), np.int32),
+        )
+
+    def vfl_fused_build(cfg, ctx, kw):
+        from fedml_tpu.splitfed.programs import make_vfl_fused_step
+
+        return make_vfl_fused_step(
+            kw["feature_splits"], hidden_dim=kw.get("hidden_dim", 16),
+            out_dim=1, lr=cfg.train.lr,
+        )
+
+    def vfl_fused_args(cfg, ctx, kw):
+        import jax
+        import numpy as np
+        import optax
+
+        splits = kw["feature_splits"]
+        hd = kw.get("hidden_dim", 16)
+        all_params = [
+            _vfl_party_shapes(d, hd, 1, i == 0)
+            for i, d in enumerate(splits)
+        ]
+        opt = optax.sgd(cfg.train.lr, momentum=0.9)
+        return (
+            all_params,
+            jax.eval_shape(opt.init, all_params),
+            [_sds((B, d), np.float32) for d in splits],
+            _sds((B,), np.float32),
+        )
+
     # Every spec audits the FULL auto-derived fan-out (every unclassified
     # RunConfig leaf) — the hand-curated per-factory subsets this
     # replaces silently exempted new knobs. Factory-kwarg perturbations
@@ -660,6 +785,29 @@ def default_specs() -> List[FactorySpec]:
                 Perturbation("@robust.stddev", 0.5),
             ],
             kwargs={"robust": _robust_config(defense_type="weak_dp")},
+        ),
+        # The split/vertical factories (PR 19, fedml_tpu/splitfed/): the
+        # cut spec is the hazard surface — '@width' moves the SplitNN cut
+        # layer (both model fingerprints change), '@feature_splits' /
+        # '@hidden_dim' move the VFL party layout; lr/momentum/wd ride
+        # the auto fan-out (train.*) and are baked into the traced
+        # updates exactly like scaffold's eta_g.
+        FactorySpec(
+            "splitnn_fused_step", splitnn_fused_build, splitnn_fused_args,
+            _AUTO_FANOUT + [Perturbation("@width", 48)],
+        ),
+        FactorySpec(
+            "splitnn_server_step", splitnn_server_build, splitnn_server_args,
+            _AUTO_FANOUT + [Perturbation("@width", 48)],
+        ),
+        FactorySpec(
+            "vfl_fused_step", vfl_fused_build, vfl_fused_args,
+            _AUTO_FANOUT + [
+                Perturbation("@feature_splits", (4, 3, 2, 1)),
+                Perturbation("@feature_splits", (5, 5)),
+                Perturbation("@hidden_dim", 8),
+            ],
+            kwargs={"feature_splits": (4, 3, 3)},
         ),
         FactorySpec("eval", eval_build, eval_args, _AUTO_FANOUT),
         FactorySpec(

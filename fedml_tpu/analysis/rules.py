@@ -58,8 +58,8 @@ CONFIG_ROOTS = ("config", "cfg")
 
 # Directories (relative to the package root) whose jit programs are the
 # training hot path — scope of uncached-jit / host-sync / nondet rules.
-HOT_DIRS = ("algorithms", "parallel", "train", "ops")
-JIT_RULE_DIRS = ("algorithms", "parallel")
+HOT_DIRS = ("algorithms", "parallel", "train", "ops", "splitfed")
+JIT_RULE_DIRS = ("algorithms", "parallel", "splitfed")
 
 # Function names that are traced by convention in this codebase (round
 # bodies, local-train loops, scan bodies). Anything nested inside one —

@@ -495,9 +495,12 @@ def _validate_comm_retry(config, opt) -> None:
 # scaffold/ditto/dp_fedavg/hierarchical run bespoke train_round loops
 # (their _build_round_fn is None or their cohorts reshape per group/draw),
 # so warming there would either no-op or compile a program the run never
-# dispatches — strictly worse than no flag.
+# dispatches — strictly worse than no flag. split_nn joined in PR 19:
+# its fused/boundary/eval programs are digested ProgramCache factories
+# warmed by compile/warmup.py:warmup_splitnn before round 0.
 _WARMUP_ALGOS = (
     "fedavg", "fedprox", "fedopt", "fednova", "qfedavg", "fedavg_robust",
+    "split_nn",
 )
 
 
@@ -1532,9 +1535,16 @@ def _run_split_nn(config, data, model, task, log_fn, opt):
 
     shape = tuple(data.client_x[0].shape[1:])
     bottom, top = default_split_models(shape, data.num_classes)
+    if config.compile.warmup:
+        # the split programs (fused step + boundary triple + eval) are
+        # ProgramCache factories like the horizontal family's — --warmup
+        # AOT-compiles them before round 0 (fedml_tpu/compile/warmup.py)
+        from fedml_tpu.compile import warmup_splitnn
+
+        warmup_splitnn(bottom, top, config, data, log_fn=log_fn)
     api = SplitNNAPI(
         bottom, top, lr=config.train.lr, momentum=config.train.momentum,
-        seed=config.seed,
+        wd=config.train.wd, seed=config.seed,
     )
     clients = _client_shards_list(data, config.fed.client_num_per_round)
     final = {}

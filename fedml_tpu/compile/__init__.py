@@ -51,7 +51,11 @@ from fedml_tpu.compile.program_cache import (
     hooks_cacheable,
     use_program_cache,
 )
-from fedml_tpu.compile.warmup import warmup_api, warmup_local_train
+from fedml_tpu.compile.warmup import (
+    warmup_api,
+    warmup_local_train,
+    warmup_splitnn,
+)
 
 __all__ = [
     "CachedProgram",
@@ -78,6 +82,7 @@ __all__ = [
     "use_program_cache",
     "warmup_api",
     "warmup_local_train",
+    "warmup_splitnn",
 ]
 
 

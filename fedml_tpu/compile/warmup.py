@@ -460,6 +460,114 @@ def warmup_api(api, log_fn: Optional[Callable[[dict], None]] = None) -> dict:
     return rows
 
 
+def warmup_splitnn(
+    bottom,
+    top,
+    config,
+    data,
+    log_fn: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """Warm every program a split federation dispatches — the boundary-cut
+    triple (client forward, server top-step, client backward), the fused
+    simulator step they must stay byte-parity with, and the eval program —
+    for the run's one activation shape class (``batch_size`` × the cut
+    width, derived via ``jax.eval_shape`` so no real forward runs).
+
+    Split rounds are a RELAY: a cold boundary compile stalls not just one
+    client but every later ring slot behind it, so the warmup barrier
+    matters more here than in the horizontal family. All five factories
+    route through the ProgramCache, so with a persistent executable store
+    installed the warmed set deserializes on the next process start."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.splitfed.programs import (
+        make_split_optimizer,
+        make_splitnn_client_backward,
+        make_splitnn_client_forward,
+        make_splitnn_eval,
+        make_splitnn_fused_step,
+        make_splitnn_server_step,
+        merge_opt_state,
+    )
+
+    tracer = get_tracer()
+    rows: dict = {}
+    t0 = time.perf_counter()
+    cfg = config
+    lr = cfg.train.lr
+    momentum = cfg.train.momentum
+    wd = cfg.train.wd
+    bs = int(cfg.data.batch_size)
+    feat = tuple(np.asarray(data.client_x[0]).shape[1:])
+    xdt = np.asarray(data.client_x[0]).dtype
+    ydt = np.asarray(data.client_y[0]).dtype
+    # params only drive shapes here — same init path as the transport
+    k1, k2 = jax.random.split(jax.random.PRNGKey(cfg.seed))
+    x0 = jnp.zeros((1,) + feat, jnp.float32)
+    bp = jax.device_get(bottom.module.init(k1, x0)["params"])
+    acts_sds = jax.eval_shape(
+        lambda v, x: bottom.module.apply({"params": v}, x, train=False),
+        bp,
+        jax.ShapeDtypeStruct((bs,) + feat, jnp.float32),
+    )
+    tp = jax.device_get(
+        top.module.init(k2, jnp.zeros((1,) + acts_sds.shape[1:]))["params"]
+    )
+    opt = make_split_optimizer(lr, momentum, wd)
+    b_opt = jax.device_get(opt.init(bp))
+    t_opt = jax.device_get(opt.init(tp))
+    xb = np.zeros((bs,) + feat, xdt)
+    yb = np.zeros((bs,), ydt)
+    acts = np.zeros(acts_sds.shape, np.float32)
+    with tracer.span("warmup", programs="splitfed"):
+        _warm_one(
+            rows,
+            "split_forward",
+            make_splitnn_client_forward(bottom),
+            (bp, xb),
+            tracer,
+        )
+        _warm_one(
+            rows,
+            "split_server_step",
+            make_splitnn_server_step(top, lr, momentum, wd),
+            (tp, t_opt, acts, yb),
+            tracer,
+        )
+        _warm_one(
+            rows,
+            "split_backward",
+            make_splitnn_client_backward(bottom, lr, momentum, wd),
+            (bp, b_opt, xb, acts),
+            tracer,
+        )
+        _warm_one(
+            rows,
+            "split_fused",
+            make_splitnn_fused_step(bottom, top, lr=lr, momentum=momentum, wd=wd),
+            (
+                {"bottom": bp, "top": tp},
+                merge_opt_state(opt, b_opt, t_opt, bp, tp),
+                xb,
+                yb,
+            ),
+            tracer,
+        )
+        _warm_one(
+            rows,
+            "split_eval",
+            make_splitnn_eval(bottom, top),
+            (bp, tp, xb, yb),
+            tracer,
+        )
+    rows["compile/warmup_s"] = time.perf_counter() - t0
+    if log_fn is not None:
+        log_fn(dict(rows))
+    return rows
+
+
 def warmup_local_train(
     shared_train,
     config,

@@ -245,6 +245,20 @@ class CommConfig:
     # identically. The eventual successful attempt delivers exactly once
     # (numerics identical to a fault-free run). Requires send_retries > 0.
     send_fault_p: float = 0.0
+    # Boundary-wire quantization for the split/vertical runtimes
+    # (fedml_tpu/splitfed/codec.py): per-batch activations, activation
+    # grads, and VFL logit contributions ship int8/int4-quantized through
+    # the same codec registry the model path uses (topk variants are
+    # delta-sparsity codecs — activations are dense, so they're
+    # rejected). "none" ships fp32 tensors. Metered per boundary message
+    # (comm/uplink_* for acts/contribs, comm/downlink_* for grads), so
+    # the cut factor is read off comm/*, never asserted.
+    activation_compression: str = "none"
+    # Per-stream residual memory over the boundary tensors: each
+    # direction of each (peer, shape) stream folds its quantization
+    # error into the next same-shape tensor before encoding (the split
+    # analogue of error_feedback's per-client residual).
+    activation_error_feedback: bool = False
     # Secure aggregation in the round loop (ref distributed turboaggregate):
     # clients upload pairwise-masked field vectors of their weighted
     # deltas; the server only ever sums masked uploads, and a quorum round

@@ -53,6 +53,23 @@ class MessageType:
     # paying dead-peer timeouts / dispatching into a drained inbox)
     C2S_JOIN = "c2s_join"
     C2S_LEAVE = "c2s_leave"
+    # split learning boundary protocol (fedml_tpu/splitfed/): the server
+    # hands the relay turn (bottom weights + bottom optimizer state) to one
+    # client at a time; per batch the client uploads cut-layer ACTIVATIONS
+    # and the server returns the ACTIVATION GRADIENTS (ref
+    # fedml_api/distributed/split_nn client.py forward/backward exchange);
+    # the turn ends with the client returning its updated bottom state
+    S2C_SPLIT_TURN = "s2c_split_turn"
+    C2S_SPLIT_ACTS = "c2s_split_acts"
+    S2C_SPLIT_GRADS = "s2c_split_grads"
+    C2S_SPLIT_DONE = "c2s_split_done"
+    # classical vertical FL (fedml_tpu/splitfed/vfl_transport.py): the
+    # guest (labels) polls every host for its per-batch logit contribution
+    # h_k and answers with dL/dh_k (ref classical_vertical_fl
+    # guest_trainer/host_trainer exchange)
+    S2C_VFL_BATCH = "s2c_vfl_batch"
+    C2S_VFL_CONTRIB = "c2s_vfl_contrib"
+    S2C_VFL_GRADS = "s2c_vfl_grads"
 
     # param keys
     ARG_MODEL_PARAMS = "model_params"
@@ -96,6 +113,25 @@ class MessageType:
     # piggybacked on model uploads — observability sidecar, never read by
     # the aggregation path, so numerics are byte-identical with it on/off
     ARG_TELEMETRY = "telemetry"
+    # split/vertical boundary payloads (fedml_tpu/splitfed/). Activations
+    # and activation-grads optionally travel COMPRESSED (ARG_ACT_PAYLOAD +
+    # ARG_ACT_CODEC naming the codec, core/compression.py) instead of the
+    # raw ARG_ACTIVATIONS / ARG_ACT_GRADS array — the receiver decodes by
+    # the protocol tag, exactly like the model-delta uplink.
+    ARG_ACTIVATIONS = "activations"
+    ARG_ACT_GRADS = "act_grads"
+    ARG_ACT_PAYLOAD = "act_payload"
+    ARG_ACT_CODEC = "act_codec"
+    ARG_BATCH_LABELS = "batch_labels"
+    ARG_BATCH_IDX = "batch_idx"
+    ARG_OPT_STATE = "opt_state"
+    # relay-turn decline: the fault plan crashed/dropped this client's
+    # turn — the server passes the unchanged bottom state to the next
+    # client in the ring instead of waiting on batches that never come
+    ARG_SKIPPED = "skipped"
+    # VFL host logit contribution h_k and its returned gradient dL/dh_k
+    ARG_CONTRIB = "contrib"
+    ARG_CONTRIB_GRAD = "contrib_grad"
 
 
 class Message:

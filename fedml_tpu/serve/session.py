@@ -49,7 +49,7 @@ from typing import Callable, List, Optional
 from fedml_tpu.config import RunConfig
 from fedml_tpu.telemetry import TelemetryScope, activate_scope, current_scope, get_tracer
 
-SESSION_ALGORITHMS = ("fedavg", "fedprox", "fedopt", "fedbuff")
+SESSION_ALGORITHMS = ("fedavg", "fedprox", "fedopt", "fedbuff", "split_nn")
 SESSION_RUNTIMES = ("loopback", "shm", "mqtt")
 
 
@@ -338,6 +338,60 @@ class FedSession:
         self._make_trainer = make_trainer
         self._next_rank = K + 1
 
+    def _build_splitnn(self):
+        """Split-learning tenant (fedml_tpu/splitfed/): server = top half
+        + relay-ring FSM, one client actor per ring slot. Rides the sync
+        checkpoint/restore/status machinery — the split server speaks the
+        same ``global_vars`` / ``_server_opt_state`` / ``round_idx``
+        dialect (both param groups + the fused optimizer tree land in the
+        rolling checkpoint). No deadline_s requirement under a fault
+        plan: the ring has no quorum barrier — a faulted turn is
+        declined explicitly and the relay advances deterministically."""
+        from fedml_tpu.scheduler import FaultInjector
+        from fedml_tpu.splitfed.split_transport import (
+            SplitNNClientManager,
+            SplitNNServerManager,
+        )
+
+        config = self.config
+        K = config.fed.client_num_per_round
+        injector = FaultInjector.from_config(config, tracer=get_tracer())
+        if self.model is not None:
+            bottom, top = self.model
+        else:
+            from fedml_tpu.algorithms.split_nn import default_split_models
+
+            bottom, top = default_split_models(
+                tuple(self.data.client_x[0].shape[1:]), self.data.num_classes
+            )
+        server = SplitNNServerManager(
+            config,
+            self.comm_factory(0),
+            bottom,
+            top,
+            data=self.data,
+            worker_num=K,
+            log_fn=self._log,
+            faults=injector,
+        )
+        if injector is not None:
+            injector.health = server.health
+        if self.warmup:
+            from fedml_tpu.compile import warmup_splitnn
+
+            warmup_splitnn(bottom, top, config, self.data, log_fn=self._log)
+        self.clients = [
+            SplitNNClientManager(
+                config, self.comm_factory(rank), rank, bottom, self.data,
+                faults=injector,
+            )
+            for rank in range(1, K + 1)
+        ]
+        self.server = server
+        self._injector = injector
+        self._make_trainer = None
+        self._next_rank = K + 1
+
     def _build_fedbuff(self):
         from fedml_tpu.algorithms.fedavg_transport import (
             LocalTrainer,
@@ -590,6 +644,8 @@ class FedSession:
                 self.comm_factory = self._default_comm_factory()
             if self.mode == "fedbuff":
                 self._build_fedbuff()
+            elif self.algorithm == "split_nn":
+                self._build_splitnn()
             else:
                 self._build_sync()
             if self.flight is not None:
@@ -966,6 +1022,18 @@ class FedSession:
             snap = self.scope.comm_meter.snapshot()
             row["comm_messages_sent"] = sum(snap["messages_sent"].values())
             row["comm_bytes_sent"] = sum(snap["bytes_sent"].values())
+            # codec payload accounting: uplink for model updates AND the
+            # splitfed activation wire, downlink for broadcasts /
+            # activation-grads — raw/payload is the measured cut factor
+            for key in (
+                "uplink_payload_bytes",
+                "uplink_raw_bytes",
+                "uplink_updates",
+                "downlink_payload_bytes",
+                "downlink_raw_bytes",
+                "downlink_updates",
+            ):
+                row[f"comm/{key}"] = snap.get(key, 0)
             row["comm/retries"] = sum(snap.get("send_retries", {}).values())
             row["comm/gave_up"] = sum(snap.get("send_gave_up", {}).values())
             row["comm/refused"] = sum(snap.get("refused", {}).values())

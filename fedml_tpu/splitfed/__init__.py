@@ -1,0 +1,82 @@
+"""Split & vertical federation as first-class citizens.
+
+The split-learning (SplitNN ring relay) and classical vertical-FL
+(guest/host) runtimes with the same layer stack the horizontal family
+has: explicit boundary messages through :class:`BaseCommManager`
+(``core/message.py`` S2C_SPLIT_* / *_VFL_*), digested ProgramCache
+factories for every boundary-cut and fused program (:mod:`.programs`),
+activation-wire compression (:mod:`.codec`), scheduler/fault/serve
+integration (:mod:`.split_transport`, :mod:`.vfl_transport`). See
+docs/SPLITFED.md.
+
+Transports import lazily (PEP 562) so the compile-layer factories stay
+importable from ``algorithms/`` without dragging in the serve stack.
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.splitfed.codec import BOUNDARY_CODECS, ActivationCodec
+from fedml_tpu.splitfed.programs import (
+    make_split_optimizer,
+    make_splitnn_client_backward,
+    make_splitnn_client_forward,
+    make_splitnn_eval,
+    make_splitnn_fused_step,
+    make_splitnn_server_step,
+    make_vfl_fused_step,
+    make_vfl_guest_grad,
+    make_vfl_party_forward,
+    make_vfl_party_update,
+    merge_opt_state,
+    merge_party_opt_states,
+    split_opt_state,
+    split_party_opt_states,
+    splitnn_cut_spec,
+    vfl_spec,
+)
+
+_LAZY = {
+    "SplitNNServerManager": "fedml_tpu.splitfed.split_transport",
+    "SplitNNClientManager": "fedml_tpu.splitfed.split_transport",
+    "run_loopback_splitnn": "fedml_tpu.splitfed.split_transport",
+    "VFLGuestManager": "fedml_tpu.splitfed.vfl_transport",
+    "VFLHostManager": "fedml_tpu.splitfed.vfl_transport",
+    "run_loopback_vfl": "fedml_tpu.splitfed.vfl_transport",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+__all__ = [
+    "ActivationCodec",
+    "BOUNDARY_CODECS",
+    "SplitNNClientManager",
+    "SplitNNServerManager",
+    "VFLGuestManager",
+    "VFLHostManager",
+    "make_split_optimizer",
+    "make_splitnn_client_backward",
+    "make_splitnn_client_forward",
+    "make_splitnn_eval",
+    "make_splitnn_fused_step",
+    "make_splitnn_server_step",
+    "make_vfl_fused_step",
+    "make_vfl_guest_grad",
+    "make_vfl_party_forward",
+    "make_vfl_party_update",
+    "merge_opt_state",
+    "merge_party_opt_states",
+    "run_loopback_splitnn",
+    "run_loopback_vfl",
+    "split_opt_state",
+    "split_party_opt_states",
+    "splitnn_cut_spec",
+    "vfl_spec",
+]

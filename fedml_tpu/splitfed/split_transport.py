@@ -1,0 +1,584 @@
+"""Distributed split learning over the Message/Observer transport (ref:
+fedml_api/distributed/split_nn/{SplitNNAPI.py, client.py, server.py,
+message_define.py}).
+
+The server owns the top half and the round FSM; clients own the bottom
+half and their local shards. Per relay turn (one active client at a
+time, ref client.py:12-13 ring neighbors):
+
+1. server → client ``S2C_SPLIT_TURN``: the shared bottom params + bottom
+   optimizer state — the relay hand-off that the reference implements as
+   client→client weight passing, centralized here so the scheduler's
+   SelectionPolicy (not a hardcoded neighbor list) decides the ring
+   order and so a dead client can be skipped without re-wiring the ring;
+2. per batch, client → server ``C2S_SPLIT_ACTS`` (cut-layer activations,
+   optionally int8/int4-quantized — :mod:`fedml_tpu.splitfed.codec`) and
+   server → client ``S2C_SPLIT_GRADS`` (∂L/∂acts, ref server.py:40-60
+   ``acts.grad``) while the server updates its top half;
+3. client → server ``C2S_SPLIT_DONE``: the updated bottom params + opt
+   state (or a ``skipped`` decline when the fault plan crashed/dropped
+   the turn — the ring advances instead of hanging on batches that will
+   never come; that decline IS the deterministic-recovery contract, and
+   it differs on purpose from the horizontal family's silent crash,
+   which a quorum deadline absorbs there but nothing would absorb here).
+
+All numerics run through the digested ProgramCache factories in
+:mod:`fedml_tpu.splitfed.programs`; the composition over the wire is
+bit-identical to the fused :class:`SplitNNAPI` simulator step
+(tests/test_splitfed.py pins ``assert_array_equal``). Retries, comm
+metering, wire-trace propagation, and flight-recorder phases
+(``forward``/``boundary``/``backward``) all arrive through the standard
+``BaseCommManager``/tracer wiring points."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.config import RunConfig
+from fedml_tpu.core.comm import BaseCommManager
+from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+from fedml_tpu.core.managers import ClientManager, ServerManager
+from fedml_tpu.core.message import Message, MessageType as MT
+from fedml_tpu.core import compression as CZ
+from fedml_tpu.models import ModelDef
+from fedml_tpu.splitfed.codec import BOUNDARY_CODECS, ActivationCodec
+from fedml_tpu.splitfed.programs import (
+    make_split_optimizer,
+    make_splitnn_client_backward,
+    make_splitnn_client_forward,
+    make_splitnn_eval,
+    make_splitnn_server_step,
+    merge_opt_state,
+    split_opt_state,
+)
+from fedml_tpu.telemetry import ClientHealthRegistry, get_comm_meter, get_tracer
+
+
+def _host_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda a: np.ascontiguousarray(np.asarray(a)), tree
+    )
+
+
+def _tree_bytes(tree) -> int:
+    return 4 * sum(int(np.size(a)) for a in jax.tree_util.tree_leaves(tree))
+
+
+def _opt_leaves(state) -> list:
+    """Optimizer state as a flat leaf list — the wire representation
+    (FTM1 params carry dict/list pytrees, not optax namedtuples); the
+    receiver re-brackets against its local eval_shape template."""
+    return [
+        np.ascontiguousarray(np.asarray(leaf))
+        for leaf in jax.tree_util.tree_leaves(state)
+    ]
+
+
+def _opt_unflatten(opt, params, leaves):
+    template = jax.eval_shape(opt.init, params)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), list(leaves)
+    )
+
+
+class SplitNNServerManager(ServerManager):
+    """Top-half owner + relay-ring FSM (ref server.py + SplitNNAPI.py
+    run loop). Rank 0."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        comm: BaseCommManager,
+        bottom: ModelDef,
+        top: ModelDef,
+        data=None,
+        worker_num: Optional[int] = None,
+        log_fn=None,
+        faults=None,
+    ):
+        super().__init__(comm, rank=0, config=config)
+        self.config = config
+        self.bottom = bottom
+        self.top = top
+        self.data = data
+        self.log_fn = log_fn or (lambda m: None)
+        self.worker_num = worker_num or config.fed.client_num_per_round
+        ac = getattr(config.comm, "activation_compression", "none")
+        if ac not in BOUNDARY_CODECS:
+            raise ValueError(
+                f"activation_compression supports {BOUNDARY_CODECS}; got {ac!r}"
+            )
+        self.faults = faults
+        lr, mom, wd = config.train.lr, config.train.momentum, config.train.wd
+        # the two halves init exactly like the fused simulator
+        # (SplitNNAPI.__init__) so sim and transport start bit-identical
+        k1, k2 = jax.random.split(jax.random.PRNGKey(config.seed))
+        self._bottom_params = jax.device_get(bottom.init(k1))["params"]
+        self._top_params = jax.device_get(top.init(k2))["params"]
+        self._opt = make_split_optimizer(lr, mom, wd)
+        self._server_optimizer = self._opt  # session checkpoint contract
+        self._bottom_opt_state = self._opt.init(self._bottom_params)
+        self._top_opt_state = self._opt.init(self._top_params)
+        self._server_step = make_splitnn_server_step(top, lr, mom, wd)
+        self._eval = make_splitnn_eval(bottom, top) if data is not None else None
+        self._codec = ActivationCodec.from_config(config.comm)
+        # round/turn FSM state — handlers run on the comm receive thread;
+        # the lock serializes round completion against request_stop
+        self.round_idx = 0
+        self.history: List[dict] = []
+        self._round_lock = threading.Lock()
+        self._stop_requested = False
+        self._federation_done = False
+        self._dead_workers: set = set()
+        self._cohort: List[int] = []
+        self._turn_pos = 0
+        self._next_batch = 0
+        self._done_seen: set = set()
+        self._loss_sum = 0.0
+        self._batches = 0
+        self.skipped_turns = 0
+        self.dropped_boundary = 0  # stale/duplicate boundary msgs discarded
+        self._round_span = None
+        self._tracer = get_tracer()
+        self.health = ClientHealthRegistry.from_config(config).attach(self._tracer)
+        from fedml_tpu.scheduler import ClientScheduler
+
+        # the SAME policy driver the horizontal family uses — the ring
+        # order IS the selected cohort's order, so ring selection inherits
+        # every registered SelectionPolicy (and the restore-time memo)
+        self.scheduler = ClientScheduler.from_config(
+            config,
+            num_clients=config.fed.client_num_in_total,
+            data=data,
+            log_fn=self.log_fn,
+            health=self.health,
+            tracer=self._tracer,
+        )
+
+    # -- session/checkpoint surface (serve/session.py speaks this exact
+    #    dialect to every sync server family) --
+    @property
+    def global_vars(self) -> dict:
+        return {"params": {"bottom": self._bottom_params, "top": self._top_params}}
+
+    @global_vars.setter
+    def global_vars(self, tree: dict) -> None:
+        self._bottom_params = tree["params"]["bottom"]
+        self._top_params = tree["params"]["top"]
+
+    @property
+    def _server_opt_state(self):
+        """Both halves' optimizer states as ONE fused tree over the joint
+        param dict — a split checkpoint row looks exactly like a
+        horizontal one (programs.merge_opt_state is the exact inverse of
+        the per-group split)."""
+        return merge_opt_state(
+            self._opt,
+            self._bottom_opt_state,
+            self._top_opt_state,
+            self._bottom_params,
+            self._top_params,
+        )
+
+    @_server_opt_state.setter
+    def _server_opt_state(self, fused_state) -> None:
+        self._bottom_opt_state, self._top_opt_state = split_opt_state(
+            self._opt, fused_state, self._bottom_params, self._top_params
+        )
+
+    def finish(self):
+        self.health.detach()
+        super().finish()
+
+    def request_stop(self, drain: bool = True) -> None:
+        """Graceful per-tenant stop (fedml_tpu/serve/): drain lets the
+        open round's relay finish; drain=False closes the round now with
+        the turns already completed (the active turn's in-flight boundary
+        messages round-tag-drop harmlessly)."""
+        self._stop_requested = True
+        if drain:
+            return
+        with self._round_lock:
+            if not self._federation_done:
+                self._complete_round()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MT.C2S_SPLIT_ACTS, self._on_acts)
+        self.register_message_receive_handler(MT.C2S_SPLIT_DONE, self._on_done)
+
+    def send_init_msg(self):
+        self._t0 = time.monotonic()
+        self._start_round()
+
+    def _broadcast(self, msg: Message) -> bool:
+        """Dead-peer-tolerant send (same contract as the FedAvg server's):
+        a crashed client process must not take the ring FSM down — its
+        turn is skipped and the relay advances."""
+        worker = msg.get_receiver_id()
+        if worker in self._dead_workers:
+            return False
+        try:
+            self.send_message(msg)
+            return True
+        except Exception as e:  # noqa: BLE001 — transport errors vary by backend
+            self._dead_workers.add(worker)
+            logging.warning(
+                "split turn send to worker %d failed (%s) — skipping turn",
+                worker,
+                e,
+            )
+            return False
+
+    def _start_round(self):
+        r = self.round_idx
+        self._cohort = list(self.scheduler.select(r, k=self.worker_num))
+        self._turn_pos = 0
+        self._next_batch = 0
+        self._loss_sum = 0.0
+        self._batches = 0
+        self._round_span = self._tracer.start_span("round", round=r)
+        self._send_turn()
+
+    def _send_turn(self):
+        """Hand the relay baton (bottom params + bottom opt state) to the
+        next live client in the ring; a failed hand-off skips the turn."""
+        r = self.round_idx
+        while self._turn_pos < len(self._cohort):
+            worker = self._turn_pos + 1
+            msg = Message(MT.S2C_SPLIT_TURN, 0, worker)
+            msg.add_params(MT.ARG_MODEL_PARAMS, _host_tree(self._bottom_params))
+            msg.add_params(MT.ARG_OPT_STATE, _opt_leaves(self._bottom_opt_state))
+            msg.add_params(MT.ARG_CLIENT_INDEX, int(self._cohort[self._turn_pos]))
+            msg.add_params(MT.ARG_ROUND_IDX, r)
+            self._next_batch = 0
+            with self._tracer.span("broadcast", round=r):
+                sent = self._broadcast(msg)
+            if sent:
+                return
+            self.skipped_turns += 1
+            self._turn_pos += 1
+        self._finish_or_next_round()
+
+    def _turn_is_current(self, msg: Message) -> bool:
+        return (
+            not self._federation_done
+            and msg.get(MT.ARG_ROUND_IDX) == self.round_idx
+            and msg.get_sender_id() == self._turn_pos + 1
+        )
+
+    def _on_acts(self, msg: Message):
+        if not self._turn_is_current(msg) or int(msg.get(MT.ARG_BATCH_IDX)) != self._next_batch:
+            self.dropped_boundary += 1
+            return
+        r = self.round_idx
+        worker = msg.get_sender_id()
+        payload = msg.get(MT.ARG_ACT_PAYLOAD)
+        if payload is not None:
+            acts = ActivationCodec.decode(payload, msg.get(MT.ARG_ACT_CODEC))
+        else:
+            acts = msg.get(MT.ARG_ACTIVATIONS)
+        y = msg.get(MT.ARG_BATCH_LABELS)
+        with self._tracer.span("boundary", round=r):
+            (
+                self._top_params,
+                self._top_opt_state,
+                loss,
+                _correct,
+                acts_grad,
+            ) = self._server_step(
+                self._top_params,
+                self._top_opt_state,
+                jnp.asarray(acts),
+                jnp.asarray(y),
+            )
+        self._loss_sum += float(loss)
+        self._batches += 1
+        g = np.ascontiguousarray(np.asarray(acts_grad))
+        out = Message(MT.S2C_SPLIT_GRADS, 0, worker)
+        out.add_params(MT.ARG_ROUND_IDX, r)
+        out.add_params(MT.ARG_BATCH_IDX, int(msg.get(MT.ARG_BATCH_IDX)))
+        if self._codec is not None:
+            gp = self._codec.encode(f"down:{worker}", g)
+            get_comm_meter().on_downlink(CZ.payload_bytes(gp), g.nbytes)
+            out.add_params(MT.ARG_ACT_PAYLOAD, gp)
+            out.add_params(MT.ARG_ACT_CODEC, self._codec.method)
+        else:
+            get_comm_meter().on_downlink(g.nbytes, g.nbytes)
+            out.add_params(MT.ARG_ACT_GRADS, g)
+        self._next_batch += 1
+        if not self._broadcast(out):
+            # client died mid-turn: its bottom updates are lost with it —
+            # the turn is abandoned and the PREVIOUS bottom state relays on
+            self.skipped_turns += 1
+            self._turn_pos += 1
+            self._send_turn()
+
+    def _on_done(self, msg: Message):
+        if not self._turn_is_current(msg):
+            self.dropped_boundary += 1
+            return
+        key = (self.round_idx, msg.get_sender_id())
+        if key in self._done_seen:  # flaky at-least-once duplicate
+            self.dropped_boundary += 1
+            return
+        self._done_seen.add(key)
+        if msg.get(MT.ARG_SKIPPED):
+            # fault-plan decline: the bottom state relays on unchanged
+            self.skipped_turns += 1
+        else:
+            self._bottom_params = msg.get(MT.ARG_MODEL_PARAMS)
+            self._bottom_opt_state = _opt_unflatten(
+                self._opt, self._bottom_params, msg.get(MT.ARG_OPT_STATE)
+            )
+        self._turn_pos += 1
+        if self._turn_pos < len(self._cohort):
+            self._send_turn()
+        else:
+            self._finish_or_next_round()
+
+    def _finish_or_next_round(self):
+        with self._round_lock:
+            if self._federation_done:
+                return
+            self._complete_round()
+
+    def _complete_round(self):
+        """Close the open round: log the row, advance or FINISH. Caller
+        holds ``_round_lock`` (or is the drain path, which takes it)."""
+        r = self.round_idx
+        row = {
+            "round": r,
+            "t_s": round(time.monotonic() - getattr(self, "_t0", time.monotonic()), 3),
+            "Train/Loss": self._loss_sum / max(self._batches, 1),
+            "split/skipped_turns": self.skipped_turns,
+        }
+        if self._eval is not None:
+            with self._tracer.span("eval", round=r):
+                x, y = self.data.test_x, self.data.test_y
+                correct = 0
+                for s in range(0, len(y), 128):
+                    correct += int(
+                        self._eval(
+                            self._bottom_params,
+                            self._top_params,
+                            jnp.asarray(x[s : s + 128]),
+                            jnp.asarray(y[s : s + 128]),
+                        )
+                    )
+                row["Test/Acc"] = correct / max(len(y), 1)
+        self.history.append(row)
+        self.log_fn(row)
+        if self._round_span is not None:
+            self._round_span.end()
+            self._round_span = None
+        self.round_idx = r + 1
+        if self.round_idx >= self.config.fed.comm_round or self._stop_requested:
+            self._federation_done = True
+            for worker in range(1, self.worker_num + 1):
+                self._broadcast(Message(MT.FINISH, 0, worker))
+            self.finish()
+        else:
+            self._start_round()
+
+
+class SplitNNClientManager(ClientManager):
+    """Bottom-half owner for one worker slot (ref client.py:24-34 forward/
+    backward). Holds the full dataset handle; the turn message names which
+    client's shard this slot plays this round (the sampler re-assigns
+    clients to slots round by round, like the horizontal family)."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        comm: BaseCommManager,
+        rank: int,
+        bottom: ModelDef,
+        data,
+        faults=None,
+    ):
+        super().__init__(comm, rank, config=config)
+        self.config = config
+        self.data = data
+        self._faults = faults
+        lr, mom, wd = config.train.lr, config.train.momentum, config.train.wd
+        self._opt = make_split_optimizer(lr, mom, wd)
+        self._forward = make_splitnn_client_forward(bottom)
+        self._backward = make_splitnn_client_backward(bottom, lr, mom, wd)
+        self._codec = ActivationCodec.from_config(config.comm)
+        self._tracer = get_tracer()
+        self._turn: Optional[Dict] = None
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MT.S2C_SPLIT_TURN, self._on_turn)
+        self.register_message_receive_handler(MT.S2C_SPLIT_GRADS, self._on_grads)
+        self.register_message_receive_handler(MT.FINISH, lambda m: self.finish())
+
+    def _on_turn(self, msg: Message):
+        self._turn = None  # a turn abandoned by the server leaves no state
+        r = int(msg.get(MT.ARG_ROUND_IDX))
+        cid = int(msg.get(MT.ARG_CLIENT_INDEX))
+        params = msg.get(MT.ARG_MODEL_PARAMS)
+        opt_state = _opt_unflatten(self._opt, params, msg.get(MT.ARG_OPT_STATE))
+        fd = self._faults.decide(cid, r) if self._faults is not None else None
+        if fd is not None and (fd.crashed or fd.drop):
+            # decline the turn instead of going silent: the ring has no
+            # quorum deadline to absorb silence, so the deterministic
+            # recovery is an explicit skip — the server relays the
+            # unchanged bottom state to the next client
+            self._faults.record(cid, r, "crash" if fd.crashed else "dropout")
+            self._send_done(r, cid, skipped=True)
+            return
+        if fd is not None and fd.slowdown_s:
+            self._faults.record(cid, r, "slowdown", detail=fd.slowdown_s)
+            time.sleep(fd.slowdown_s)
+        x, y = self.data.client_x[cid], self.data.client_y[cid]
+        bs = int(self.config.data.batch_size)
+        n = len(y)
+        # identical batch walk to SplitNNAPI.train_ring (drop-partial, no
+        # shuffle, epochs_per_client epochs) — the parity contract
+        starts = [
+            s
+            for _ in range(int(self.config.fed.epochs))
+            for s in range(0, n - bs + 1, bs)
+        ]
+        self._turn = {
+            "round": r,
+            "cid": cid,
+            "params": params,
+            "opt_state": opt_state,
+            "starts": starts,
+            "pos": 0,
+            "flaky": bool(fd.flaky) if fd is not None else False,
+            "x": x,
+            "y": y,
+            "bs": bs,
+            "xb": None,
+        }
+        if not starts:
+            self._send_done(r, cid, skipped=False)
+            return
+        self._send_acts()
+
+    def _send_acts(self):
+        t = self._turn
+        r, pos, bs = t["round"], t["pos"], t["bs"]
+        s = t["starts"][pos]
+        xb = jnp.asarray(t["x"][s : s + bs])
+        t["xb"] = xb
+        with self._tracer.span("forward", round=r):
+            acts = np.ascontiguousarray(np.asarray(self._forward(t["params"], xb)))
+        out = Message(MT.C2S_SPLIT_ACTS, self.rank, 0)
+        if self._codec is not None:
+            payload = self._codec.encode(f"up:{self.rank}", acts)
+            get_comm_meter().on_uplink(CZ.payload_bytes(payload), acts.nbytes)
+            out.add_params(MT.ARG_ACT_PAYLOAD, payload)
+            out.add_params(MT.ARG_ACT_CODEC, self._codec.method)
+        else:
+            get_comm_meter().on_uplink(acts.nbytes, acts.nbytes)
+            out.add_params(MT.ARG_ACTIVATIONS, acts)
+        out.add_params(MT.ARG_BATCH_LABELS, np.asarray(t["y"][s : s + bs]))
+        out.add_params(MT.ARG_BATCH_IDX, pos)
+        out.add_params(MT.ARG_ROUND_IDX, r)
+        out.add_params(MT.ARG_CLIENT_INDEX, t["cid"])
+        self.send_message(out)
+
+    def _on_grads(self, msg: Message):
+        t = self._turn
+        if (
+            t is None
+            or int(msg.get(MT.ARG_ROUND_IDX)) != t["round"]
+            or int(msg.get(MT.ARG_BATCH_IDX)) != t["pos"]
+        ):
+            return  # stale round or duplicate batch reply
+        payload = msg.get(MT.ARG_ACT_PAYLOAD)
+        if payload is not None:
+            g = ActivationCodec.decode(payload, msg.get(MT.ARG_ACT_CODEC))
+        else:
+            g = msg.get(MT.ARG_ACT_GRADS)
+        with self._tracer.span("backward", round=t["round"]):
+            t["params"], t["opt_state"] = self._backward(
+                t["params"], t["opt_state"], t["xb"], jnp.asarray(g)
+            )
+        t["pos"] += 1
+        if t["pos"] < len(t["starts"]):
+            self._send_acts()
+        else:
+            self._send_done(t["round"], t["cid"], skipped=False)
+
+    def _send_done(self, r: int, cid: int, skipped: bool):
+        out = Message(MT.C2S_SPLIT_DONE, self.rank, 0)
+        out.add_params(MT.ARG_ROUND_IDX, r)
+        out.add_params(MT.ARG_CLIENT_INDEX, cid)
+        if skipped:
+            out.add_params(MT.ARG_SKIPPED, True)
+        else:
+            t = self._turn
+            out.add_params(MT.ARG_MODEL_PARAMS, _host_tree(t["params"]))
+            out.add_params(MT.ARG_OPT_STATE, _opt_leaves(t["opt_state"]))
+        flaky = self._turn is not None and self._turn.get("flaky")
+        self._turn = None
+        self.send_message(out)
+        if flaky:
+            # flaky = at-least-once double delivery; the server's
+            # (round, worker) done-dedupe absorbs the duplicate
+            self._faults.record(cid, r, "flaky")
+            try:
+                self.send_message(out)
+            except Exception:  # noqa: BLE001 — best-effort duplicate
+                pass
+
+
+def run_loopback_splitnn(
+    config: RunConfig,
+    data,
+    bottom: Optional[ModelDef] = None,
+    top: Optional[ModelDef] = None,
+    log_fn=None,
+    faults=None,
+):
+    """One-process split federation over the loopback hub: 1 server +
+    worker_num client actors in threads. Returns the server manager
+    (global_vars / history / skipped_turns)."""
+    if bottom is None or top is None:
+        from fedml_tpu.algorithms.split_nn import default_split_models
+
+        bottom, top = default_split_models(
+            tuple(data.client_x[0].shape[1:]), data.num_classes
+        )
+    hub = LoopbackHub()
+    k = config.fed.client_num_per_round
+    server = SplitNNServerManager(
+        config,
+        LoopbackCommManager(hub, 0),
+        bottom,
+        top,
+        data=data,
+        worker_num=k,
+        log_fn=log_fn,
+        faults=faults,
+    )
+    clients = [
+        SplitNNClientManager(
+            config, LoopbackCommManager(hub, rank), rank, bottom, data,
+            faults=faults,
+        )
+        for rank in range(1, k + 1)
+    ]
+    threads = [
+        threading.Thread(target=c.run, daemon=True, name=f"splitnn-client-{c.rank}")
+        for c in clients
+    ]
+    for t in threads:
+        t.start()
+    server.send_init_msg()
+    server.run()
+    for t in threads:
+        t.join(timeout=60)
+    return server

@@ -1,0 +1,344 @@
+"""Distributed classical vertical FL over the Message/Observer transport
+(ref: fedml_api/distributed/classical_vertical_fl/{vfl_api.py,
+guest_trainer.py, host_trainer.py}).
+
+The guest (rank 0) holds the labels and its own feature slice; each host
+(rank k ≥ 1) holds party k's disjoint feature columns. Per batch (ref
+guest_trainer.train):
+
+1. guest → hosts ``S2C_VFL_BATCH``: the batch index (parties walk the
+   SAME drop-partial batch grid over their aligned sample axis, so the
+   index is the whole message);
+2. host → guest ``C2S_VFL_CONTRIB``: the logit contribution
+   h_k = dense(extractor_k(x_k)) (host_trainer.py:43-78), optionally
+   int8/int4-quantized;
+3. guest sums contributions with its own, takes the loss, and returns
+   ``S2C_VFL_GRADS`` carrying ∂L/∂h_k to each host
+   (guest_trainer.py:96-126), which backprops through its local stack.
+
+Party numerics run through the digested ProgramCache factories
+(:mod:`fedml_tpu.splitfed.programs`); the wire composition matches the
+fused :class:`VFLAPI` step to float32 resolution (the fused step's XLA
+fusion across the party-sum reorders a handful of flops — pinned at
+tiny-atol in tests/test_splitfed.py). Per-rank FIFO delivery guarantees
+a host applies batch t's gradients before it sees batch t+1's
+announcement, so no barrier message is needed."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.config import RunConfig
+from fedml_tpu.core.comm import BaseCommManager
+from fedml_tpu.core.loopback import LoopbackCommManager, LoopbackHub
+from fedml_tpu.core.managers import ClientManager, ServerManager
+from fedml_tpu.core.message import Message, MessageType as MT
+from fedml_tpu.core import compression as CZ
+from fedml_tpu.splitfed.codec import ActivationCodec
+from fedml_tpu.splitfed.programs import (
+    make_vfl_guest_grad,
+    make_vfl_party_forward,
+    make_vfl_party_update,
+)
+from fedml_tpu.telemetry import get_comm_meter, get_tracer
+
+
+def _party_params(feature_splits, hidden_dim, out_dim, seed, party_idx):
+    """Party ``party_idx``'s init, bit-identical to ``VFLAPI.__init__`` —
+    every rank derives the SAME per-party rng fan-out from the shared
+    seed, so sim and transport start from one model."""
+    from fedml_tpu.algorithms.vertical_fl import VFLParty
+
+    rngs = jax.random.split(jax.random.PRNGKey(seed), len(feature_splits))
+    party = VFLParty(
+        int(feature_splits[party_idx]),
+        hidden_dim,
+        out_dim,
+        rngs[party_idx],
+        has_labels=(party_idx == 0),
+    )
+    return jax.device_get(party.params)
+
+
+def _batch_starts(n: int, bs: int) -> List[int]:
+    return list(range(0, n - bs + 1, bs))
+
+
+class VFLGuestManager(ServerManager):
+    """Label holder + per-batch FSM (ref guest_trainer.py). Rank 0 = party 0."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        comm: BaseCommManager,
+        x_guest: np.ndarray,
+        y: np.ndarray,
+        feature_splits,
+        hidden_dim: int = 16,
+        out_dim: int = 1,
+        log_fn=None,
+    ):
+        super().__init__(comm, rank=0, config=config)
+        self.config = config
+        self.x = np.asarray(x_guest)
+        self.y = np.asarray(y, np.float32)
+        self.feature_splits = tuple(int(d) for d in feature_splits)
+        self.n_parties = len(self.feature_splits)
+        self.log_fn = log_fn or (lambda m: None)
+        lr = config.train.lr
+        self.params = _party_params(
+            self.feature_splits, hidden_dim, out_dim, config.seed, 0
+        )
+        import optax
+
+        self._opt = optax.sgd(lr, momentum=0.9)
+        self.opt_state = self._opt.init(self.params)
+        self._forward = make_vfl_party_forward(hidden_dim, out_dim, True)
+        self._guest_grad = make_vfl_guest_grad(self.n_parties, out_dim)
+        self._update = make_vfl_party_update(hidden_dim, out_dim, True, lr=lr)
+        self._codec = ActivationCodec.from_config(config.comm)
+        self._tracer = get_tracer()
+        self.round_idx = 0
+        self.history: List[dict] = []
+        self._starts = _batch_starts(len(self.y), int(config.data.batch_size))
+        self._batch = 0
+        self._contribs: Dict[int, np.ndarray] = {}
+        self._loss_sum = 0.0
+        self._correct = 0
+        self._round_span = None
+        self._federation_done = False
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MT.C2S_VFL_CONTRIB, self._on_contrib)
+
+    def send_init_msg(self):
+        self._t0 = time.monotonic()
+        self._start_round()
+
+    def _start_round(self):
+        r = self.round_idx
+        self._batch = 0
+        self._loss_sum = 0.0
+        self._correct = 0
+        self._round_span = self._tracer.start_span("round", round=r)
+        if not self._starts:
+            self._complete_round()
+            return
+        self._announce_batch()
+
+    def _announce_batch(self):
+        r = self.round_idx
+        self._contribs = {}
+        with self._tracer.span("broadcast", round=r):
+            for host in range(1, self.n_parties):
+                msg = Message(MT.S2C_VFL_BATCH, 0, host)
+                msg.add_params(MT.ARG_ROUND_IDX, r)
+                msg.add_params(MT.ARG_BATCH_IDX, self._batch)
+                self.send_message(msg)
+
+    def _on_contrib(self, msg: Message):
+        if (
+            self._federation_done
+            or int(msg.get(MT.ARG_ROUND_IDX)) != self.round_idx
+            or int(msg.get(MT.ARG_BATCH_IDX)) != self._batch
+        ):
+            return
+        payload = msg.get(MT.ARG_ACT_PAYLOAD)
+        if payload is not None:
+            contrib = ActivationCodec.decode(payload, msg.get(MT.ARG_ACT_CODEC))
+        else:
+            contrib = msg.get(MT.ARG_CONTRIB)
+        self._contribs[msg.get_sender_id()] = np.asarray(contrib)
+        if len(self._contribs) == self.n_parties - 1:
+            self._process_batch()
+
+    def _process_batch(self):
+        r = self.round_idx
+        s = self._starts[self._batch]
+        bs = int(self.config.data.batch_size)
+        xb = jnp.asarray(self.x[s : s + bs])
+        yb = jnp.asarray(self.y[s : s + bs])
+        with self._tracer.span("boundary", round=r):
+            own = self._forward(self.params, xb)
+            ordered = [own] + [
+                jnp.asarray(self._contribs[h]) for h in range(1, self.n_parties)
+            ]
+            loss, correct, grads = self._guest_grad(ordered, yb)
+            self.params, self.opt_state = self._update(
+                self.params, self.opt_state, xb, grads[0]
+            )
+        self._loss_sum += float(loss)
+        self._correct += int(correct)
+        for host in range(1, self.n_parties):
+            g = np.ascontiguousarray(np.asarray(grads[host]))
+            out = Message(MT.S2C_VFL_GRADS, 0, host)
+            out.add_params(MT.ARG_ROUND_IDX, r)
+            out.add_params(MT.ARG_BATCH_IDX, self._batch)
+            if self._codec is not None:
+                gp = self._codec.encode(f"down:{host}", g)
+                get_comm_meter().on_downlink(CZ.payload_bytes(gp), g.nbytes)
+                out.add_params(MT.ARG_ACT_PAYLOAD, gp)
+                out.add_params(MT.ARG_ACT_CODEC, self._codec.method)
+            else:
+                get_comm_meter().on_downlink(g.nbytes, g.nbytes)
+                out.add_params(MT.ARG_CONTRIB_GRAD, g)
+            self.send_message(out)
+        self._batch += 1
+        if self._batch < len(self._starts):
+            self._announce_batch()
+        else:
+            self._complete_round()
+
+    def _complete_round(self):
+        r = self.round_idx
+        seen = len(self._starts) * int(self.config.data.batch_size)
+        row = {
+            "round": r,
+            "t_s": round(time.monotonic() - getattr(self, "_t0", time.monotonic()), 3),
+            "Train/Loss": self._loss_sum / max(len(self._starts), 1),
+            "Train/Acc": self._correct / max(seen, 1),
+        }
+        self.history.append(row)
+        self.log_fn(row)
+        if self._round_span is not None:
+            self._round_span.end()
+            self._round_span = None
+        self.round_idx = r + 1
+        if self.round_idx >= self.config.fed.comm_round:
+            self._federation_done = True
+            for host in range(1, self.n_parties):
+                self.send_message(Message(MT.FINISH, 0, host))
+            self.finish()
+        else:
+            self._start_round()
+
+
+class VFLHostManager(ClientManager):
+    """Feature-slice holder, party ``rank`` (ref host_trainer.py)."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        comm: BaseCommManager,
+        rank: int,
+        x_host: np.ndarray,
+        n_samples: int,
+        feature_splits,
+        hidden_dim: int = 16,
+        out_dim: int = 1,
+    ):
+        super().__init__(comm, rank, config=config)
+        self.config = config
+        self.x = np.asarray(x_host)
+        lr = config.train.lr
+        self.params = _party_params(
+            tuple(feature_splits), hidden_dim, out_dim, config.seed, rank
+        )
+        import optax
+
+        self._opt = optax.sgd(lr, momentum=0.9)
+        self.opt_state = self._opt.init(self.params)
+        self._forward = make_vfl_party_forward(hidden_dim, out_dim, False)
+        self._update = make_vfl_party_update(hidden_dim, out_dim, False, lr=lr)
+        self._codec = ActivationCodec.from_config(config.comm)
+        self._tracer = get_tracer()
+        self._starts = _batch_starts(n_samples, int(config.data.batch_size))
+        self._xb = None
+        self._pending = None  # (round, batch) awaiting grads
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MT.S2C_VFL_BATCH, self._on_batch)
+        self.register_message_receive_handler(MT.S2C_VFL_GRADS, self._on_grads)
+        self.register_message_receive_handler(MT.FINISH, lambda m: self.finish())
+
+    def _on_batch(self, msg: Message):
+        r = int(msg.get(MT.ARG_ROUND_IDX))
+        bi = int(msg.get(MT.ARG_BATCH_IDX))
+        s = self._starts[bi]
+        bs = int(self.config.data.batch_size)
+        self._xb = jnp.asarray(self.x[s : s + bs])
+        self._pending = (r, bi)
+        with self._tracer.span("forward", round=r):
+            contrib = np.ascontiguousarray(np.asarray(self._forward(self.params, self._xb)))
+        out = Message(MT.C2S_VFL_CONTRIB, self.rank, 0)
+        out.add_params(MT.ARG_ROUND_IDX, r)
+        out.add_params(MT.ARG_BATCH_IDX, bi)
+        if self._codec is not None:
+            payload = self._codec.encode(f"up:{self.rank}", contrib)
+            get_comm_meter().on_uplink(CZ.payload_bytes(payload), contrib.nbytes)
+            out.add_params(MT.ARG_ACT_PAYLOAD, payload)
+            out.add_params(MT.ARG_ACT_CODEC, self._codec.method)
+        else:
+            get_comm_meter().on_uplink(contrib.nbytes, contrib.nbytes)
+            out.add_params(MT.ARG_CONTRIB, contrib)
+        self.send_message(out)
+
+    def _on_grads(self, msg: Message):
+        key = (int(msg.get(MT.ARG_ROUND_IDX)), int(msg.get(MT.ARG_BATCH_IDX)))
+        if self._pending != key:
+            return  # stale/duplicate reply
+        self._pending = None
+        payload = msg.get(MT.ARG_ACT_PAYLOAD)
+        if payload is not None:
+            g = ActivationCodec.decode(payload, msg.get(MT.ARG_ACT_CODEC))
+        else:
+            g = msg.get(MT.ARG_CONTRIB_GRAD)
+        with self._tracer.span("backward", round=key[0]):
+            self.params, self.opt_state = self._update(
+                self.params, self.opt_state, self._xb, jnp.asarray(g)
+            )
+
+
+def run_loopback_vfl(
+    config: RunConfig,
+    xs_parties,
+    y,
+    hidden_dim: int = 16,
+    out_dim: int = 1,
+    log_fn=None,
+):
+    """One-process vertical federation over the loopback hub: guest +
+    len(xs_parties)-1 host actors in threads. Returns ``(guest, hosts)``
+    so callers can read every party's final params."""
+    feature_splits = [int(np.asarray(x).shape[1]) for x in xs_parties]
+    hub = LoopbackHub()
+    guest = VFLGuestManager(
+        config,
+        LoopbackCommManager(hub, 0),
+        xs_parties[0],
+        y,
+        feature_splits,
+        hidden_dim=hidden_dim,
+        out_dim=out_dim,
+        log_fn=log_fn,
+    )
+    hosts = [
+        VFLHostManager(
+            config,
+            LoopbackCommManager(hub, rank),
+            rank,
+            xs_parties[rank],
+            len(y),
+            feature_splits,
+            hidden_dim=hidden_dim,
+            out_dim=out_dim,
+        )
+        for rank in range(1, len(xs_parties))
+    ]
+    threads = [
+        threading.Thread(target=h.run, daemon=True, name=f"vfl-host-{h.rank}")
+        for h in hosts
+    ]
+    for t in threads:
+        t.start()
+    guest.send_init_msg()
+    guest.run()
+    for t in threads:
+        t.join(timeout=60)
+    return guest, hosts

@@ -47,9 +47,12 @@ from fedml_tpu.telemetry.spans import SpanEvent, Tracer
 
 # Phase spans folded into a record, in lifecycle order. "round" (sync) and
 # "server_step" (FedBuff — it is both a phase and the fold trigger) are
-# the record boundaries.
-PHASES = ("select", "broadcast", "local_train", "aggregate", "eval",
-          "server_step")
+# the record boundaries. forward/boundary/backward are the split/vertical
+# runtimes' per-batch phases (fedml_tpu/splitfed/): client cut-layer
+# forward, server top-half step at the wire boundary, client backward
+# with the returned activation grads.
+PHASES = ("select", "broadcast", "local_train", "forward", "boundary",
+          "backward", "aggregate", "eval", "server_step")
 
 # Conservative per-record footprint estimate against the byte budget: a
 # folded record is a flat dict of ~20 scalar slots plus a small phases

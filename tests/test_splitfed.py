@@ -1,0 +1,489 @@
+"""Split & vertical federation (fedml_tpu/splitfed/, PR 19).
+
+The load-bearing contracts:
+
+- **sim-vs-transport parity** — the boundary-cut message protocol
+  (forward → acts → server step → grads → backward) over the loopback
+  wire produces BYTE-identical params to the fused ``SplitNNAPI``
+  simulator over the same scheduler-selected cohorts. VFL parity is
+  allclose, not byte: XLA fuses across the party-sum in the fused step,
+  reordering the flop sequence (~1e-8) — pinned here so a regression to
+  worse than 1e-6 still fails.
+- **opt-state partition** — merge/split between the fused optimizer tree
+  (what checkpoints carry) and the per-group wire states is an exact
+  inverse pair.
+- **warm-vs-cold** — AOT warmup changes when programs compile, never
+  what they compute.
+- **fault-injected relay** — a crashed client's turn is declined
+  explicitly (no quorum deadline exists to absorb silence); recovery is
+  deterministic: two identical faulted runs agree byte-for-byte.
+- **supervised restart** — a split tenant killed mid-flight self-heals
+  from its rolling checkpoint with bit parity (both param groups + the
+  fused opt state round-trip).
+- **activation-wire compression** — the int8/int4 cut factor is read
+  off the comm meter (on_uplink/on_downlink), never asserted from the
+  codec's spec sheet.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.config import (
+    CommConfig,
+    DataConfig,
+    FedConfig,
+    RunConfig,
+    TrainConfig,
+)
+from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.telemetry import get_comm_meter
+
+
+def _cfg(comm_round=2, workers=3, total=5, seed=11, comm=None, **fed_kw):
+    return RunConfig(
+        data=DataConfig(batch_size=8),
+        fed=FedConfig(
+            client_num_in_total=total, client_num_per_round=workers,
+            comm_round=comm_round, epochs=1, frequency_of_the_test=100,
+            **fed_kw,
+        ),
+        train=TrainConfig(
+            client_optimizer="sgd", lr=0.1, momentum=0.9, wd=5e-4
+        ),
+        comm=comm or CommConfig(),
+        seed=seed,
+    )
+
+
+def _data(num_clients=5, seed=0):
+    return synthetic_classification(
+        num_clients=num_clients, num_classes=3, feat_shape=(10,),
+        samples_per_client=24, partition_method="homo", seed=seed,
+    )
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _cohorts(cfg, data):
+    """The ring orders the transport's server will draw — derived from an
+    IDENTICAL scheduler (same config/seed/policy), which is the parity
+    contract: ring order comes from the SelectionPolicy registry, not a
+    hardcoded neighbor list."""
+    from fedml_tpu.scheduler import ClientScheduler
+
+    sched = ClientScheduler.from_config(
+        cfg, num_clients=cfg.fed.client_num_in_total, data=data
+    )
+    return [
+        list(sched.select(r, k=cfg.fed.client_num_per_round))
+        for r in range(cfg.fed.comm_round)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# boundary programs: composition == fused step, opt-state partition
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_composition_matches_fused_step_bitwise():
+    """client_forward → server_step → client_backward over per-group opt
+    states == the fused step over the joint param dict, byte-for-byte,
+    including a numpy wire round-trip of the activations/grads."""
+    from fedml_tpu.algorithms.split_nn import default_split_models
+    from fedml_tpu.splitfed.programs import (
+        make_split_optimizer,
+        make_splitnn_client_backward,
+        make_splitnn_client_forward,
+        make_splitnn_fused_step,
+        make_splitnn_server_step,
+        merge_opt_state,
+        split_opt_state,
+    )
+
+    bottom, top = default_split_models((10,), 3)
+    lr, mom, wd = 0.1, 0.9, 5e-4
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    bp = jax.device_get(bottom.init(k1))["params"]
+    tp = jax.device_get(top.init(k2))["params"]
+    opt = make_split_optimizer(lr, mom, wd)
+    fused = make_splitnn_fused_step(bottom, top, lr=lr, momentum=mom, wd=wd)
+    fwd = make_splitnn_client_forward(bottom)
+    srv = make_splitnn_server_step(top, lr, mom, wd)
+    bwd = make_splitnn_client_backward(bottom, lr, mom, wd)
+
+    params = {"bottom": bp, "top": tp}
+    fused_state = opt.init(params)
+    b_state, t_state = split_opt_state(opt, fused_state, bp, tp)
+
+    rng = np.random.default_rng(3)
+    for step in range(4):
+        x = rng.standard_normal((8, 10)).astype(np.float32)
+        y = rng.integers(0, 3, size=(8,)).astype(np.int32)
+        params, fused_state, loss_f, _ = fused(params, fused_state, x, y)
+        # the wire composition: acts and grads cross as numpy
+        acts = np.asarray(fwd(bp, x))
+        tp, t_state, loss_b, _, acts_grad = srv(tp, t_state, acts, y)
+        bp, b_state = bwd(bp, b_state, x, np.asarray(acts_grad))
+        np.testing.assert_array_equal(
+            np.asarray(loss_f), np.asarray(loss_b)
+        )
+        _tree_equal(params["bottom"], bp)
+        _tree_equal(params["top"], tp)
+    # and the state partition is an exact inverse pair
+    merged = merge_opt_state(opt, b_state, t_state, bp, tp)
+    _tree_equal(fused_state, merged)
+    b2, t2 = split_opt_state(opt, merged, bp, tp)
+    _tree_equal(b_state, b2)
+    _tree_equal(t_state, t2)
+
+
+def test_vfl_party_opt_state_partition_roundtrips():
+    import optax
+
+    from fedml_tpu.splitfed.programs import (
+        merge_party_opt_states,
+        split_party_opt_states,
+    )
+    from fedml_tpu.algorithms.vertical_fl import VFLParty
+
+    rngs = jax.random.split(jax.random.PRNGKey(5), 3)
+    parties = [
+        VFLParty(d, 16, 1, rngs[i], has_labels=(i == 0))
+        for i, d in enumerate((4, 3, 3))
+    ]
+    all_params = [p.params for p in parties]
+    opt = optax.sgd(0.05, momentum=0.9)
+    fused = opt.init(all_params)
+    states = split_party_opt_states(opt, fused, all_params)
+    assert len(states) == 3
+    _tree_equal(fused, merge_party_opt_states(opt, states, all_params))
+
+
+def test_default_split_models_derives_cut_width_by_eval_shape():
+    """The top half's input width must equal whatever the bottom actually
+    emits — for conv bottoms that is stride arithmetic the old hardcoded
+    ``(d+3)//4`` got wrong for non-multiple-of-4 inputs."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.split_nn import default_split_models
+
+    for shape in ((10,), (8, 8, 1), (9, 9, 2), (11, 7, 3)):
+        bottom, top = default_split_models(shape, 3)
+        v = bottom.init(jax.random.PRNGKey(0))
+        acts, _ = bottom.apply(
+            v, jnp.zeros((2,) + shape, jnp.float32), train=False
+        )
+        assert top.input_shape == (int(acts.shape[-1]),), shape
+        # the composition must actually run
+        tv = top.init(jax.random.PRNGKey(1))
+        logits, _ = top.apply(tv, acts, train=False)
+        assert logits.shape == (2, 3)
+
+
+# ---------------------------------------------------------------------------
+# sim-vs-transport parity
+# ---------------------------------------------------------------------------
+
+
+def test_splitnn_transport_matches_fused_simulator_bitwise():
+    from fedml_tpu.algorithms.split_nn import SplitNNAPI, default_split_models
+    from fedml_tpu.splitfed import run_loopback_splitnn
+
+    cfg = _cfg(comm_round=2, workers=3)
+    data = _data()
+    server = run_loopback_splitnn(cfg, data)
+    assert server.round_idx == 2
+    assert server.skipped_turns == 0
+
+    bottom, top = default_split_models(
+        tuple(data.client_x[0].shape[1:]), data.num_classes
+    )
+    api = SplitNNAPI(
+        bottom, top, lr=cfg.train.lr, momentum=cfg.train.momentum,
+        wd=cfg.train.wd, seed=cfg.seed,
+    )
+    for cohort in _cohorts(cfg, data):
+        api.train_ring(
+            [(data.client_x[c], data.client_y[c]) for c in cohort],
+            batch_size=cfg.data.batch_size,
+            epochs_per_client=cfg.fed.epochs,
+        )
+    _tree_equal(
+        server.global_vars["params"]["bottom"], api.bottom_vars["params"]
+    )
+    _tree_equal(server.global_vars["params"]["top"], api.top_vars["params"])
+
+
+def test_vfl_transport_matches_fused_simulator():
+    """Guest + 2 hosts over the wire vs VFLAPI.train_epoch. NOT byte-
+    exact by design: the fused step lets XLA fuse across the party sum,
+    reordering flops — the bound pins the divergence to float32 noise."""
+    from fedml_tpu.algorithms.vertical_fl import VFLAPI
+    from fedml_tpu.splitfed import run_loopback_vfl
+
+    cfg = _cfg(comm_round=2, workers=2, seed=4)
+    rng = np.random.default_rng(9)
+    n, splits = 48, (4, 3, 3)
+    xs = [rng.standard_normal((n, d)).astype(np.float32) for d in splits]
+    y = (rng.integers(0, 2, size=(n,))).astype(np.float32)
+
+    guest, hosts = run_loopback_vfl(cfg, xs, y)
+    api = VFLAPI(feature_splits=list(splits), lr=cfg.train.lr, seed=cfg.seed)
+    for _ in range(cfg.fed.comm_round):
+        api.train_epoch(xs, y, batch_size=cfg.data.batch_size)
+
+    def close(a, b):
+        for x_, y_ in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(x_), np.asarray(y_), atol=1e-6, rtol=1e-5
+            )
+
+    close(guest.params, api.params[0])
+    for h, pp in zip(hosts, api.params[1:]):
+        close(h.params, pp)
+    assert len(guest.history) == cfg.fed.comm_round
+    assert "Train/Loss" in guest.history[-1]
+
+
+# ---------------------------------------------------------------------------
+# warm vs cold
+# ---------------------------------------------------------------------------
+
+
+def test_split_warmup_is_numerically_invisible():
+    """warmup_splitnn AOT-compiles the five split programs; a warmed
+    session's result is byte-identical to a cold one, and the compile
+    telemetry rows land in the log stream."""
+    from fedml_tpu.serve import FedSession
+
+    cfg, data = _cfg(), _data()
+    cold = FedSession(cfg, data, None, algorithm="split_nn").run()
+    rows = []
+    warm = FedSession(
+        cfg, data, None, algorithm="split_nn", warmup=True,
+        log_fn=rows.append,
+    ).run()
+    _tree_equal(cold.global_vars, warm.global_vars)
+    crow = [r for r in rows if "compile/warmup_s" in r]
+    assert crow, "warmup emitted no compile row"
+    for prog in ("split_forward", "split_server_step", "split_backward",
+                 "split_fused", "split_eval"):
+        assert any(
+            k.startswith(f"compile/{prog}") for k in crow[0]
+        ), (prog, sorted(crow[0]))
+
+
+# ---------------------------------------------------------------------------
+# faults: explicit decline + deterministic recovery
+# ---------------------------------------------------------------------------
+
+
+def _faulted(cfg, data, plan_json):
+    from fedml_tpu.scheduler import FaultInjector, FaultPlan
+    from fedml_tpu.splitfed import run_loopback_splitnn
+
+    inj = FaultInjector(FaultPlan.from_json(plan_json))
+    rows = []
+    server = run_loopback_splitnn(
+        cfg, data, log_fn=rows.append, faults=inj
+    )
+    return server, rows
+
+
+def test_faulted_boundary_round_recovers_deterministically():
+    """A client crashed from round 0 declines every turn: the server
+    relays the unchanged bottom state past it, the round completes, the
+    skip is visible in the round row — and the whole faulted run is
+    bit-reproducible."""
+    plan = {"clients": {"1": {"crash_at_round": 0}}}
+    cfg, data = _cfg(comm_round=2, workers=3), _data()
+
+    a, rows_a = _faulted(cfg, data, plan)
+    b, _rows_b = _faulted(cfg, data, plan)
+    assert a.round_idx == 2
+    assert a.skipped_turns > 0
+    done = [r for r in rows_a if "t_s" in r and "round" in r]
+    assert done and all("split/skipped_turns" in r for r in done)
+    assert done[-1]["split/skipped_turns"] == a.skipped_turns
+    _tree_equal(a.global_vars, b.global_vars)
+    _tree_equal(a._server_opt_state, b._server_opt_state)
+
+    # the crashed client contributed nothing: the run equals a clean run
+    # where that client's turns never update the relay — i.e. it still
+    # DIFFERS from the no-fault run (the decline is not a silent no-op)
+    clean = _faulted(cfg, data, {})[0]
+    leaves_a = jax.tree_util.tree_leaves(a.global_vars)
+    leaves_c = jax.tree_util.tree_leaves(clean.global_vars)
+    assert any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(leaves_a, leaves_c)
+    )
+
+
+def test_flaky_duplicate_done_is_deduped():
+    """flaky_p=1 double-sends every DONE; the server's (round, worker)
+    dedupe absorbs the duplicates — same result as the clean run."""
+    plan = {"default": {"flaky_upload_p": 1.0}}
+    cfg, data = _cfg(comm_round=2, workers=2), _data()
+    flaky, _ = _faulted(cfg, data, plan)
+    clean, _ = _faulted(cfg, data, {})
+    assert flaky.dropped_boundary > 0  # duplicates arrived and were dropped
+    _tree_equal(flaky.global_vars, clean.global_vars)
+
+
+# ---------------------------------------------------------------------------
+# serve integration: co-residency, checkpoint, supervised restart
+# ---------------------------------------------------------------------------
+
+
+def test_split_tenant_checkpoint_resume_bit_parity(tmp_path):
+    from fedml_tpu.serve import FedSession
+
+    data = _data()
+    ck = str(tmp_path / "split.ckpt")
+    FedSession(
+        _cfg(comm_round=2), data, None, algorithm="split_nn",
+        checkpoint_path=ck, checkpoint_every=1,
+    ).run()
+    assert os.path.exists(ck + ".npz")
+    resumed = FedSession(
+        _cfg(comm_round=4), data, None, algorithm="split_nn",
+        checkpoint_path=ck, checkpoint_every=1, resume=True,
+    ).run()
+    ref = FedSession(_cfg(comm_round=4), data, None,
+                     algorithm="split_nn").run()
+    assert resumed.round_idx == 4
+    _tree_equal(resumed.global_vars, ref.global_vars)
+    _tree_equal(resumed._server_opt_state, ref._server_opt_state)
+
+
+def test_split_tenant_supervised_restart_bit_parity(tmp_path):
+    """The soak_d twin for split federations: kill the tenant mid-flight
+    via a poisoned log row; the supervisor restarts it from the rolling
+    checkpoint; final params (both groups) match an uninterrupted run."""
+    from fedml_tpu.serve import FedSession, RestartPolicy, SupervisedSession
+
+    data = _data()
+    ref = FedSession(_cfg(comm_round=4), data, None,
+                     algorithm="split_nn").run()
+    state = {"killed": False}
+
+    def chaos(row):
+        if row.get("round") == 1 and "t_s" in row and not state["killed"]:
+            state["killed"] = True
+            raise RuntimeError("chaos kill")
+
+    sup = SupervisedSession(
+        _cfg(comm_round=4), data, None, algorithm="split_nn",
+        name="heal_split",
+        restart=RestartPolicy(budget=2, backoff_base_s=0.02),
+        checkpoint_path=str(tmp_path / "heal.ckpt"), checkpoint_every=1,
+        log_fn=chaos,
+    )
+    healed = sup.run()
+    assert sup.restarts == 1
+    _tree_equal(ref.global_vars, healed.global_vars)
+
+
+def test_split_tenant_coresident_with_horizontal_tenant():
+    """One FedSession host, two tenants: a horizontal fedavg federation
+    and a split federation run concurrently in one process; both finish
+    and neither perturbs the other (the split run matches its solo
+    twin)."""
+    from fedml_tpu.models import create_model
+    from fedml_tpu.serve import FedSession
+
+    data = _data()
+    solo = FedSession(_cfg(), data, None, algorithm="split_nn").run()
+
+    model = create_model("lr", "synthetic", (10,), 3)
+    horiz = FedSession(
+        _cfg(), data, model, algorithm="fedavg", name="horiz",
+    ).start()
+    split = FedSession(
+        _cfg(), data, None, algorithm="split_nn", name="split",
+    ).start()
+    hsrv = horiz.wait(timeout=120)
+    ssrv = split.wait(timeout=120)
+    assert hsrv.round_idx == 2 and ssrv.round_idx == 2
+    _tree_equal(solo.global_vars, ssrv.global_vars)
+
+
+# ---------------------------------------------------------------------------
+# activation-wire compression: the cut factor off comm/*
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("method,min_cut", [("int8", 3.0), ("int4", 5.0)])
+def test_activation_compression_cut_factor_metered(method, min_cut):
+    from fedml_tpu.splitfed import run_loopback_splitnn
+
+    data = _data()
+    cfg = _cfg(
+        comm_round=1, workers=2,
+        comm=CommConfig(
+            activation_compression=method, activation_error_feedback=True
+        ),
+    )
+    meter = get_comm_meter()
+    before = meter.snapshot()
+    server = run_loopback_splitnn(cfg, data)
+    after = meter.snapshot()
+    assert server.round_idx == 1
+    up_p = after["uplink_payload_bytes"] - before["uplink_payload_bytes"]
+    up_r = after["uplink_raw_bytes"] - before["uplink_raw_bytes"]
+    dn_p = after["downlink_payload_bytes"] - before["downlink_payload_bytes"]
+    dn_r = after["downlink_raw_bytes"] - before["downlink_raw_bytes"]
+    assert up_r > 0 and dn_r > 0
+    assert up_r / up_p >= min_cut, (method, up_p, up_r)
+    assert dn_r / dn_p >= min_cut, (method, dn_p, dn_r)
+
+
+def test_compressed_split_run_stays_close_to_exact():
+    """int8 boundary quantization with error feedback: lossy but sane —
+    the final params stay within quantization noise of the exact run,
+    and the run completes every round."""
+    from fedml_tpu.splitfed import run_loopback_splitnn
+
+    data = _data()
+    exact = run_loopback_splitnn(_cfg(comm_round=2, workers=2), data)
+    lossy = run_loopback_splitnn(
+        _cfg(
+            comm_round=2, workers=2,
+            comm=CommConfig(
+                activation_compression="int8",
+                activation_error_feedback=True,
+            ),
+        ),
+        data,
+    )
+    assert lossy.round_idx == 2
+    for a, b in zip(
+        jax.tree_util.tree_leaves(exact.global_vars),
+        jax.tree_util.tree_leaves(lossy.global_vars),
+    ):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = max(1.0, float(np.max(np.abs(a))))
+        assert float(np.max(np.abs(a - b))) / scale < 0.15
+
+
+def test_activation_codec_rejects_unknown_method():
+    from fedml_tpu.splitfed import ActivationCodec, run_loopback_splitnn
+
+    with pytest.raises(ValueError):
+        ActivationCodec("topk")
+    with pytest.raises(ValueError):
+        run_loopback_splitnn(
+            _cfg(comm=CommConfig(activation_compression="zstd")), _data()
+        )
