@@ -18,6 +18,56 @@ python -m compileall -q fedml_tpu tests bench.py __graft_entry__.py
 echo "== static analysis gate (fedlint) =="
 python -m fedml_tpu.analysis --fail-on-findings
 
+# protocol-flow + concurrency lint, called out as its OWN gate so a red
+# run names the family that broke: the wire-protocol model (every sent
+# type handled, no orphan constants, at-least-once handlers deduped,
+# request/reply closure) and the threading model (global lock order,
+# lock discipline per shared attr, scope-wrapped threads). Same walk,
+# same suppressions — this is the all-rules gate above narrowed to the
+# seven fedlint-v2 rules (docs/ANALYSIS.md "Protocol-flow rules").
+echo "== static analysis gate (fedlint v2: protocol + concurrency) =="
+python -m fedml_tpu.analysis --fail-on-findings \
+  --rule sent-unhandled --rule dead-msg-type --rule retry-no-dedupe \
+  --rule reply-closure \
+  --rule lock-order-cycle --rule unlocked-shared-mutation \
+  --rule unscoped-thread
+
+# direction check: the gate must still DETECT. Copy the real fedbuff
+# manager into a scratch tree, strip its _on_leave dedupe guard (the
+# exact bug retry-no-dedupe exists for: an at-least-once redelivery
+# double-counting a LEAVE), and require the lint to exit nonzero. A
+# silently-vacuous analyzer passes the clean-tree gate forever; this
+# keeps it honest. (tests/test_analysis.py pins the same seeded bug at
+# unit granularity; this is the shell-level end-to-end of it.)
+echo "== static analysis direction check: seeded bug must fail the gate =="
+FLINT=$(mktemp -d)
+python - "$FLINT" <<'PY'
+import pathlib, sys
+tmp = pathlib.Path(sys.argv[1])
+guard = (
+    "            if sender in self._dead_workers:\n"
+    "                # duplicate LEAVE (at-least-once delivery) — already\n"
+    "                # counted; re-adding would double the leaves tally\n"
+    "                return\n"
+)
+src = pathlib.Path("fedml_tpu/algorithms/fedbuff.py").read_text()
+assert guard in src, "fedbuff _on_leave dedupe guard moved — update ci.sh"
+for rel, text in (
+    ("pkg/algorithms/fedbuff.py", src.replace(guard, "")),
+    ("pkg/core/message.py",
+     pathlib.Path("fedml_tpu/core/message.py").read_text()),
+):
+    dest = tmp / rel
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    dest.write_text(text)
+PY
+if python -m fedml_tpu.analysis "$FLINT" --rule retry-no-dedupe \
+    --fail-on-findings > /dev/null 2>&1; then
+  echo "  ERROR: stripped _on_leave dedupe guard was NOT detected"; exit 1
+fi
+rm -rf "$FLINT"
+echo "  direction check ok: seeded retry-no-dedupe bug fails the gate"
+
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export JAX_PLATFORMS=cpu
 

@@ -425,12 +425,17 @@ class FedAvgServerManager(ServerManager):
         (fedml_tpu/serve/session.py), in which case the scheduler's
         restored memo re-selects the in-flight cohort byte-identically."""
         self._t0 = time.monotonic()
-        r = self.round_idx
-        sampled = self.scheduler.select(r, k=self.worker_num)
-        self._round_span = self._tracer.start_span("round", round=r)
-        with self._tracer.span("broadcast", round=r):
-            self._broadcast_round(MT.S2C_INIT_CONFIG, r, sampled)
-        self._arm_deadline()
+        # _complete_round (the steady-state sender) runs entirely under
+        # _round_lock; the opening round must too, or its writes to
+        # _round_span / global_vars / the deadline scaffolding race the
+        # first client uploads arriving on the comm thread
+        with self._round_lock:
+            r = self.round_idx
+            sampled = self.scheduler.select(r, k=self.worker_num)
+            self._round_span = self._tracer.start_span("round", round=r)
+            with self._tracer.span("broadcast", round=r):
+                self._broadcast_round(MT.S2C_INIT_CONFIG, r, sampled)
+            self._arm_deadline()
 
     def _broadcast_round(self, msg_type: str, round_idx: int, sampled):
         """Ship the round's model to the sampled cohort, encoding the

@@ -255,8 +255,12 @@ class FedBuffServerManager(ServerManager):
 
     def send_init_msg(self):
         self._t0 = time.monotonic()
-        for worker in range(1, self.worker_num + 1):
-            self._dispatch(worker, MT.S2C_INIT_CONFIG)
+        # every steady-state dispatch runs inside a handler holding _lock;
+        # the opening dispatches must too — an early JOIN/upload arriving
+        # on the comm thread would otherwise race the assignment stream
+        with self._lock:
+            for worker in range(1, self.worker_num + 1):
+                self._dispatch(worker, MT.S2C_INIT_CONFIG)
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
@@ -324,6 +328,10 @@ class FedBuffServerManager(ServerManager):
     def _on_leave(self, msg: Message):
         with self._lock:
             sender = msg.get_sender_id()
+            if sender in self._dead_workers:
+                # duplicate LEAVE (at-least-once delivery) — already
+                # counted; re-adding would double the leaves tally
+                return
             # no more dispatches to this rank: mark it dead (a later JOIN
             # from the same rank revives it) and forget its outstanding
             # assignment — async has no barrier, the assignment simply
@@ -387,9 +395,13 @@ class FedBuffServerManager(ServerManager):
         }
 
     def restore_state(self, state: dict) -> None:
-        self.version = int(np.asarray(state["version"]))
-        self.server_steps = int(np.asarray(state["server_steps"]))
-        self._dispatch_counter = int(np.asarray(state["dispatch_counter"]))
+        # restore runs before the serve loop starts, but take the lock
+        # anyway: it is free at that point and the counters it writes are
+        # lock-protected everywhere else
+        with self._lock:
+            self.version = int(np.asarray(state["version"]))
+            self.server_steps = int(np.asarray(state["server_steps"]))
+            self._dispatch_counter = int(np.asarray(state["dispatch_counter"]))
 
     # -- aggregation --
     def _on_delta_from_client(self, msg: Message):
@@ -624,6 +636,7 @@ class FedBuffClientManager(ClientManager):
         self.register_message_receive_handler(MT.S2C_SYNC_MODEL, self._on_model)
         self.register_message_receive_handler(MT.FINISH, self._on_finish)
 
+    # fedlint: disable=retry-no-dedupe -- FINISH is terminal and idempotent: the only accumulation on this path is _disarm_liveness's generation bump, which exists precisely so a late/duplicate timer or FINISH is a no-op
     def _on_finish(self, msg: Message):
         self._got_finish = True
         self.finish()

@@ -60,6 +60,11 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="text (default, human-readable) or json (structured finding "
+        "records for CI artifact upload)",
+    )
+    parser.add_argument(
         "--digest-audit", action="store_true",
         help="also run the digest-completeness fuzzer over all registered "
         "program factories (imports jax)",
@@ -71,11 +76,11 @@ def main(argv=None) -> int:
         load_baseline,
         write_baseline,
     )
-    from fedml_tpu.analysis.rules import RULES
+    from fedml_tpu.analysis.rules import PROJECT_RULES, RULES
 
     if args.list_rules:
-        for rule in RULES.values():
-            print(f"{rule.name:18s} {rule.doc}")
+        for rule in list(RULES.values()) + list(PROJECT_RULES.values()):
+            print(f"{rule.name:24s} {rule.doc}")
         return 0
 
     pkg_root = _package_root()
@@ -85,9 +90,13 @@ def main(argv=None) -> int:
         load_baseline(baseline_path) if os.path.exists(baseline_path) else set()
     )
 
-    report = lint_paths(
-        paths, baseline=baseline, rules=args.rules, base_dir=pkg_root
-    )
+    try:
+        report = lint_paths(
+            paths, baseline=baseline, rules=args.rules, base_dir=pkg_root
+        )
+    except KeyError as e:
+        print(f"fedlint: {e.args[0]}", file=sys.stderr)
+        return 2
     if args.write_baseline:
         write_baseline(baseline_path, report.findings)
         print(
@@ -95,7 +104,32 @@ def main(argv=None) -> int:
             f"{baseline_path} — review before committing"
         )
         return 0
-    print(report.render())
+    if args.format == "json":
+        import json
+
+        print(json.dumps(
+            {
+                "findings": [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "col": f.col,
+                        "message": f.message,
+                        "scope": f.scope,
+                        "fingerprint": f.fingerprint(),
+                    }
+                    for f in report.findings
+                ],
+                "suppressed": len(report.suppressed),
+                "baselined": len(report.baselined),
+                "files_checked": report.files_checked,
+                "files": report.files,
+            },
+            indent=2,
+        ))
+    else:
+        print(report.render())
 
     rc = 0
     if report.findings and args.fail_on_findings:

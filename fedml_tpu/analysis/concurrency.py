@@ -1,0 +1,625 @@
+"""fedlint concurrency rules — lock-order and thread-scope lint for the
+threaded wire stack.
+
+The serve control plane, the loopback transports, and the telemetry
+layer share one discipline: every lock is an instance attribute
+acquired with ``with self._lock:``, cross-thread work flows through
+methods, and tenant telemetry rides the thread-scoped TelemetryScope
+(telemetry/scope.py). These rules check that discipline statically,
+propagating held-lock sets through an intraprocedural call graph
+(self.method(), self.attr.method() where the attr's class is a known
+constructor assignment, and same-module functions):
+
+- ``lock-order-cycle``       — two locks acquired in both orders on
+  some pair of call paths: a deadlock candidate the moment the two
+  paths run on different threads.
+- ``unlocked-shared-mutation`` — an attribute of a lock-owning class
+  mutated under the lock in one method and outside any lock in
+  another: either the lock is decorative or the unlocked site is a
+  race. One finding per (class, attribute).
+- ``unscoped-thread``        — a ``threading.Thread`` started in
+  serve/ or splitfed/ whose target is not routed through a
+  TelemetryScope activation (``scope.wrap``, ``with x.activate()``,
+  ``self._activation(...)``, ``activate_scope``): spans and metrics
+  emitted on that thread land in the global registry, leaking across
+  tenants.
+
+Heuristic AST analysis, stdlib-only; known limits in docs/ANALYSIS.md."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.rules import (
+    Finding,
+    ProjectContext,
+    ancestors,
+    qual_name,
+    register_project,
+    scope_chain,
+)
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+_THREAD_SCOPE_DIRS = ("serve", "splitfed")
+# Callables that route a thread target through the tenant scope.
+_SCOPE_MARKERS = frozenset({
+    "activate", "_activation", "activate_scope", "wrap",
+    "wrap_in_current_scope",
+})
+
+# A lock is identified by (owner, attr): owner is the class NAME that
+# assigns it (shared down the inheritance chain) or the module path for
+# module-level locks.
+LockId = Tuple[str, str]
+# A method/function analysis unit: (owner class name or module path, name).
+UnitId = Tuple[str, str]
+
+
+def _is_lock_ctor(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    qn = qual_name(expr.func) or ""
+    return qn.split(".")[-1] in _LOCK_CTORS
+
+
+class _ClassCx:
+    def __init__(self, name: str, path: str, node: ast.ClassDef):
+        self.name = name
+        self.path = path
+        self.node = node
+        self.base_names = [
+            (qual_name(b) or "").split(".")[-1] for b in node.bases
+        ]
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: Set[str] = set()
+        # self.<attr> = ClassName(...): the attr's methods resolve there
+        self.attr_classes: Dict[str, str] = {}
+        for meth in self.methods.values():
+            for node_ in ast.walk(meth):
+                if not isinstance(node_, ast.Assign):
+                    continue
+                for t in node_.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        if _is_lock_ctor(node_.value):
+                            self.lock_attrs.add(t.attr)
+                        elif isinstance(node_.value, ast.Call):
+                            qn = qual_name(node_.value.func) or ""
+                            tail = qn.split(".")[-1]
+                            if tail and tail[0].isupper():
+                                self.attr_classes[t.attr] = tail
+
+
+class _Graph:
+    """Whole-tree lock/call model."""
+
+    def __init__(self, project: ProjectContext):
+        self.classes: Dict[str, _ClassCx] = {}
+        self.module_locks: Dict[str, Set[str]] = {}  # path -> lock names
+        self.module_funcs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        self.files = project.files
+        for fc in project.files:
+            locks: Set[str] = set()
+            funcs: Dict[str, ast.FunctionDef] = {}
+            for stmt in fc.tree.body:
+                if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                    locks |= {
+                        t.id for t in stmt.targets if isinstance(t, ast.Name)
+                    }
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    funcs[stmt.name] = stmt
+            self.module_locks[fc.path] = locks
+            self.module_funcs[fc.path] = funcs
+            for node in ast.walk(fc.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(
+                        node.name, _ClassCx(node.name, fc.path, node)
+                    )
+
+    def lock_owner(self, cls_name: str, attr: str) -> Optional[str]:
+        """Class (walking the base chain) that assigns self.<attr> as a
+        lock — the identity shared by base and subclass methods."""
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            cx = self.classes.get(c)
+            if cx is None:
+                continue
+            if attr in cx.lock_attrs:
+                return c
+            stack.extend(cx.base_names)
+        return None
+
+    def method(self, cls_name: str, meth: str) -> Optional[Tuple[str, ast.FunctionDef]]:
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            cx = self.classes.get(c)
+            if cx is None:
+                continue
+            if meth in cx.methods:
+                return c, cx.methods[meth]
+            stack.extend(cx.base_names)
+        return None
+
+    def attr_class(self, cls_name: str, attr: str) -> Optional[str]:
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            cx = self.classes.get(c)
+            if cx is None:
+                continue
+            if attr in cx.attr_classes:
+                return cx.attr_classes[attr]
+            stack.extend(cx.base_names)
+        return None
+
+
+class _UnitSummary:
+    """Per-method facts from one lexical walk with held-set tracking."""
+
+    def __init__(self):
+        self.acquires: Dict[LockId, int] = {}  # lock -> first line
+        # (outer, inner) -> (line, scope): lexically nested acquisitions
+        self.edges: Dict[Tuple[LockId, LockId], Tuple[int, str]] = {}
+        # (held locks at the call, callee key, line)
+        self.calls: List[Tuple[Tuple[LockId, ...], tuple, int]] = []
+        # attr -> first line, for mutation classification
+        self.locked_mut: Dict[str, int] = {}
+        self.unlocked_mut: Dict[str, Tuple[int, str]] = {}
+
+
+def _analyze_unit(
+    graph: _Graph,
+    path: str,
+    fn: ast.AST,
+    cls: Optional[_ClassCx],
+) -> _UnitSummary:
+    s = _UnitSummary()
+
+    def lock_of(expr: ast.AST) -> Optional[LockId]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            owner = graph.lock_owner(cls.name, expr.attr)
+            if owner is not None:
+                return (owner, expr.attr)
+        elif isinstance(expr, ast.Name) and expr.id in graph.module_locks.get(
+            path, set()
+        ):
+            return (path, expr.id)
+        return None
+
+    def note_mutation(target: ast.AST, held, line: int, scope: str):
+        if cls is None or not cls.lock_attrs:
+            return
+        node = target
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if not (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return
+        attr = node.attr
+        if graph.lock_owner(cls.name, attr) is not None:
+            return  # the lock object itself
+        class_held = any(o != path for (o, _a) in held)
+        if class_held:
+            s.locked_mut.setdefault(attr, line)
+        else:
+            s.unlocked_mut.setdefault(attr, (line, scope))
+
+    def visit(node: ast.AST, held: Tuple[LockId, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+                and node is not fn:
+            return  # nested defs run on their own thread/context
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[LockId] = []
+            for item in node.items:
+                visit(item.context_expr, held)
+                lk = lock_of(item.context_expr)
+                if lk is not None:
+                    for h in tuple(held) + tuple(acquired):
+                        if h != lk:
+                            s.edges.setdefault(
+                                (h, lk), (node.lineno, scope_chain(node))
+                            )
+                    s.acquires.setdefault(lk, node.lineno)
+                    acquired.append(lk)
+            inner = held + tuple(acquired)
+            for st in node.body:
+                visit(st, inner)
+            return
+        if isinstance(node, ast.Call):
+            key = None
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if isinstance(f.value, ast.Name) and f.value.id == "self":
+                    key = ("self", f.attr)
+                elif (
+                    isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"
+                ):
+                    key = ("attr", f.value.attr, f.attr)
+            elif isinstance(f, ast.Name):
+                key = ("mod", f.id)
+            if key is not None:
+                s.calls.append((held, key, node.lineno))
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)) and (
+            not isinstance(node, ast.AnnAssign) or node.value is not None
+        ):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                note_mutation(t, held, node.lineno, scope_chain(node))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    body = fn.body if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+        else [fn]
+    for st in body:
+        visit(st, ())
+    return s
+
+
+class _Analysis:
+    """Summaries for every method/function plus the transitive-acquire
+    fixpoint — shared by the two lock rules."""
+
+    def __init__(self, project: ProjectContext):
+        self.graph = _Graph(project)
+        self.summaries: Dict[UnitId, _UnitSummary] = {}
+        self.unit_path: Dict[UnitId, str] = {}
+        self.unit_cls: Dict[UnitId, Optional[str]] = {}
+        g = self.graph
+        for cx in g.classes.values():
+            for mname, meth in cx.methods.items():
+                uid = (cx.name, mname)
+                self.summaries[uid] = _analyze_unit(g, cx.path, meth, cx)
+                self.unit_path[uid] = cx.path
+                self.unit_cls[uid] = cx.name
+        for path, funcs in g.module_funcs.items():
+            for fname, fdef in funcs.items():
+                uid = (path, fname)
+                if uid in self.summaries:
+                    continue
+                self.summaries[uid] = _analyze_unit(g, path, fdef, None)
+                self.unit_path[uid] = path
+                self.unit_cls[uid] = None
+
+    def resolve_call(self, uid: UnitId, key: tuple) -> Optional[UnitId]:
+        g = self.graph
+        cls = self.unit_cls[uid]
+        if key[0] == "self" and cls is not None:
+            hit = g.method(cls, key[1])
+            return (hit[0], key[1]) if hit else None
+        if key[0] == "attr" and cls is not None:
+            target_cls = g.attr_class(cls, key[1])
+            if target_cls is not None:
+                hit = g.method(target_cls, key[2])
+                return (hit[0], key[2]) if hit else None
+            return None
+        if key[0] == "mod":
+            path = self.unit_path[uid]
+            if key[1] in g.module_funcs.get(path, {}):
+                return (path, key[1])
+        return None
+
+    def transitive_acquires(self) -> Dict[UnitId, Set[LockId]]:
+        acq: Dict[UnitId, Set[LockId]] = {
+            uid: set(s.acquires) for uid, s in self.summaries.items()
+        }
+        for _ in range(8):
+            grew = False
+            for uid, s in self.summaries.items():
+                for _held, key, _line in s.calls:
+                    callee = self.resolve_call(uid, key)
+                    if callee is None or callee not in acq:
+                        continue
+                    extra = acq[callee] - acq[uid]
+                    if extra:
+                        acq[uid] |= extra
+                        grew = True
+            if not grew:
+                break
+        return acq
+
+
+def _analysis(project: ProjectContext) -> _Analysis:
+    cached = getattr(project, "_concurrency_analysis", None)
+    if cached is None:
+        cached = _Analysis(project)
+        project._concurrency_analysis = cached
+    return cached
+
+
+def _fmt_lock(lk: LockId) -> str:
+    owner, attr = lk
+    sep = ":" if "/" in owner or owner.endswith(".py") else "."
+    return f"{owner}{sep}{attr}"
+
+
+# --------------------------------------------------------------------------
+# lock-order-cycle
+# --------------------------------------------------------------------------
+
+
+@register_project(
+    "lock-order-cycle",
+    "two locks acquired in both orders on different call paths "
+    "(deadlock candidate)",
+)
+def check_lock_order_cycle(project: ProjectContext) -> List[Finding]:
+    an = _analysis(project)
+    acq = an.transitive_acquires()
+    # (outer, inner) -> (path, line, scope), first witness wins
+    edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]] = {}
+    for uid, s in an.summaries.items():
+        path = an.unit_path[uid]
+        for (a, b), (line, scope) in s.edges.items():
+            edges.setdefault((a, b), (path, line, scope))
+        for held, key, line in s.calls:
+            if not held:
+                continue
+            callee = an.resolve_call(uid, key)
+            if callee is None:
+                continue
+            for inner in acq.get(callee, ()):
+                for outer in held:
+                    if outer != inner:
+                        edges.setdefault(
+                            (outer, inner),
+                            (path, line, f"{uid[0]}.{uid[1]}"),
+                        )
+    out: List[Finding] = []
+    seen: Set[Tuple[LockId, LockId]] = set()
+    for (a, b) in edges:
+        if (b, a) not in edges:
+            continue
+        pair = (a, b) if _fmt_lock(a) < _fmt_lock(b) else (b, a)
+        if pair in seen:
+            continue
+        seen.add(pair)
+        w_ab = edges[pair]
+        w_ba = edges[(pair[1], pair[0])]
+        out.append(
+            Finding(
+                "lock-order-cycle", w_ab[0], w_ab[1], 0,
+                f"locks {_fmt_lock(pair[0])} and {_fmt_lock(pair[1])} are "
+                f"acquired in both orders ({w_ab[2]} takes "
+                f"{_fmt_lock(pair[0])} then {_fmt_lock(pair[1])}; {w_ba[2]} "
+                "the reverse) — deadlock candidate",
+                scope=w_ab[2],
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# unlocked-shared-mutation
+# --------------------------------------------------------------------------
+
+
+@register_project(
+    "unlocked-shared-mutation",
+    "attribute mutated both under and outside its class's lock",
+)
+def check_unlocked_shared_mutation(project: ProjectContext) -> List[Finding]:
+    an = _analysis(project)
+    # caller-holds-the-lock convention: a method every intraclass call
+    # site of which runs under a class lock counts as locked context
+    callers: Dict[UnitId, List[Tuple[UnitId, bool]]] = {}
+    for uid, s in an.summaries.items():
+        for held, key, _line in s.calls:
+            callee = an.resolve_call(uid, key)
+            if callee is None or an.unit_cls.get(callee) is None:
+                continue
+            if an.unit_cls[callee] != an.unit_cls[uid] and key[0] != "self":
+                continue
+            class_held = any("/" not in o for (o, _a) in held)
+            callers.setdefault(callee, []).append((uid, class_held))
+    # Greatest fixpoint: start every method WITH intraclass callers as
+    # locked-context and demote on any unlocked call site. Least-fixpoint
+    # would never prove a self-recursive method (the secure-agg
+    # _complete_round re-entry) locked — its own call site depends on
+    # the answer.
+    locked_context: Dict[UnitId, bool] = {uid: True for uid in callers}
+    for _ in range(8):
+        changed = False
+        for callee, sites in callers.items():
+            val = all(
+                held or locked_context.get(caller, False)
+                for caller, held in sites
+            )
+            if locked_context[callee] != val:
+                locked_context[callee] = val
+                changed = True
+        if not changed:
+            break
+
+    per_class_locked: Dict[str, Set[str]] = {}
+    per_class_unlocked: Dict[str, Dict[str, Tuple[str, int, str, str]]] = {}
+    for uid, s in an.summaries.items():
+        cls = an.unit_cls.get(uid)
+        if cls is None or uid[1] in ("__init__", "__post_init__"):
+            continue
+        locked = set(s.locked_mut)
+        unlocked = dict(s.unlocked_mut)
+        if locked_context.get(uid, False):
+            locked |= set(unlocked)
+            unlocked = {}
+        per_class_locked.setdefault(cls, set()).update(locked)
+        dst = per_class_unlocked.setdefault(cls, {})
+        for attr, (line, scope) in unlocked.items():
+            cur = dst.get(attr)
+            if cur is None or (an.unit_path[uid], line) < (cur[0], cur[1]):
+                dst[attr] = (an.unit_path[uid], line, scope, uid[1])
+    out: List[Finding] = []
+    for cls, attrs in sorted(per_class_unlocked.items()):
+        locked = per_class_locked.get(cls, set())
+        for attr in sorted(attrs):
+            if attr not in locked:
+                continue
+            path, line, scope, meth = attrs[attr]
+            out.append(
+                Finding(
+                    "unlocked-shared-mutation", path, line, 0,
+                    f"self.{attr} of {cls} is mutated under the class lock "
+                    f"elsewhere but written without it in {meth} — either "
+                    "the lock is decorative or this write races",
+                    scope=scope,
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# unscoped-thread
+# --------------------------------------------------------------------------
+
+
+def _body_activates_scope(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            qn = qual_name(node.func) or ""
+            if qn.split(".")[-1] in _SCOPE_MARKERS:
+                return True
+    return False
+
+
+def _find_local_def(func: Optional[ast.AST], name: str) -> Optional[ast.AST]:
+    if func is None:
+        return None
+    for node in ast.walk(func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _target_is_scoped(
+    expr: ast.AST,
+    func: Optional[ast.AST],
+    cls: Optional[ast.ClassDef],
+) -> bool:
+    if isinstance(expr, ast.Call):
+        qn = qual_name(expr.func) or ""
+        if qn.split(".")[-1] in _SCOPE_MARKERS:
+            return True
+        if qn.split(".")[-1] == "partial" and expr.args:
+            return _target_is_scoped(expr.args[0], func, cls)
+        return False
+    if isinstance(expr, ast.IfExp):
+        return _target_is_scoped(expr.body, func, cls) and _target_is_scoped(
+            expr.orelse, func, cls
+        )
+    if isinstance(expr, ast.Name):
+        local = _find_local_def(func, expr.id)
+        if local is not None and _body_activates_scope(local):
+            return True
+        if func is not None:
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in node.targets
+                ):
+                    if _target_is_scoped(node.value, func, cls):
+                        return True
+        return False
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and cls is not None
+    ):
+        # self.<attr> as target: accept when the attr is assigned a
+        # scope-activating local def or wrapper anywhere in the class
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr == expr.attr
+                    for t in node.targets
+                ):
+                    if _target_is_scoped(node.value, meth, cls):
+                        return True
+            if meth.name == expr.attr and _body_activates_scope(meth):
+                return True
+    return False
+
+
+@register_project(
+    "unscoped-thread",
+    "threading.Thread in serve//splitfed/ whose target bypasses the "
+    "TelemetryScope wrapper (cross-tenant telemetry leak)",
+)
+def check_unscoped_thread(project: ProjectContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fc in project.files:
+        if not fc.in_dirs(_THREAD_SCOPE_DIRS):
+            continue
+        for node in ast.walk(fc.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qn = qual_name(node.func) or ""
+            if qn not in ("threading.Thread", "Thread"):
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None:
+                continue
+            func = None
+            cls = None
+            for a in ancestors(node):
+                if func is None and isinstance(
+                    a, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    func = a
+                if isinstance(a, ast.ClassDef):
+                    cls = a
+                    break
+            if _target_is_scoped(target, func, cls):
+                continue
+            out.append(
+                Finding(
+                    "unscoped-thread", fc.path, node.lineno, 0,
+                    "thread target is not routed through a TelemetryScope "
+                    "activation (scope.wrap / with activate() / "
+                    "wrap_in_current_scope) — spans and metrics emitted on "
+                    "this thread leak into the global registry",
+                    scope=scope_chain(node),
+                )
+            )
+    return out

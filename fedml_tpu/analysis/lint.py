@@ -33,11 +33,18 @@ import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from fedml_tpu.analysis.rules import (
+    PROJECT_RULES,
     RULES,
     FileContext,
     Finding,
+    ProjectContext,
     _attach_parents,
 )
+
+# Importing these modules registers the cross-module rules (protocol
+# flow, lock order) into PROJECT_RULES.
+from fedml_tpu.analysis import concurrency as _concurrency  # noqa: F401,E402
+from fedml_tpu.analysis import protocol as _protocol  # noqa: F401,E402
 
 _SUPPRESS_RE = re.compile(
     r"#\s*fedlint:\s*disable=([A-Za-z0-9_,\- ]+?)(?:\s*--\s*(.*))?\s*$"
@@ -50,6 +57,9 @@ class LintReport:
     suppressed: List[Finding]        # silenced by an inline justification
     baselined: List[Finding]         # accepted debt from the baseline file
     files_checked: int = 0
+    # every visited file, repo-relative — the walk-scope pin
+    # (tests/test_analysis.py) and --format json read this
+    files: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -188,9 +198,20 @@ def lint_paths(
     base_dir: Optional[str] = None,
 ) -> LintReport:
     """Run fedlint over ``paths`` (files or directories). ``rules``
-    restricts to a subset of rule names; ``baseline`` is a set of
-    accepted fingerprints; ``base_dir`` makes reported paths relative."""
-    selected = [RULES[r] for r in rules] if rules else list(RULES.values())
+    restricts to a subset of rule names (per-file and project rules
+    share one namespace); ``baseline`` is a set of accepted
+    fingerprints; ``base_dir`` makes reported paths relative."""
+    if rules:
+        unknown = [r for r in rules if r not in RULES and r not in PROJECT_RULES]
+        if unknown:
+            raise KeyError(
+                f"unknown rule(s): {', '.join(unknown)} — see --list-rules"
+            )
+        selected = [RULES[r] for r in rules if r in RULES]
+        selected_project = [PROJECT_RULES[r] for r in rules if r in PROJECT_RULES]
+    else:
+        selected = list(RULES.values())
+        selected_project = list(PROJECT_RULES.values())
     files = _iter_py_files(paths)
     index = _HelperIndex()
     parsed: List[Tuple[str, ast.Module, str]] = []
@@ -204,34 +225,53 @@ def lint_paths(
         index.add(path, tree)
         parsed.append((path, tree, source))
 
-    report = LintReport([], [], [], files_checked=len(files))
-    baseline = baseline or set()
+    contexts: List[FileContext] = []
+    sup_by_rel: Dict[str, Dict[int, Tuple[Set[str], bool]]] = {}
     for path, tree, source in parsed:
         rel = _relpath(path, base_dir)
-        ctx = FileContext(rel, tree, source, resolve_helper=index.resolver(path))
-        sup = _suppressions(source)
+        contexts.append(
+            FileContext(rel, tree, source, resolve_helper=index.resolver(path))
+        )
+        sup_by_rel[rel] = _suppressions(source)
+
+    report = LintReport(
+        [], [], [],
+        files_checked=len(files),
+        files=[c.path for c in contexts],
+    )
+    baseline = baseline or set()
+
+    def _classify(finding: Finding) -> None:
+        sup = sup_by_rel.get(finding.path, {})
+        entry = sup.get(finding.line) or sup.get(finding.line - 1)
+        if entry is not None and (
+            finding.rule in entry[0] or "all" in entry[0]
+        ):
+            if not entry[1]:
+                # suppression without a justification: keep the
+                # silenced finding out, surface the discipline gap
+                report.findings.append(
+                    Finding(
+                        "bare-suppression", finding.path, finding.line, 0,
+                        f"suppression of {finding.rule} has no "
+                        "justification — append '-- <reason>'",
+                        scope=finding.scope,
+                    )
+                )
+            report.suppressed.append(finding)
+            return
+        if finding.fingerprint() in baseline:
+            report.baselined.append(finding)
+            return
+        report.findings.append(finding)
+
+    for ctx in contexts:
         for rule in selected:
             for finding in rule.check(ctx):
-                entry = sup.get(finding.line) or sup.get(finding.line - 1)
-                if entry is not None and (
-                    finding.rule in entry[0] or "all" in entry[0]
-                ):
-                    if not entry[1]:
-                        # suppression without a justification: keep the
-                        # silenced finding out, surface the discipline gap
-                        report.findings.append(
-                            Finding(
-                                "bare-suppression", rel, finding.line, 0,
-                                f"suppression of {finding.rule} has no "
-                                "justification — append '-- <reason>'",
-                                scope=finding.scope,
-                            )
-                        )
-                    report.suppressed.append(finding)
-                    continue
-                if finding.fingerprint() in baseline:
-                    report.baselined.append(finding)
-                    continue
-                report.findings.append(finding)
+                _classify(finding)
+    project = ProjectContext(contexts)
+    for prule in selected_project:
+        for finding in prule.check(project):
+            _classify(finding)
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return report

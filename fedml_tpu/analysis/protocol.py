@@ -1,0 +1,747 @@
+"""fedlint protocol rules — cross-module message-flow verification for
+the wire stack.
+
+The transports are message-passing actor programs: a ``MessageType``
+constant names an edge, ``Message(<type>, src, dst)`` construction sites
+are the sends, ``register_message_receive_handler(<type>, fn)`` sites
+are the receives, and ``BaseCommManager.send_message`` retries (so
+delivery is at-least-once whenever a RetryPolicy is installed — every
+manager constructed with ``config=``). These rules rebuild that graph
+from the ASTs of the whole linted tree and check the invariants every
+review pass since PR 3 has re-checked by hand:
+
+- ``sent-unhandled``  — a type sent by a manager whose module's peer
+  managers never register a handler for it (receive_message raises
+  KeyError at runtime — but only when the message actually arrives).
+- ``dead-msg-type``   — a type constant defined but never sent anywhere
+  in the tree: either dead protocol surface or a send that silently
+  fell off during a refactor.
+- ``retry-no-dedupe`` — a type whose send path is under the retry
+  template, but whose handler ACCUMULATES state (append/add/+=/
+  subscript-store) without a dedupe guard comparing message-derived
+  data against handler state. At-least-once delivery turns that into
+  double-counted uploads (the fedbuff restated-assignment and SplitNN
+  double-DONE bug classes).
+- ``reply-closure``   — a handler for type T sends reply type R: every
+  manager class that originates T must register a handler for R, or
+  the reply dies in a KeyError on the originator.
+
+Everything here is heuristic AST work (see docs/ANALYSIS.md for the
+known limits): send types are resolved through locals, parameter
+defaults and same-class call sites; dedupe guards are recognized as an
+``if`` whose test mixes message-derived names with handler state and
+whose body returns. Stdlib-only, like every fedlint rule."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.rules import (
+    Finding,
+    ProjectContext,
+    ancestors,
+    qual_name,
+    register_project,
+    scope_chain,
+)
+
+# Message-TYPE constants only: ARG_* (param keys) never name an edge.
+_TYPE_NAME = re.compile(r"^(S2C_|C2S_|MSG_)\w+$|^FINISH$")
+
+# Mutating container methods that make a handler ACCUMULATE state (the
+# at-least-once hazard). Removals (pop/discard/clear) are idempotent
+# cleanup and plain `self.x = v` is last-writer-wins — both excluded.
+_ACCUMULATORS = frozenset({
+    "append", "add", "extend", "update", "insert", "setdefault",
+    "appendleft", "push", "put",
+})
+
+_GUARD_RECURSION_DEPTH = 2
+_REPLY_RECURSION_DEPTH = 3
+
+
+class _ClassInfo:
+    def __init__(self, name: str, path: str, node: ast.ClassDef):
+        self.name = name
+        self.path = path
+        self.node = node
+        self.base_names: List[str] = []
+        for b in node.bases:
+            qn = qual_name(b)
+            if qn:
+                self.base_names.append(qn.split(".")[-1])
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+
+class _SendSite:
+    def __init__(self, type_name, cls, path, line, scope, retried, func):
+        self.type_name = type_name
+        self.cls: Optional[str] = cls
+        self.path = path
+        self.line = line
+        self.scope = scope
+        self.retried = retried
+        self.func: Optional[ast.FunctionDef] = func  # enclosing def
+
+
+class _HandlerSite:
+    def __init__(self, type_name, cls, path, line, scope, handler):
+        self.type_name = type_name
+        self.cls: str = cls
+        self.path = path
+        self.line = line
+        self.scope = scope
+        # ("method", name) | ("lambda", node) | None
+        self.handler = handler
+
+
+class _Model:
+    """The whole-tree message-flow graph."""
+
+    def __init__(self):
+        # constant name -> [(path, line)]
+        self.consts: Dict[str, List[Tuple[str, int]]] = {}
+        self.by_value: Dict[str, str] = {}  # string value -> constant name
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.sends: List[_SendSite] = []
+        self.handlers: List[_HandlerSite] = []
+
+    # -- roles / retry --
+
+    def role(self, cls_name: str, _seen: frozenset = frozenset()) -> Optional[str]:
+        if cls_name == "ServerManager":
+            return "server"
+        if cls_name == "ClientManager":
+            return "client"
+        if cls_name in _seen:
+            return None
+        ci = self.classes.get(cls_name)
+        if ci is None:
+            return None
+        for b in ci.base_names:
+            r = self.role(b, _seen | {cls_name})
+            if r:
+                return r
+        return None
+
+    def is_manager(self, cls_name: Optional[str]) -> bool:
+        return bool(cls_name) and self.role(cls_name) is not None
+
+    def retry_enabled(self, cls_name: str) -> bool:
+        """A manager only gets the retry template when its __init__
+        hands a RunConfig up to _ManagerBase (``config=`` or a third
+        positional). Unknown -> True (conservative: more dedupe checks,
+        never fewer)."""
+        ci = self.classes.get(cls_name)
+        if ci is None:
+            return True
+        init = ci.methods.get("__init__")
+        if init is None:
+            for b in ci.base_names:
+                if b in self.classes:
+                    return self.retry_enabled(b)
+            return True
+        for node in ast.walk(init):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "__init__"
+            ):
+                return len(node.args) >= 3 or any(
+                    kw.arg == "config" for kw in node.keywords
+                )
+        return True
+
+    def method(self, cls_name: str, meth: str) -> Optional[ast.FunctionDef]:
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            ci = self.classes.get(c)
+            if ci is None:
+                continue
+            if meth in ci.methods:
+                return ci.methods[meth]
+            stack.extend(ci.base_names)
+        return None
+
+    def handled_types(self, cls_name: str) -> Set[str]:
+        """Types a class registers handlers for, base chain included."""
+        out: Set[str] = set()
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            out |= {h.type_name for h in self.handlers if h.cls == c}
+            ci = self.classes.get(c)
+            if ci is not None:
+                stack.extend(ci.base_names)
+        return out
+
+
+def _const_ref(expr: Optional[ast.AST], model: _Model) -> Optional[str]:
+    """Resolve an expression to a known message-type constant name."""
+    if isinstance(expr, ast.Attribute) and expr.attr in model.consts:
+        return expr.attr
+    if isinstance(expr, ast.Name) and expr.id in model.consts:
+        return expr.id
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return model.by_value.get(expr.value)
+    return None
+
+
+def _enclosing(node: ast.AST):
+    """(nearest enclosing FunctionDef, nearest enclosing ClassDef)."""
+    func = None
+    cls = None
+    for a in ancestors(node):
+        if func is None and isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = a
+        if isinstance(a, ast.ClassDef):
+            cls = a
+            break
+    return func, cls
+
+
+def _param_index(func: ast.FunctionDef, name: str) -> Optional[int]:
+    params = [a.arg for a in func.args.args]
+    return params.index(name) if name in params else None
+
+
+def _param_default(func: ast.FunctionDef, name: str) -> Optional[ast.AST]:
+    args = func.args
+    pos = [a.arg for a in args.args]
+    if name in pos:
+        i = pos.index(name)
+        off = len(pos) - len(args.defaults)
+        if i >= off:
+            return args.defaults[i - off]
+    if name in [a.arg for a in args.kwonlyargs]:
+        i = [a.arg for a in args.kwonlyargs].index(name)
+        return args.kw_defaults[i]
+    return None
+
+
+def _resolve_type_exprs(
+    expr: ast.AST,
+    func: Optional[ast.FunctionDef],
+    cls_node: Optional[ast.ClassDef],
+    tree: ast.Module,
+    model: _Model,
+) -> List[str]:
+    """Every message-type constant ``expr`` can name at a Message()
+    construction site: direct refs, a local assigned from a constant, a
+    parameter (resolved through its default and through same-class /
+    same-module call sites of the enclosing function)."""
+    direct = _const_ref(expr, model)
+    if direct:
+        return [direct]
+    out: List[str] = []
+    if not (isinstance(expr, ast.Name) and func is not None):
+        return out
+    name = expr.id
+    for n in ast.walk(func):
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in n.targets
+        ):
+            c = _const_ref(n.value, model)
+            if c:
+                out.append(c)
+    idx = _param_index(func, name)
+    if idx is not None:
+        c = _const_ref(_param_default(func, name), model)
+        if c:
+            out.append(c)
+        search_root: ast.AST = cls_node if cls_node is not None else tree
+        has_self = bool(func.args.args) and func.args.args[0].arg == "self"
+        for n in ast.walk(search_root):
+            if not isinstance(n, ast.Call):
+                continue
+            qn = qual_name(n.func) or ""
+            if qn.split(".")[-1] != func.name or n.func is func:
+                continue
+            # a self.method(...) call site omits the bound first param
+            off = 1 if (has_self and "." in qn) else 0
+            arg: Optional[ast.AST] = None
+            if 0 <= idx - off < len(n.args):
+                arg = n.args[idx - off]
+            for kw in n.keywords:
+                if kw.arg == name:
+                    arg = kw.value
+            c = _const_ref(arg, model)
+            if c:
+                out.append(c)
+    seen: Set[str] = set()
+    uniq = []
+    for c in out:
+        if c not in seen:
+            seen.add(c)
+            uniq.append(c)
+    return uniq
+
+
+def _send_is_nowait(call: ast.Call, func: Optional[ast.FunctionDef]) -> bool:
+    """True when this Message() construction only ever reaches
+    ``send_message_nowait`` (the single-attempt path)."""
+    prev: ast.AST = call
+    for anc in ancestors(call):
+        if isinstance(anc, ast.Call) and prev in anc.args:
+            qn = qual_name(anc.func) or ""
+            if qn.endswith("send_message_nowait"):
+                return True
+            if qn.split(".")[-1].startswith(("send_message", "_broadcast")):
+                return False
+        if isinstance(anc, ast.Assign) and func is not None:
+            for t in anc.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                nowait = retried = False
+                for c in ast.walk(func):
+                    if not isinstance(c, ast.Call):
+                        continue
+                    if not any(
+                        isinstance(a, ast.Name) and a.id == t.id for a in c.args
+                    ):
+                        continue
+                    qn = qual_name(c.func) or ""
+                    tail = qn.split(".")[-1]
+                    if tail == "send_message_nowait":
+                        nowait = True
+                    elif "send" in tail or "broadcast" in tail or "dispatch" in tail:
+                        retried = True
+                return nowait and not retried
+        prev = anc
+    return False
+
+
+def build_model(project: ProjectContext) -> _Model:
+    cached = getattr(project, "_protocol_model", None)
+    if cached is not None:
+        return cached
+    model = _Model()
+    # pass 1: constants + classes
+    for fc in project.files:
+        for node in ast.walk(fc.tree):
+            if isinstance(node, ast.ClassDef):
+                model.classes.setdefault(
+                    node.name, _ClassInfo(node.name, fc.path, node)
+                )
+        bodies = [fc.tree.body] + [
+            n.body for n in fc.tree.body if isinstance(n, ast.ClassDef)
+        ]
+        for body in bodies:
+            for stmt in body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    if (
+                        isinstance(t, ast.Name)
+                        and _TYPE_NAME.match(t.id)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)
+                    ):
+                        model.consts.setdefault(t.id, []).append(
+                            (fc.path, stmt.lineno)
+                        )
+                        model.by_value.setdefault(stmt.value.value, t.id)
+    # pass 2: sends + handlers
+    for fc in project.files:
+        for node in ast.walk(fc.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qn = qual_name(node.func) or ""
+            tail = qn.split(".")[-1]
+            if tail == "Message" and node.args:
+                func, cls = _enclosing(node)
+                cls_name = cls.name if cls is not None else None
+                types = _resolve_type_exprs(
+                    node.args[0], func, cls, fc.tree, model
+                )
+                if not types:
+                    continue
+                nowait = _send_is_nowait(node, func)
+                retried = not nowait
+                if retried and model.is_manager(cls_name):
+                    retried = model.retry_enabled(cls_name)
+                for ty in types:
+                    model.sends.append(
+                        _SendSite(
+                            ty, cls_name, fc.path, node.lineno,
+                            scope_chain(node), retried, func,
+                        )
+                    )
+            elif tail == "register_message_receive_handler" and len(node.args) >= 2:
+                _, cls = _enclosing(node)
+                if cls is None:
+                    continue
+                ty = _const_ref(node.args[0], model)
+                if ty is None:
+                    continue
+                h = node.args[1]
+                handler = None
+                if isinstance(h, ast.Attribute) and qual_name(h) == f"self.{h.attr}":
+                    handler = ("method", h.attr)
+                elif isinstance(h, ast.Lambda):
+                    handler = ("lambda", h)
+                model.handlers.append(
+                    _HandlerSite(
+                        ty, cls.name, fc.path, node.lineno,
+                        scope_chain(node), handler,
+                    )
+                )
+    project._protocol_model = model  # one graph per lint run
+    return model
+
+
+# --------------------------------------------------------------------------
+# sent-unhandled
+# --------------------------------------------------------------------------
+
+
+@register_project(
+    "sent-unhandled",
+    "message type sent to a peer manager that never registers a handler",
+)
+def check_sent_unhandled(project: ProjectContext) -> List[Finding]:
+    model = build_model(project)
+    global_handled = {h.type_name for h in model.handlers}
+    # types registered by any manager defined in a given file — the
+    # module is the protocol family (each transport pairs its client
+    # and server classes in one file)
+    module_handled: Dict[str, Set[str]] = {}
+    for ci in model.classes.values():
+        if model.is_manager(ci.name):
+            module_handled.setdefault(ci.path, set()).update(
+                model.handled_types(ci.name)
+            )
+    out: List[Finding] = []
+    seen: Set[Tuple[Optional[str], str, str]] = set()
+    for s in model.sends:
+        key = (s.cls, s.type_name, s.path)
+        if key in seen:
+            continue
+        seen.add(key)
+        if s.cls is not None and model.is_manager(s.cls):
+            family = module_handled.get(s.path, set())
+            ok = s.type_name in family if family else s.type_name in global_handled
+            where = "a manager in the same module"
+        else:
+            ok = s.type_name in global_handled
+            where = "any manager"
+        if not ok:
+            sender = s.cls or "module-level code"
+            out.append(
+                Finding(
+                    "sent-unhandled", s.path, s.line, 0,
+                    f"message type {s.type_name} is sent by {sender} but "
+                    f"never registered by {where} — receive_message will "
+                    "raise KeyError on delivery",
+                    scope=s.scope,
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# dead-msg-type
+# --------------------------------------------------------------------------
+
+
+@register_project(
+    "dead-msg-type",
+    "message type constant defined but never sent anywhere in the tree",
+)
+def check_dead_msg_type(project: ProjectContext) -> List[Finding]:
+    model = build_model(project)
+    sent = {s.type_name for s in model.sends}
+    out: List[Finding] = []
+    for name, defs in sorted(model.consts.items()):
+        if name in sent:
+            continue
+        for path, line in defs:
+            out.append(
+                Finding(
+                    "dead-msg-type", path, line, 0,
+                    f"message type {name} is defined but never sent — "
+                    "dead protocol surface, or a send lost in a refactor",
+                    scope=name,
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# retry-no-dedupe
+# --------------------------------------------------------------------------
+
+
+def _self_attr_chain(expr: ast.AST) -> bool:
+    """True when expr contains a self.<attr>... access."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                and n.value.id == "self":
+            return True
+    return False
+
+
+def _accumulates(model: _Model, cls: str, fn: ast.AST, depth: int,
+                 _seen: Optional[Set[str]] = None) -> bool:
+    """Does the handler (or a self-method it calls, depth-bounded)
+    accumulate state on self?"""
+    _seen = _seen if _seen is not None else set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.AugAssign) and _self_attr_chain(node.target):
+            return True
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Subscript) and _self_attr_chain(t.value)
+            for t in node.targets
+        ):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ACCUMULATORS
+            and _self_attr_chain(node.func.value)
+        ):
+            return True
+    if depth > 0:
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr not in _seen
+            ):
+                _seen.add(node.func.attr)
+                callee = model.method(cls, node.func.attr)
+                if callee is not None and _accumulates(
+                    model, cls, callee, depth - 1, _seen
+                ):
+                    return True
+    return False
+
+
+def _tainted_names(fn: ast.AST, roots: Set[str]) -> Tuple[Set[str], Set[str]]:
+    """(message-derived names, self-derived names) within fn — a
+    fixpoint over simple assignments."""
+    tainted = set(roots)
+    selfd: Set[str] = set()
+    for _ in range(4):
+        grew = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = {
+                n.id for n in ast.walk(node.value) if isinstance(n, ast.Name)
+            }
+            for t in node.targets:
+                targets = [t.id] if isinstance(t, ast.Name) else [
+                    e.id for e in getattr(t, "elts", []) if isinstance(e, ast.Name)
+                ]
+                for tid in targets:
+                    if names & tainted and tid not in tainted:
+                        tainted.add(tid)
+                        grew = True
+                    if ("self" in names or names & selfd) and tid not in selfd:
+                        selfd.add(tid)
+                        grew = True
+        if not grew:
+            break
+    return tainted, selfd
+
+
+def _has_dedupe_guard(model: _Model, cls: str, fn, msg_params: Set[str],
+                      depth: int, _seen: Optional[Set[str]] = None) -> bool:
+    """A dedupe guard is an ``if`` whose test mixes message-derived
+    names with handler/self state and whose body returns early — the
+    shape of every real dedupe in this tree (fedbuff last-tag, sync
+    round-idx compare, SplitNN done-set membership)."""
+    _seen = _seen if _seen is not None else set()
+    if isinstance(fn, ast.Lambda):
+        return False
+    tainted, selfd = _tainted_names(fn, msg_params)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        test_names = {
+            n.id for n in ast.walk(node.test) if isinstance(n, ast.Name)
+        }
+        has_msg = bool(test_names & tainted)
+        has_state = "self" in test_names or bool(test_names & selfd)
+        has_return = any(
+            isinstance(n, ast.Return) for b in node.body for n in ast.walk(b)
+        )
+        if has_msg and has_state and has_return:
+            return True
+    if depth > 0:
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                continue
+            passes_msg = any(
+                isinstance(n, ast.Name) and n.id in tainted
+                for a in node.args for n in ast.walk(a)
+            )
+            if not passes_msg or node.func.attr in _seen:
+                continue
+            _seen.add(node.func.attr)
+            callee = model.method(cls, node.func.attr)
+            if callee is None:
+                continue
+            callee_params = {
+                a.arg for a in callee.args.args if a.arg != "self"
+            }
+            if _has_dedupe_guard(
+                model, cls, callee, callee_params, depth - 1, _seen
+            ):
+                return True
+    return False
+
+
+@register_project(
+    "retry-no-dedupe",
+    "handler of a retried (at-least-once) message type accumulates "
+    "state without a dedupe guard",
+)
+def check_retry_no_dedupe(project: ProjectContext) -> List[Finding]:
+    model = build_model(project)
+    retried_types = {s.type_name for s in model.sends if s.retried}
+    out: List[Finding] = []
+    reported: Set[Tuple[str, str]] = set()
+    for h in model.handlers:
+        if h.type_name not in retried_types or h.handler is None:
+            continue
+        kind, ref = h.handler
+        if kind == "lambda":
+            fn: ast.AST = ref
+            fname = "<lambda>"
+            msg_params = {a.arg for a in ref.args.args}
+        else:
+            fn = model.method(h.cls, ref)
+            fname = ref
+            if fn is None:
+                continue
+            msg_params = {a.arg for a in fn.args.args if a.arg != "self"}
+        if (h.cls, fname) in reported:
+            continue
+        if not _accumulates(model, h.cls, fn, _GUARD_RECURSION_DEPTH):
+            continue
+        if _has_dedupe_guard(
+            model, h.cls, fn, msg_params, _GUARD_RECURSION_DEPTH
+        ):
+            continue
+        reported.add((h.cls, fname))
+        line = fn.lineno if hasattr(fn, "lineno") else h.line
+        out.append(
+            Finding(
+                "retry-no-dedupe", h.path, line, 0,
+                f"{h.cls}.{fname} handles {h.type_name}, which is sent "
+                "under the at-least-once retry template, and accumulates "
+                "state without a dedupe guard — a delivered-but-errored "
+                "send is re-delivered and double-counted",
+                scope=f"{h.cls}.{fname}",
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# reply-closure
+# --------------------------------------------------------------------------
+
+
+@register_project(
+    "reply-closure",
+    "types a handler sends back must be registered on the originating side",
+)
+def check_reply_closure(project: ProjectContext) -> List[Finding]:
+    model = build_model(project)
+    # enclosing-def node -> send sites, for walking replies out of a
+    # handler and the self-methods it calls
+    by_func: Dict[int, List[_SendSite]] = {}
+    for s in model.sends:
+        if s.func is not None:
+            by_func.setdefault(id(s.func), []).append(s)
+    # Originators are resolved per protocol FAMILY (the defining module):
+    # C2S_SEND_MODEL is sent by both the fedavg and the fedbuff client,
+    # but a fedbuff client never converses with a fedavg server — only
+    # same-module originators constrain a handler's replies, with a
+    # global fallback when the family itself has none (types originated
+    # purely by serve/fleet wrapper code).
+    originators: Dict[str, Set[str]] = {}
+    originators_by_module: Dict[Tuple[str, str], Set[str]] = {}
+    for s in model.sends:
+        if s.cls is not None and model.is_manager(s.cls):
+            originators.setdefault(s.type_name, set()).add(s.cls)
+            originators_by_module.setdefault(
+                (s.type_name, s.path), set()
+            ).add(s.cls)
+
+    def replies_of(cls: str, fn: ast.FunctionDef, depth: int,
+                   seen: Set[str]) -> List[_SendSite]:
+        out = list(by_func.get(id(fn), []))
+        if depth <= 0:
+            return out
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr not in seen
+            ):
+                seen.add(node.func.attr)
+                callee = model.method(cls, node.func.attr)
+                if callee is not None:
+                    out.extend(replies_of(cls, callee, depth - 1, seen))
+        return out
+
+    out: List[Finding] = []
+    reported: Set[Tuple[str, str, str, str]] = set()
+    for h in model.handlers:
+        if h.handler is None or h.handler[0] != "method":
+            continue
+        fn = model.method(h.cls, h.handler[1])
+        if fn is None:
+            continue
+        origs = originators_by_module.get((h.type_name, h.path), set()) - {h.cls}
+        if not origs:
+            origs = originators.get(h.type_name, set()) - {h.cls}
+        if not origs:
+            continue
+        replies = replies_of(h.cls, fn, _REPLY_RECURSION_DEPTH, set())
+        for o in sorted(origs):
+            handled = model.handled_types(o)
+            for r in replies:
+                if r.type_name in handled:
+                    continue
+                key = (h.cls, h.type_name, r.type_name, o)
+                if key in reported:
+                    continue
+                reported.add(key)
+                out.append(
+                    Finding(
+                        "reply-closure", r.path, r.line, 0,
+                        f"{h.cls}.{h.handler[1]} replies {r.type_name} to "
+                        f"{h.type_name}, but originator {o} never registers "
+                        f"a handler for {r.type_name}",
+                        scope=r.scope,
+                    )
+                )
+    return out

@@ -204,6 +204,34 @@ def register(name: str, doc: str):
     return deco
 
 
+class ProjectContext:
+    """Every parsed file of one lint run. Per-file rules see one
+    FileContext at a time; the protocol-flow and lock-order rules
+    (analysis/protocol.py, analysis/concurrency.py) need the whole
+    message graph / call graph at once, so they run over this."""
+
+    def __init__(self, files: List[FileContext]):
+        self.files = files
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectRule:
+    name: str
+    doc: str
+    check: Callable[[ProjectContext], List[Finding]]
+
+
+PROJECT_RULES: Dict[str, ProjectRule] = {}
+
+
+def register_project(name: str, doc: str):
+    def deco(fn):
+        PROJECT_RULES[name] = ProjectRule(name=name, doc=doc, check=fn)
+        return fn
+
+    return deco
+
+
 # --------------------------------------------------------------------------
 # uncached-jit
 # --------------------------------------------------------------------------

@@ -35,7 +35,7 @@ class MessageType:
     S2C_INIT_CONFIG = "s2c_init"
     S2C_SYNC_MODEL = "s2c_sync"
     C2S_SEND_MODEL = "c2s_model"
-    C2S_SEND_STATS = "c2s_stats"
+    C2S_SEND_STATS = "c2s_stats"  # fedlint: disable=dead-msg-type -- reference-FedML parity constant; the neutral envelope type transport/retry tests send when they need a real MessageType no production handler consumes
     FINISH = "finish"
     # secure-aggregation key exchange + dropout recovery (client-held keys,
     # secagg/secure_aggregation.py ClientParty/ServerAggregator): clients

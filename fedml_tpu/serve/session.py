@@ -336,7 +336,11 @@ class FedSession:
         self.server = server
         self._injector = injector
         self._make_trainer = make_trainer
-        self._next_rank = K + 1
+        # builders run single-threaded (before start() spawns anything),
+        # but _next_rank is _lock-guarded in join() — keep the invariant
+        # uniform rather than reasoning per-site about thread timelines
+        with self._lock:
+            self._next_rank = K + 1
 
     def _build_splitnn(self):
         """Split-learning tenant (fedml_tpu/splitfed/): server = top half
@@ -390,7 +394,8 @@ class FedSession:
         self.server = server
         self._injector = injector
         self._make_trainer = None
-        self._next_rank = K + 1
+        with self._lock:
+            self._next_rank = K + 1
 
     def _build_fedbuff(self):
         from fedml_tpu.algorithms.fedavg_transport import (
@@ -449,7 +454,8 @@ class FedSession:
         self.server = server
         self._injector = injector
         self._make_trainer = make_trainer
-        self._next_rank = K + 1
+        with self._lock:
+            self._next_rank = K + 1
 
     # -- checkpoint/resume -------------------------------------------------
 
@@ -671,7 +677,8 @@ class FedSession:
                 except Exception:  # noqa: BLE001 — best effort
                     pass
                 self.state = "done"
-                self._finalized = True
+                with self._lock:
+                    self._finalized = True
                 self._cleanup()
                 return self
         self.state = "running"

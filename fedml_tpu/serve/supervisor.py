@@ -228,8 +228,14 @@ class SupervisedSession:
             if self._started:
                 raise RuntimeError(f"session {self.name} already started")
             self._started = True
+        run = self._supervise
+        if self.scope is not None:
+            # the monitor thread builds and reruns sessions: its spans and
+            # restart metrics must fold into this tenant's scope, not the
+            # global registry (thread-locals don't cross Thread boundaries)
+            run = self.scope.wrap(run)
         self._monitor = threading.Thread(
-            target=self._supervise, daemon=True,
+            target=run, daemon=True,
             name=f"fedml-supervisor-{self.name}",
         )
         self._monitor.start()

@@ -58,7 +58,12 @@ from fedml_tpu.splitfed.programs import (
     merge_opt_state,
     split_opt_state,
 )
-from fedml_tpu.telemetry import ClientHealthRegistry, get_comm_meter, get_tracer
+from fedml_tpu.telemetry import (
+    ClientHealthRegistry,
+    get_comm_meter,
+    get_tracer,
+    wrap_in_current_scope,
+)
 
 
 def _host_tree(tree):
@@ -170,8 +175,12 @@ class SplitNNServerManager(ServerManager):
 
     @global_vars.setter
     def global_vars(self, tree: dict) -> None:
-        self._bottom_params = tree["params"]["bottom"]
-        self._top_params = tree["params"]["top"]
+        # checkpoint-restore surface: runs before the serve loop starts,
+        # but the halves it swaps are relay state everywhere else — take
+        # the (free) lock rather than reason about restore timing per-site
+        with self._round_lock:
+            self._bottom_params = tree["params"]["bottom"]
+            self._top_params = tree["params"]["top"]
 
     @property
     def _server_opt_state(self):
@@ -215,7 +224,11 @@ class SplitNNServerManager(ServerManager):
 
     def send_init_msg(self):
         self._t0 = time.monotonic()
-        self._start_round()
+        # steady-state rounds start under _round_lock (handler-driven via
+        # _finish_or_next_round); the opening round must too, or its FSM
+        # resets race the first activations arriving on the comm thread
+        with self._round_lock:
+            self._start_round()
 
     def _broadcast(self, msg: Message) -> bool:
         """Dead-peer-tolerant send (same contract as the FedAvg server's):
@@ -274,6 +287,13 @@ class SplitNNServerManager(ServerManager):
         )
 
     def _on_acts(self, msg: Message):
+        # the whole boundary step runs under _round_lock: request_stop's
+        # drain=False path completes the round from another thread, and
+        # the FSM counters it resets are the ones mutated here
+        with self._round_lock:
+            self._on_acts_locked(msg)
+
+    def _on_acts_locked(self, msg: Message):
         if not self._turn_is_current(msg) or int(msg.get(MT.ARG_BATCH_IDX)) != self._next_batch:
             self.dropped_boundary += 1
             return
@@ -321,6 +341,10 @@ class SplitNNServerManager(ServerManager):
             self._send_turn()
 
     def _on_done(self, msg: Message):
+        with self._round_lock:
+            self._on_done_locked(msg)
+
+    def _on_done_locked(self, msg: Message):
         if not self._turn_is_current(msg):
             self.dropped_boundary += 1
             return
@@ -344,10 +368,11 @@ class SplitNNServerManager(ServerManager):
             self._finish_or_next_round()
 
     def _finish_or_next_round(self):
-        with self._round_lock:
-            if self._federation_done:
-                return
-            self._complete_round()
+        """Caller holds ``_round_lock`` (handlers enter through their
+        locked wrappers; _start_round's callers hold it too)."""
+        if self._federation_done:
+            return
+        self._complete_round()
 
     def _complete_round(self):
         """Close the open round: log the row, advance or FINISH. Caller
@@ -572,7 +597,12 @@ def run_loopback_splitnn(
         for rank in range(1, k + 1)
     ]
     threads = [
-        threading.Thread(target=c.run, daemon=True, name=f"splitnn-client-{c.rank}")
+        # bind the spawner's telemetry scope to each client thread — bare
+        # c.run would emit this tenant's spans into the global registry
+        threading.Thread(
+            target=wrap_in_current_scope(c.run), daemon=True,
+            name=f"splitnn-client-{c.rank}",
+        )
         for c in clients
     ]
     for t in threads:

@@ -46,7 +46,7 @@ from fedml_tpu.splitfed.programs import (
     make_vfl_party_forward,
     make_vfl_party_update,
 )
-from fedml_tpu.telemetry import get_comm_meter, get_tracer
+from fedml_tpu.telemetry import get_comm_meter, get_tracer, wrap_in_current_scope
 
 
 def _party_params(feature_splits, hidden_dim, out_dim, seed, party_idx):
@@ -332,7 +332,12 @@ def run_loopback_vfl(
         for rank in range(1, len(xs_parties))
     ]
     threads = [
-        threading.Thread(target=h.run, daemon=True, name=f"vfl-host-{h.rank}")
+        # bind the spawner's telemetry scope to each host thread — bare
+        # h.run would emit this tenant's spans into the global registry
+        threading.Thread(
+            target=wrap_in_current_scope(h.run), daemon=True,
+            name=f"vfl-host-{h.rank}",
+        )
         for h in hosts
     ]
     for t in threads:

@@ -53,6 +53,7 @@ from fedml_tpu.telemetry.scope import (
     TelemetryScope,
     activate_scope,
     current_scope,
+    wrap_in_current_scope,
 )
 from fedml_tpu.telemetry.spans import (
     Span,
@@ -96,6 +97,7 @@ __all__ = [
     "get_tracer",
     "span",
     "telemetry_summary",
+    "wrap_in_current_scope",
 ]
 
 

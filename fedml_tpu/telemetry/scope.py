@@ -137,3 +137,16 @@ def activate_scope(scope: Optional[TelemetryScope]):
     if scope is None:
         return contextlib.nullcontext()
     return scope.activate()
+
+
+def wrap_in_current_scope(fn):
+    """``fn`` bound to the CALLING thread's active scope — the standard
+    way to hand a callable to ``threading.Thread``. Thread-locals don't
+    cross Thread boundaries, so a bare ``Thread(target=fn)`` silently
+    drops the spawner's tenant attribution; this captures it at spawn
+    time. Identity when no scope is active (global-registry semantics
+    are then intentional, not accidental)."""
+    scope = current_scope()
+    if scope is None:
+        return fn
+    return scope.wrap(fn)

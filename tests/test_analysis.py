@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 from fedml_tpu.analysis.lint import lint_paths, load_baseline, write_baseline
-from fedml_tpu.analysis.rules import RULES
+from fedml_tpu.analysis.rules import PROJECT_RULES, RULES
 
 
 # ---------------------------------------------------------------------------
@@ -39,6 +39,13 @@ def test_rule_catalog_complete():
         "uncached-jit", "baked-constant", "host-sync", "nondet-in-trace",
         "repr-in-digest", "o-n-per-round",
     }
+    assert set(PROJECT_RULES) == {
+        "sent-unhandled", "dead-msg-type", "retry-no-dedupe",
+        "reply-closure", "lock-order-cycle", "unlocked-shared-mutation",
+        "unscoped-thread",
+    }
+    # the two registries share one --rule namespace: no collisions
+    assert not set(RULES) & set(PROJECT_RULES)
 
 
 # -- uncached-jit -----------------------------------------------------------
@@ -444,6 +451,695 @@ def test_baseline_roundtrip(tmp_path):
         ":" not in fp.rsplit("::", 1)[-1] or True
         for fp in json.load(open(bl))["findings"]
     )
+
+
+# ---------------------------------------------------------------------------
+# protocol-flow rules (fedml_tpu/analysis/protocol.py)
+# ---------------------------------------------------------------------------
+
+
+_PROTO_PREAMBLE = """
+    from fedml_tpu.core.message import Message
+    from fedml_tpu.algorithms.base_framework import ClientManager, ServerManager
+
+    class MessageType:
+        S2C_PING = "s2c_ping"
+        C2S_PONG = "c2s_pong"
+"""
+
+
+def test_sent_unhandled_fires_when_family_never_registers(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class PingServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def kick(self):
+                self.send_message(Message(MessageType.S2C_PING, 0, 1))
+
+        class PingClientManager(ClientManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.C2S_PONG, self._on_pong
+                )
+
+            def _on_pong(self, msg):
+                pass
+        """,
+        rules=["sent-unhandled"],
+    )
+    assert _rules_of(report) == ["sent-unhandled"]
+    assert "S2C_PING" in report.findings[0].message
+
+
+def test_sent_unhandled_silent_when_peer_registers(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class PingServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def kick(self):
+                self.send_message(Message(MessageType.S2C_PING, 0, 1))
+
+        class PingClientManager(ClientManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.S2C_PING, self._on_ping
+                )
+
+            def _on_ping(self, msg):
+                pass
+        """,
+        rules=["sent-unhandled"],
+    )
+    assert report.clean, report.render()
+
+
+def test_sent_unhandled_resolves_type_through_helper_param(tmp_path):
+    # the _broadcast_round shape: the type flows through a parameter of
+    # a same-class helper; the resolver follows the call site
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class PingServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def kick(self):
+                self._fan_out(MessageType.S2C_PING)
+
+            def _fan_out(self, msg_type):
+                self.send_message(Message(msg_type, 0, 1))
+        """,
+        rules=["sent-unhandled"],
+    )
+    assert _rules_of(report) == ["sent-unhandled"]
+
+
+def test_dead_msg_type_fires_and_clears_on_send(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        from fedml_tpu.core.message import Message
+
+        class MessageType:
+            S2C_LIVE = "s2c_live"
+            S2C_ORPHAN = "s2c_orphan"
+
+        def kick(comm):
+            comm.send_message(Message(MessageType.S2C_LIVE, 0, 1))
+        """,
+        rules=["dead-msg-type"],
+    )
+    assert _rules_of(report) == ["dead-msg-type"]
+    assert report.findings[0].scope == "S2C_ORPHAN"
+
+
+def test_retry_no_dedupe_fires_on_unguarded_accumulation(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class UpServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+                self.total = 0
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.C2S_PONG, self._on_pong
+                )
+
+            def _on_pong(self, msg):
+                self.total += 1
+
+        class UpClientManager(ClientManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def push(self):
+                self.send_message(Message(MessageType.C2S_PONG, 1, 0))
+        """,
+        rules=["retry-no-dedupe"],
+    )
+    assert _rules_of(report) == ["retry-no-dedupe"]
+    assert report.findings[0].scope == "UpServerManager._on_pong"
+
+
+def test_retry_no_dedupe_silent_with_tag_guard(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class UpServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+                self.total = 0
+                self._last = {}
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.C2S_PONG, self._on_pong
+                )
+
+            def _on_pong(self, msg):
+                sender = msg.get_sender_id()
+                tag = msg.get("tag")
+                if self._last.get(sender) == tag:
+                    return
+                self._last[sender] = tag
+                self.total += 1
+
+        class UpClientManager(ClientManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def push(self):
+                self.send_message(Message(MessageType.C2S_PONG, 1, 0))
+        """,
+        rules=["retry-no-dedupe"],
+    )
+    assert report.clean, report.render()
+
+
+def test_retry_no_dedupe_silent_on_single_attempt_send(tmp_path):
+    # send_message_nowait is the single-attempt path: no retry, no
+    # at-least-once hazard, no dedupe requirement on the handler
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class UpServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+                self.total = 0
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.C2S_PONG, self._on_pong
+                )
+
+            def _on_pong(self, msg):
+                self.total += 1
+
+        class UpClientManager(ClientManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def push(self):
+                self.comm.send_message_nowait(
+                    Message(MessageType.C2S_PONG, 1, 0)
+                )
+        """,
+        rules=["retry-no-dedupe"],
+    )
+    assert report.clean, report.render()
+
+
+def test_reply_closure_fires_when_originator_lacks_handler(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class QaServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.C2S_PONG, self._on_pong
+                )
+
+            def _on_pong(self, msg):
+                self.send_message(
+                    Message(MessageType.S2C_PING, 0, msg.get_sender_id())
+                )
+
+        class QaClientManager(ClientManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def push(self):
+                self.send_message(Message(MessageType.C2S_PONG, 1, 0))
+        """,
+        rules=["reply-closure"],
+    )
+    assert _rules_of(report) == ["reply-closure"]
+    msg = report.findings[0].message
+    assert "S2C_PING" in msg and "QaClientManager" in msg
+
+
+def test_reply_closure_silent_when_originator_handles_reply(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        _PROTO_PREAMBLE + """
+        class QaServerManager(ServerManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.C2S_PONG, self._on_pong
+                )
+
+            def _on_pong(self, msg):
+                self.send_message(
+                    Message(MessageType.S2C_PING, 0, msg.get_sender_id())
+                )
+
+        class QaClientManager(ClientManager):
+            def __init__(self, config, comm, rank):
+                super().__init__(config, comm, rank)
+
+            def register_message_receive_handlers(self):
+                self.register_message_receive_handler(
+                    MessageType.S2C_PING, self._on_ping
+                )
+
+            def _on_ping(self, msg):
+                pass
+
+            def push(self):
+                self.send_message(Message(MessageType.C2S_PONG, 1, 0))
+        """,
+        rules=["reply-closure"],
+    )
+    assert report.clean, report.render()
+
+
+# ---------------------------------------------------------------------------
+# concurrency rules (fedml_tpu/analysis/concurrency.py)
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_cycle_fires_on_inverted_nesting(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """,
+        rules=["lock-order-cycle"],
+    )
+    assert _rules_of(report) == ["lock-order-cycle"]
+    assert "both orders" in report.findings[0].message
+
+
+def test_lock_order_cycle_sees_through_call_graph(tmp_path):
+    # the second order is transitive: two() holds _b and CALLS a helper
+    # that takes _a — the held-call × transitive-acquire edge
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    self._grab_a()
+
+            def _grab_a(self):
+                with self._a:
+                    pass
+        """,
+        rules=["lock-order-cycle"],
+    )
+    assert _rules_of(report) == ["lock-order-cycle"]
+
+
+def test_lock_order_consistent_nesting_is_silent(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """,
+        rules=["lock-order-cycle"],
+    )
+    assert report.clean, report.render()
+
+
+def test_unlocked_shared_mutation_fires_on_mixed_discipline(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def reset(self):
+                self.n = 0
+        """,
+        rules=["unlocked-shared-mutation"],
+    )
+    assert _rules_of(report) == ["unlocked-shared-mutation"]
+    assert "reset" in report.findings[0].message
+
+
+def test_unlocked_shared_mutation_accepts_caller_holds_convention(tmp_path):
+    # every intraclass call site of _clear holds the lock: _clear's
+    # writes are locked-context, not races
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def reset(self):
+                with self._lock:
+                    self._clear()
+
+            def _clear(self):
+                self.n = 0
+        """,
+        rules=["unlocked-shared-mutation"],
+    )
+    assert report.clean, report.render()
+
+
+def test_unlocked_shared_mutation_handles_self_recursion(tmp_path):
+    # the secure-agg _complete_round shape: a caller-holds method that
+    # re-enters ITSELF — only a greatest fixpoint proves it locked
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def flush(self):
+                with self._lock:
+                    self._drain()
+
+            def _drain(self):
+                self.n = 0
+                if self.n:
+                    self._drain()
+        """,
+        rules=["unlocked-shared-mutation"],
+    )
+    assert report.clean, report.render()
+
+
+def test_unscoped_thread_fires_in_serve_dir(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Runner:
+            def start(self):
+                t = threading.Thread(target=self.run, daemon=True)
+                t.start()
+        """,
+        rel="fedml_tpu/serve/snippet.py",
+        rules=["unscoped-thread"],
+    )
+    assert _rules_of(report) == ["unscoped-thread"]
+
+
+def test_unscoped_thread_accepts_scope_wrappers(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+        from fedml_tpu.telemetry import wrap_in_current_scope
+
+        class Runner:
+            def start(self):
+                threading.Thread(
+                    target=wrap_in_current_scope(self.run), daemon=True
+                ).start()
+                run = self.scope.wrap(self.run)
+                threading.Thread(target=run, daemon=True).start()
+
+            def start_inline(self):
+                def main():
+                    with self.scope.activate():
+                        self.run()
+                threading.Thread(target=main, daemon=True).start()
+        """,
+        rel="fedml_tpu/serve/snippet.py",
+        rules=["unscoped-thread"],
+    )
+    assert report.clean, report.render()
+
+
+def test_unscoped_thread_out_of_scope_dirs_silent(tmp_path):
+    report = _lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Runner:
+            def start(self):
+                threading.Thread(target=self.run, daemon=True).start()
+        """,
+        rel="fedml_tpu/algorithms/snippet.py",
+        rules=["unscoped-thread"],
+    )
+    assert report.clean, report.render()
+
+
+# ---------------------------------------------------------------------------
+# seeded regressions on REAL tree copies — each rule must detect its
+# target bug when the shipped fix/guard is removed
+# ---------------------------------------------------------------------------
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _copy_into(tmp_path, rel, source):
+    dst = tmp_path / rel
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(source)
+
+
+def test_seeded_fedbuff_without_leave_dedupe_is_detected(tmp_path):
+    """Removing the shipped _on_leave dedupe guard from a copy of the
+    real fedbuff module recreates the double-counted-LEAVE bug — the
+    rule must catch exactly it (and stay quiet on the intact copy)."""
+    repo = _repo_root()
+    src = open(os.path.join(repo, "fedml_tpu/algorithms/fedbuff.py")).read()
+    msg = open(os.path.join(repo, "fedml_tpu/core/message.py")).read()
+    guard = (
+        "            if sender in self._dead_workers:\n"
+        "                # duplicate LEAVE (at-least-once delivery) — already\n"
+        "                # counted; re-adding would double the leaves tally\n"
+        "                return\n"
+    )
+    assert guard in src  # the shipped guard this regression pins
+    _copy_into(tmp_path, "fedml_tpu/core/message.py", msg)
+    _copy_into(
+        tmp_path, "fedml_tpu/algorithms/fedbuff.py", src.replace(guard, "")
+    )
+    report = lint_paths(
+        [str(tmp_path)], rules=["retry-no-dedupe"], base_dir=str(tmp_path)
+    )
+    assert [f.scope for f in report.findings] == [
+        "FedBuffServerManager._on_leave"
+    ], report.render()
+    # the intact copy is clean — the guard is what the rule keys on
+    _copy_into(tmp_path, "fedml_tpu/algorithms/fedbuff.py", src)
+    report = lint_paths(
+        [str(tmp_path)], rules=["retry-no-dedupe"], base_dir=str(tmp_path)
+    )
+    assert report.clean, report.render()
+
+
+def test_seeded_serve_lock_order_inversion_is_detected(tmp_path):
+    """The serve layer's real discipline is _admit_lock -> _lock
+    (create_session -> _create_session). A method taking them in the
+    reverse order, seeded into a copy of the real module, must surface
+    as a lock-order-cycle."""
+    repo = _repo_root()
+    src = open(os.path.join(repo, "fedml_tpu/serve/server.py")).read()
+    anchor = "    def add_session("
+    assert anchor in src
+    inverted = (
+        "    def _seeded_inversion(self):\n"
+        "        with self._lock:\n"
+        "            with self._admit_lock:\n"
+        "                pass\n\n"
+    )
+    _copy_into(
+        tmp_path, "fedml_tpu/serve/server.py",
+        src.replace(anchor, inverted + anchor, 1),
+    )
+    report = lint_paths(
+        [str(tmp_path)], rules=["lock-order-cycle"], base_dir=str(tmp_path)
+    )
+    assert _rules_of(report) == ["lock-order-cycle"], report.render()
+    assert "_admit_lock" in report.findings[0].message
+    # the unmodified copy is clean — the inversion is the bug
+    _copy_into(tmp_path, "fedml_tpu/serve/server.py", src)
+    report = lint_paths(
+        [str(tmp_path)], rules=["lock-order-cycle"], base_dir=str(tmp_path)
+    )
+    assert report.clean, report.render()
+
+
+# ---------------------------------------------------------------------------
+# walk scope + CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_lint_walk_visits_every_package_dir():
+    """The walk-scope pin: every fedml_tpu/ package directory with .py
+    files appears in the visited-file list — a future walk regression
+    (pruned dir, bad filter) cannot silently exempt a subsystem."""
+    repo = _repo_root()
+    pkg = os.path.join(repo, "fedml_tpu")
+    report = lint_paths([pkg], base_dir=repo, rules=["repr-in-digest"])
+    visited_dirs = {os.path.dirname(p) for p in report.files}
+    expected = set()
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        if any(f.endswith(".py") for f in files):
+            expected.add(os.path.relpath(root, repo).replace(os.sep, "/"))
+    assert visited_dirs == expected
+    assert len(report.files) == report.files_checked
+    # the subsystems the new rules exist for are in scope
+    for sub in ("analysis", "serve", "splitfed", "algorithms", "telemetry"):
+        assert f"fedml_tpu/{sub}" in visited_dirs
+
+
+def _cli_fixture(tmp_path):
+    path = tmp_path / "fedml_tpu" / "algorithms" / "snippet.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    return str(tmp_path / "fedml_tpu")
+
+
+def test_cli_format_json(tmp_path, capsys):
+    from fedml_tpu.analysis.__main__ import main
+
+    rc = main([
+        _cli_fixture(tmp_path), "--format", "json",
+        "--rule", "uncached-jit",
+        "--baseline", str(tmp_path / "no-baseline.json"),
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0  # no --fail-on-findings
+    assert [f["rule"] for f in doc["findings"]] == ["uncached-jit"]
+    f = doc["findings"][0]
+    assert f["path"].endswith("snippet.py") and f["line"] == 2
+    assert f["fingerprint"]  # stable CI-artifact identity
+    assert doc["files_checked"] == 1 and doc["files"] == [f["path"]]
+    assert doc["suppressed"] == 0 and doc["baselined"] == 0
+
+
+def test_cli_format_text_default_matches_render(tmp_path, capsys):
+    from fedml_tpu.analysis.__main__ import main
+
+    target = _cli_fixture(tmp_path)
+    baseline = str(tmp_path / "no-baseline.json")
+    rc = main([target, "--rule", "uncached-jit", "--baseline", baseline])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # default --format text is exactly LintReport.render() — byte-stable
+    # for anything parsing today's output
+    assert out.rstrip("\n").endswith(
+        "fedlint: 1 finding(s), 0 suppressed, 0 baselined, 1 file(s) checked"
+    )
+    assert "uncached-jit" in out
+
+
+def test_cli_fail_on_findings_exit_codes(tmp_path, capsys):
+    from fedml_tpu.analysis.__main__ import main
+
+    target = _cli_fixture(tmp_path)
+    baseline = str(tmp_path / "no-baseline.json")
+    assert main([
+        target, "--rule", "uncached-jit", "--baseline", baseline,
+        "--fail-on-findings",
+    ]) == 1
+    capsys.readouterr()
+
+
+def test_cli_unknown_rule_is_usage_error(tmp_path, capsys):
+    from fedml_tpu.analysis.__main__ import main
+
+    rc = main([_cli_fixture(tmp_path), "--rule", "no-such-rule"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "unknown rule" in err and "no-such-rule" in err
+
+
+def test_cli_list_rules_covers_both_registries(capsys):
+    from fedml_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in list(RULES) + list(PROJECT_RULES):
+        assert name in out
 
 
 # -- the acceptance gate: the shipped tree is clean -------------------------
